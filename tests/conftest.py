@@ -1,0 +1,44 @@
+"""Test fixtures.
+
+The reference tests distributed code against Spark local-mode
+(TestSparkContext spins local[2], utils/.../test/TestSparkContext.scala:36).
+Our analog: JAX on a virtual 8-device CPU mesh —
+``--xla_force_host_platform_device_count=8`` (SURVEY §4 implication c).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pandas as pd
+import pytest
+
+TITANIC_CSV = "/root/reference/test-data/PassengerDataAllWithHeader.csv"
+
+
+@pytest.fixture(scope="session")
+def titanic_df():
+    if os.path.exists(TITANIC_CSV):
+        df = pd.read_csv(TITANIC_CSV)
+        df.columns = [c.strip() for c in df.columns]
+        return df
+    # synthetic fallback with the same schema
+    rng = np.random.default_rng(0)
+    n = 800
+    return pd.DataFrame({
+        "PassengerId": np.arange(n),
+        "Survived": rng.integers(0, 2, n),
+        "Pclass": rng.integers(1, 4, n),
+        "Name": [f"Person {i}" for i in range(n)],
+        "Sex": rng.choice(["male", "female"], n),
+        "Age": np.where(rng.random(n) < 0.2, np.nan, rng.uniform(1, 80, n)),
+        "SibSp": rng.integers(0, 5, n),
+        "Parch": rng.integers(0, 5, n),
+        "Ticket": [f"T{i}" for i in range(n)],
+        "Fare": rng.uniform(5, 500, n),
+        "Cabin": np.where(rng.random(n) < 0.7, None, "C85"),
+        "Embarked": rng.choice(["S", "C", "Q", None], n),
+    })

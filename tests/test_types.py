@@ -1,0 +1,110 @@
+"""Feature type system tests (reference: features/ type tests)."""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+
+
+def test_hierarchy():
+    assert issubclass(T.Currency, T.Real)
+    assert issubclass(T.DateTime, T.Date)
+    assert issubclass(T.Date, T.Integral)
+    assert issubclass(T.RealNN, T.Real)
+    assert issubclass(T.PickList, T.Text)
+    assert issubclass(T.Email, T.Text)
+    assert issubclass(T.CurrencyMap, T.RealMap)
+    assert issubclass(T.Prediction, T.RealMap)
+    assert issubclass(T.RealNN, T.NonNullable)
+    assert issubclass(T.PickList, T.SingleResponse)
+    assert issubclass(T.MultiPickList, T.MultiResponse)
+    assert issubclass(T.Geolocation, T.Location)
+    assert issubclass(T.Country, T.Location)
+
+
+def test_type_count():
+    # the reference defines ~45 nominal types (SURVEY §2.1)
+    assert len(T.FEATURE_TYPES) >= 45
+
+
+def test_nullability():
+    assert T.Real(None).is_empty
+    assert not T.Real(1.5).is_empty
+    assert T.Real(1.5).value == 1.5
+    with pytest.raises(ValueError):
+        T.RealNN(None)
+    assert T.Text(None).is_empty
+    assert T.TextList(None).is_empty
+    assert T.TextList(["a"]).value == ["a"]
+    assert T.RealMap(None).is_empty
+    assert T.RealMap({"a": 1}).value == {"a": 1.0}
+
+
+def test_equality():
+    assert T.Real(1.0) == T.Real(1.0)
+    assert T.Real(1.0) != T.Real(2.0)
+    assert T.Real(1.0) != T.Currency(1.0)  # nominal typing
+    assert T.Text("a") == T.Text("a")
+
+
+def test_conversions():
+    assert T.Integral("5").value == 5
+    assert T.Binary(1).value is True
+    assert T.Real(3).value == 3.0
+    assert T.Integral(None).to_double() is None
+    assert T.Integral(5).to_double() == 5.0
+
+
+def test_email():
+    e = T.Email("user@example.com")
+    assert e.prefix() == "user"
+    assert e.domain() == "example.com"
+    assert T.Email("bogus").prefix() is None
+
+
+def test_url():
+    u = T.URL("https://example.com/path")
+    assert u.is_valid()
+    assert u.domain() == "example.com"
+    assert u.protocol() == "https"
+    assert not T.URL("not a url").is_valid()
+
+
+def test_geolocation():
+    g = T.Geolocation([37.7, -122.4, 5.0])
+    assert g.lat == 37.7 and g.lon == -122.4 and g.accuracy == 5.0
+    with pytest.raises(ValueError):
+        T.Geolocation([100.0, 200.0, 1.0])
+    with pytest.raises(ValueError):
+        T.Geolocation([1.0, 2.0])
+    sphere = g.to_unit_sphere()
+    assert abs(np.linalg.norm(sphere) - 1.0) < 1e-9
+
+
+def test_prediction():
+    p = T.Prediction(prediction=1.0, probability=[0.2, 0.8], raw_prediction=[-1.0, 1.0])
+    assert p.prediction == 1.0
+    assert p.probability == [0.2, 0.8]
+    assert p.raw_prediction == [-1.0, 1.0]
+    with pytest.raises(ValueError):
+        T.Prediction({"probability_0": 0.3})
+
+
+def test_multipicklist():
+    m = T.MultiPickList(["a", "b", "a"])
+    assert m.value == {"a", "b"}
+
+
+def test_factory():
+    assert T.feature_type_by_name("Real") is T.Real
+    assert T.make(T.Real, 2).value == 2.0
+    assert T.default_of(T.Real).is_empty
+    assert T.default_of(T.RealNN).value == 0.0
+    assert T.default_of(T.Prediction).prediction == 0.0
+    assert T.is_nullable(T.Real) and not T.is_nullable(T.RealNN)
+
+
+def test_opvector():
+    v = T.OPVector([1.0, 2.0])
+    assert not v.is_empty
+    assert v == T.OPVector([1.0, 2.0])
+    assert T.OPVector(None).is_empty

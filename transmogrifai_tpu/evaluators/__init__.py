@@ -1,0 +1,140 @@
+"""Evaluators (reference core/src/main/scala/com/salesforce/op/evaluators/).
+
+``Evaluators`` factory mirrors ``Evaluators.BinaryClassification.auPR()`` etc.
+(Evaluators.scala:40), including custom-metric evaluators.
+"""
+from typing import Callable, Optional
+
+import numpy as np
+
+from .base import (OpBinaryClassificationEvaluatorBase, OpEvaluatorBase,
+                   OpMultiClassificationEvaluatorBase, OpRegressionEvaluatorBase)
+from .classification import (OpBinaryClassificationEvaluator, OpBinScoreEvaluator,
+                             OpLogLoss, OpMultiClassificationEvaluator,
+                             binary_counts, pr_auc, roc_auc)
+from .regression import OpForecastEvaluator, OpRegressionEvaluator
+
+
+class _SingleMetric(OpEvaluatorBase):
+    """Wrap a full evaluator, exposing one metric as the default."""
+
+    def __init__(self, inner: OpEvaluatorBase, metric: str, larger_better: bool):
+        super().__init__(inner.label_col, inner.prediction_col)
+        self.inner = inner
+        self.name = f"{inner.name}.{metric}"
+        self.default_metric = metric
+        self.is_larger_better = larger_better
+
+    def evaluate_all(self, ds, label_col=None, prediction_col=None):
+        return self.inner.evaluate_all(ds, label_col, prediction_col)
+
+    def evaluate_arrays(self, y, prediction, probability=None):
+        return self.inner.evaluate_arrays(y, prediction, probability)
+
+
+class CustomEvaluator(OpEvaluatorBase):
+    """User-defined metric (Evaluators.BinaryClassification.custom analog)."""
+
+    def __init__(self, metric_name: str, is_larger_better: bool,
+                 fn: Callable[[np.ndarray, np.ndarray, Optional[np.ndarray]], float],
+                 label_col: Optional[str] = None, prediction_col: Optional[str] = None):
+        super().__init__(label_col, prediction_col)
+        self.name = f"custom.{metric_name}"
+        self.default_metric = metric_name
+        self.is_larger_better = is_larger_better
+        self.fn = fn
+
+    def evaluate_arrays(self, y, prediction, probability=None):
+        return {self.default_metric: float(self.fn(y, prediction, probability))}
+
+    def evaluate_all(self, ds, label_col=None, prediction_col=None):
+        y, pred = self._extract(ds, label_col, prediction_col)
+        return self.evaluate_arrays(y, pred.prediction, pred.probability)
+
+
+class Evaluators:
+    class BinaryClassification:
+        @staticmethod
+        def auROC() -> OpEvaluatorBase:
+            return _SingleMetric(OpBinaryClassificationEvaluator(), "AuROC", True)
+
+        @staticmethod
+        def auPR() -> OpEvaluatorBase:
+            return _SingleMetric(OpBinaryClassificationEvaluator(), "AuPR", True)
+
+        @staticmethod
+        def precision() -> OpEvaluatorBase:
+            return _SingleMetric(OpBinaryClassificationEvaluator(), "Precision", True)
+
+        @staticmethod
+        def recall() -> OpEvaluatorBase:
+            return _SingleMetric(OpBinaryClassificationEvaluator(), "Recall", True)
+
+        @staticmethod
+        def f1() -> OpEvaluatorBase:
+            return _SingleMetric(OpBinaryClassificationEvaluator(), "F1", True)
+
+        @staticmethod
+        def error() -> OpEvaluatorBase:
+            return _SingleMetric(OpBinaryClassificationEvaluator(), "Error", False)
+
+        @staticmethod
+        def brierScore() -> OpEvaluatorBase:
+            return OpBinScoreEvaluator()
+
+        @staticmethod
+        def custom(metric_name: str, is_larger_better: bool, fn) -> OpEvaluatorBase:
+            return CustomEvaluator(metric_name, is_larger_better, fn)
+
+    class MultiClassification:
+        @staticmethod
+        def f1() -> OpEvaluatorBase:
+            return _SingleMetric(OpMultiClassificationEvaluator(), "F1", True)
+
+        @staticmethod
+        def precision() -> OpEvaluatorBase:
+            return _SingleMetric(OpMultiClassificationEvaluator(), "Precision", True)
+
+        @staticmethod
+        def recall() -> OpEvaluatorBase:
+            return _SingleMetric(OpMultiClassificationEvaluator(), "Recall", True)
+
+        @staticmethod
+        def error() -> OpEvaluatorBase:
+            return _SingleMetric(OpMultiClassificationEvaluator(), "Error", False)
+
+        @staticmethod
+        def logLoss() -> OpEvaluatorBase:
+            return OpLogLoss()
+
+        @staticmethod
+        def custom(metric_name: str, is_larger_better: bool, fn) -> OpEvaluatorBase:
+            return CustomEvaluator(metric_name, is_larger_better, fn)
+
+    class Regression:
+        @staticmethod
+        def rmse() -> OpEvaluatorBase:
+            return _SingleMetric(OpRegressionEvaluator(), "RootMeanSquaredError", False)
+
+        @staticmethod
+        def mse() -> OpEvaluatorBase:
+            return _SingleMetric(OpRegressionEvaluator(), "MeanSquaredError", False)
+
+        @staticmethod
+        def mae() -> OpEvaluatorBase:
+            return _SingleMetric(OpRegressionEvaluator(), "MeanAbsoluteError", False)
+
+        @staticmethod
+        def r2() -> OpEvaluatorBase:
+            return _SingleMetric(OpRegressionEvaluator(), "R2", True)
+
+        @staticmethod
+        def smape() -> OpEvaluatorBase:
+            return OpForecastEvaluator()
+
+        @staticmethod
+        def custom(metric_name: str, is_larger_better: bool, fn) -> OpEvaluatorBase:
+            return CustomEvaluator(metric_name, is_larger_better, fn)
+
+
+__all__ = [n for n in dir() if not n.startswith("_")]

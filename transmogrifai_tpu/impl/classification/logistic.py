@@ -1,0 +1,73 @@
+"""OpLogisticRegression — logistic regression predictor.
+
+Reference parity: core/.../impl/classification/OpLogisticRegression.scala
+wrapping Spark's LogisticRegression with params regParam, elasticNetParam,
+maxIter, tol, fitIntercept, standardization, family (auto/binomial/multinomial).
+
+TPU-native: binary fits use full-batch Newton (pure L2) or FISTA prox-gradient
+(elastic net); multiclass uses accelerated softmax regression — all
+fixed-iteration jit'd kernels from ``ops.linear``.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops import linear as L
+from ..selector.predictor import PredictorEstimator
+
+
+class OpLogisticRegression(PredictorEstimator):
+    is_classifier = True
+
+    def __init__(self, reg_param: float = 0.0, elastic_net_param: float = 0.0,
+                 max_iter: int = 100, tol: float = 1e-6, fit_intercept: bool = True,
+                 standardization: bool = True, family: str = "auto",
+                 uid: Optional[str] = None, **extra):
+        super().__init__(operation_name="OpLogisticRegression", uid=uid,
+                         reg_param=reg_param, elastic_net_param=elastic_net_param,
+                         max_iter=max_iter, tol=tol, fit_intercept=fit_intercept,
+                         standardization=standardization, family=family, **extra)
+
+    def fit_arrays(self, X: np.ndarray, y: np.ndarray,
+                   w: Optional[np.ndarray] = None) -> Dict[str, Any]:
+        X = jnp.asarray(X, jnp.float32)
+        y = jnp.asarray(y, jnp.float32)
+        sw = jnp.ones(X.shape[0], jnp.float32) if w is None else jnp.asarray(w, jnp.float32)
+        reg = float(self.get_param("reg_param", 0.0))
+        alpha = float(self.get_param("elastic_net_param", 0.0))
+        fit_intercept = bool(self.get_param("fit_intercept", True))
+        max_iter = int(self.get_param("max_iter", 100))
+        family = self.get_param("family", "auto")
+        num_classes = int(np.max(np.asarray(y))) + 1 if len(y) else 2
+        multinomial = family == "multinomial" or (family == "auto" and num_classes > 2)
+        if multinomial:
+            fitres = L.fit_softmax(X, y, sw, reg * (1.0 - alpha), num_classes=max(num_classes, 2),
+                                   max_iter=max_iter, fit_intercept=fit_intercept,
+                                   l1=reg * alpha)
+            return {"coef": np.asarray(fitres.coef), "intercept": np.asarray(fitres.intercept),
+                    "num_classes": max(num_classes, 2), "multinomial": True}
+        if alpha > 0.0 and reg > 0.0:
+            fitres = L.fit_logistic_fista(X, y, sw, l1=reg * alpha, l2=reg * (1.0 - alpha),
+                                          max_iter=max(max_iter, 200),
+                                          fit_intercept=fit_intercept)
+        else:
+            fitres = L.fit_logistic_newton(X, y, sw, l2=reg,
+                                           max_iter=min(max(max_iter // 4, 10), 50),
+                                           fit_intercept=fit_intercept)
+        return {"coef": np.asarray(fitres.coef), "intercept": np.asarray(fitres.intercept),
+                "num_classes": 2, "multinomial": False}
+
+    @classmethod
+    def predict_arrays(cls, params: Dict[str, Any], X: np.ndarray
+                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        X = jnp.asarray(X, jnp.float32)
+        coef = jnp.asarray(params["coef"], jnp.float32)
+        intercept = jnp.asarray(params["intercept"], jnp.float32)
+        if params.get("multinomial"):
+            raw, prob, pred = L.predict_softmax(X, coef, intercept)
+        else:
+            raw, prob, pred = L.predict_binary_logistic(X, coef, intercept)
+        return np.asarray(pred), np.asarray(raw), np.asarray(prob)

@@ -1,0 +1,316 @@
+"""Numeric / binary / categorical vectorizers + vector assembly.
+
+Reference parity:
+- ``RealVectorizer`` / ``IntegralVectorizer`` / ``BinaryVectorizer`` /
+  ``RealNNVectorizer`` (core/.../impl/feature/ numeric vectorizers): fill
+  mean/mode/constant + null-tracking indicator columns,
+- ``OpOneHotVectorizer`` (OpOneHotVectorizer.scala:61): topK + minSupport
+  pivot with OTHER and null columns,
+- ``OpSetVectorizer`` for MultiPickList,
+- ``VectorsCombiner`` (VectorsCombiner.scala:51): SequenceTransformer that
+  concatenates OPVectors and merges their metadata,
+- ``OpScalarStandardScaler`` (OpScalarStandardScaler.scala:49).
+
+Fit statistics are single-pass masked reductions (the SequenceAggregators
+analog, utils/.../spark/SequenceAggregators.scala:41); transforms emit dense
+float32 blocks that concatenate into the model matrix.
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ... import types as T
+from ...columns import Column, Dataset, NumericColumn, ObjectColumn, VectorColumn
+from ...features.metadata import (NULL_INDICATOR, OTHER_INDICATOR, VectorColumnMetadata,
+                                  VectorMetadata)
+from ...stages.base import Model, SequenceEstimator, SequenceTransformer, UnaryEstimator
+
+
+def _vector_meta(stage, cols_meta: List[VectorColumnMetadata]) -> VectorMetadata:
+    name = stage.get_outputs()[0].name
+    cols = [VectorColumnMetadata(c.parent_feature_name, c.parent_feature_type, c.grouping,
+                                 c.indicator_value, c.descriptor_value, i)
+            for i, c in enumerate(cols_meta)]
+    return VectorMetadata(name, tuple(cols))
+
+
+# ---------------------------------------------------------------------------
+# Numeric vectorizers
+# ---------------------------------------------------------------------------
+class RealVectorizer(SequenceEstimator):
+    """Real features -> OPVector with mean/constant fill + null tracking."""
+
+    def __init__(self, fill_with_mean: bool = True, fill_value: float = 0.0,
+                 track_nulls: bool = True, uid: Optional[str] = None):
+        super().__init__(operation_name="vecReal", output_type=T.OPVector, uid=uid,
+                         fill_with_mean=fill_with_mean, fill_value=fill_value,
+                         track_nulls=track_nulls)
+
+    def fit_columns(self, cols: Sequence[Column], dataset: Dataset) -> "RealVectorizerModel":
+        fills = []
+        for col in cols:
+            assert isinstance(col, NumericColumn)
+            if self.get_param("fill_with_mean"):
+                n = col.mask.sum()
+                fills.append(float(col.values[col.mask].mean()) if n else 0.0)
+            else:
+                fills.append(float(self.get_param("fill_value")))
+        return RealVectorizerModel(fills=np.asarray(fills, dtype=np.float64),
+                                   track_nulls=bool(self.get_param("track_nulls")),
+                                   operation_name=self.operation_name,
+                                   output_type=self.output_type)
+
+
+class RealVectorizerModel(Model):
+    def __init__(self, fills: np.ndarray, track_nulls: bool, operation_name: str = "vecReal",
+                 output_type=T.OPVector, uid: Optional[str] = None, **kw):
+        super().__init__(operation_name, output_type, uid=uid, **kw)
+        self.fills = np.asarray(fills, dtype=np.float64)
+        self.track_nulls = track_nulls
+
+    def transform_columns(self, cols: Sequence[Column]) -> VectorColumn:
+        blocks, meta = [], []
+        for f, col, fill in zip(self.inputs, cols, self.fills):
+            assert isinstance(col, NumericColumn)
+            vals = np.where(col.mask, col.values, fill).astype(np.float32)
+            blocks.append(vals[:, None])
+            meta.append(VectorColumnMetadata((f.name,), (f.ftype.__name__,)))
+            if self.track_nulls:
+                blocks.append((~col.mask).astype(np.float32)[:, None])
+                meta.append(VectorColumnMetadata((f.name,), (f.ftype.__name__,),
+                                                 indicator_value=NULL_INDICATOR))
+        out = np.concatenate(blocks, axis=1) if blocks else np.zeros((len(cols[0]), 0), np.float32)
+        vm = _vector_meta(self, meta)
+        self.metadata["vector_metadata"] = vm
+        return VectorColumn(T.OPVector, out, vm)
+
+
+class IntegralVectorizer(RealVectorizer):
+    """Integral features -> OPVector with mode/constant fill + null tracking."""
+
+    def __init__(self, fill_with_mode: bool = True, fill_value: float = 0.0,
+                 track_nulls: bool = True, uid: Optional[str] = None):
+        SequenceEstimator.__init__(self, operation_name="vecIntegral",
+                                   output_type=T.OPVector, uid=uid,
+                                   fill_with_mode=fill_with_mode, fill_value=fill_value,
+                                   track_nulls=track_nulls)
+
+    def fit_columns(self, cols: Sequence[Column], dataset: Dataset) -> RealVectorizerModel:
+        fills = []
+        for col in cols:
+            assert isinstance(col, NumericColumn)
+            if self.get_param("fill_with_mode") and col.mask.any():
+                vals, counts = np.unique(col.values[col.mask], return_counts=True)
+                fills.append(float(vals[np.argmax(counts)]))
+            else:
+                fills.append(float(self.get_param("fill_value")))
+        return RealVectorizerModel(fills=np.asarray(fills),
+                                   track_nulls=bool(self.get_param("track_nulls")),
+                                   operation_name=self.operation_name,
+                                   output_type=self.output_type)
+
+
+class BinaryVectorizer(SequenceTransformer):
+    """Binary features -> OPVector: value (false fill) + null indicator."""
+
+    def __init__(self, fill_value: bool = False, track_nulls: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="vecBinary", output_type=T.OPVector, uid=uid,
+                         fill_value=fill_value, track_nulls=track_nulls)
+
+    def transform_columns(self, cols: Sequence[Column]) -> VectorColumn:
+        blocks, meta = [], []
+        fill = float(self.get_param("fill_value", False))
+        track = self.get_param("track_nulls", True)
+        for f, col in zip(self.inputs, cols):
+            assert isinstance(col, NumericColumn)
+            blocks.append(np.where(col.mask, col.values, fill).astype(np.float32)[:, None])
+            meta.append(VectorColumnMetadata((f.name,), (f.ftype.__name__,)))
+            if track:
+                blocks.append((~col.mask).astype(np.float32)[:, None])
+                meta.append(VectorColumnMetadata((f.name,), (f.ftype.__name__,),
+                                                 indicator_value=NULL_INDICATOR))
+        out = np.concatenate(blocks, axis=1)
+        vm = _vector_meta(self, meta)
+        self.metadata["vector_metadata"] = vm
+        return VectorColumn(T.OPVector, out, vm)
+
+
+class RealNNVectorizer(SequenceTransformer):
+    """Non-nullable reals -> OPVector (no fill, no null tracking)."""
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(operation_name="vecRealNN", output_type=T.OPVector, uid=uid)
+
+    def transform_columns(self, cols: Sequence[Column]) -> VectorColumn:
+        blocks = [np.asarray(c.values, dtype=np.float32)[:, None] for c in cols]
+        meta = [VectorColumnMetadata((f.name,), (f.ftype.__name__,)) for f in self.inputs]
+        vm = _vector_meta(self, meta)
+        self.metadata["vector_metadata"] = vm
+        return VectorColumn(T.OPVector, np.concatenate(blocks, axis=1), vm)
+
+
+# ---------------------------------------------------------------------------
+# Categorical pivot (one-hot) vectorizers
+# ---------------------------------------------------------------------------
+class OneHotVectorizer(SequenceEstimator):
+    """TopK/minSupport pivot with OTHER + null columns
+    (OpOneHotVectorizer.scala:61; model :140).
+
+    ``max_pct_cardinality`` guards against exploding pivots
+    (OpOneHotVectorizer.scala:127-131): features whose cardinality exceeds
+    the fraction of rows are not pivoted (all mass to OTHER).
+    """
+
+    def __init__(self, top_k: int = 20, min_support: int = 10, track_nulls: bool = True,
+                 unseen_name: str = OTHER_INDICATOR, max_pct_cardinality: float = 1.0,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="pivot", output_type=T.OPVector, uid=uid,
+                         top_k=top_k, min_support=min_support, track_nulls=track_nulls,
+                         unseen_name=unseen_name, max_pct_cardinality=max_pct_cardinality)
+
+    @staticmethod
+    def _values_of(col: Column, i: int) -> List[str]:
+        if isinstance(col, ObjectColumn):
+            v = col.values[i]
+            if v is None:
+                return []
+            if isinstance(v, (set, frozenset, list, tuple)):
+                return [str(x) for x in v]
+            return [str(v)]
+        assert isinstance(col, NumericColumn)
+        return [str(col.values[i])] if col.mask[i] else []
+
+    def fit_columns(self, cols: Sequence[Column], dataset: Dataset) -> "OneHotVectorizerModel":
+        top_k = int(self.get_param("top_k"))
+        min_support = int(self.get_param("min_support"))
+        max_pct = float(self.get_param("max_pct_cardinality"))
+        categories: List[List[str]] = []
+        for col in cols:
+            n = len(col)
+            counts: Counter = Counter()
+            for i in range(n):
+                counts.update(self._values_of(col, i))
+            if n > 0 and len(counts) > max_pct * n:
+                categories.append([])
+                continue
+            keep = [(c, cnt) for c, cnt in counts.items() if cnt >= min_support]
+            keep.sort(key=lambda t: (-t[1], t[0]))
+            categories.append([c for c, _ in keep[:top_k]])
+        return OneHotVectorizerModel(categories=categories,
+                                     track_nulls=bool(self.get_param("track_nulls")),
+                                     unseen_name=str(self.get_param("unseen_name")),
+                                     operation_name=self.operation_name,
+                                     output_type=self.output_type)
+
+
+class OneHotVectorizerModel(Model):
+    def __init__(self, categories: List[List[str]], track_nulls: bool,
+                 unseen_name: str = OTHER_INDICATOR, operation_name: str = "pivot",
+                 output_type=T.OPVector, uid: Optional[str] = None, **kw):
+        super().__init__(operation_name, output_type, uid=uid, **kw)
+        self.categories = categories
+        self.track_nulls = track_nulls
+        self.unseen_name = unseen_name
+
+    def transform_columns(self, cols: Sequence[Column]) -> VectorColumn:
+        n = len(cols[0])
+        blocks, meta = [], []
+        for f, col, cats in zip(self.inputs, cols, self.categories):
+            index = {c: j for j, c in enumerate(cats)}
+            k = len(cats)
+            block = np.zeros((n, k + (2 if self.track_nulls else 1)), dtype=np.float32)
+            for i in range(n):
+                vals = OneHotVectorizer._values_of(col, i)
+                if not vals:
+                    if self.track_nulls:
+                        block[i, k + 1] = 1.0
+                    continue
+                for v in vals:
+                    j = index.get(v)
+                    if j is None:
+                        block[i, k] = 1.0  # OTHER
+                    else:
+                        block[i, j] = 1.0
+            blocks.append(block)
+            ind = list(cats) + [self.unseen_name] + ([NULL_INDICATOR] if self.track_nulls else [])
+            for v in ind:
+                meta.append(VectorColumnMetadata((f.name,), (f.ftype.__name__,),
+                                                 grouping=None, indicator_value=v))
+        out = np.concatenate(blocks, axis=1) if blocks else np.zeros((n, 0), np.float32)
+        vm = _vector_meta(self, meta)
+        self.metadata["vector_metadata"] = vm
+        return VectorColumn(T.OPVector, out, vm)
+
+
+OpOneHotVectorizer = OneHotVectorizer
+OpSetVectorizer = OneHotVectorizer  # MultiPickList sets pivot through the same path
+
+
+# ---------------------------------------------------------------------------
+# Vector assembly + scaling
+# ---------------------------------------------------------------------------
+class VectorsCombiner(SequenceTransformer):
+    """Concatenate OPVectors, merging metadata (VectorsCombiner.scala:51)."""
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(operation_name="combineVector", output_type=T.OPVector, uid=uid)
+
+    def transform_columns(self, cols: Sequence[Column]) -> VectorColumn:
+        mats, metas = [], []
+        for f, col in zip(self.inputs, cols):
+            assert isinstance(col, VectorColumn), f"VectorsCombiner input {f.name} not a vector"
+            mats.append(col.values)
+            if col.metadata is not None:
+                metas.append(col.metadata)
+            else:
+                metas.append(VectorMetadata(f.name, tuple(
+                    VectorColumnMetadata((f.name,), (f.ftype.__name__,), index=i)
+                    for i in range(col.width))))
+        out = np.concatenate(mats, axis=1)
+        vm = VectorMetadata.flatten(self.get_outputs()[0].name, metas)
+        self.metadata["vector_metadata"] = vm
+        return VectorColumn(T.OPVector, out, vm)
+
+
+class StandardScalerVectorizer(UnaryEstimator):
+    """Standardize an OPVector column (z-score); the OpScalarStandardScaler /
+    Spark StandardScaler analog."""
+
+    def __init__(self, with_mean: bool = True, with_std: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="stdScaler", input_type=T.OPVector,
+                         output_type=T.OPVector, uid=uid,
+                         with_mean=with_mean, with_std=with_std)
+
+    def fit_columns(self, cols: Sequence[Column], dataset: Dataset) -> "StandardScalerModel":
+        col = cols[0]
+        assert isinstance(col, VectorColumn)
+        mean = col.values.mean(axis=0)
+        std = col.values.std(axis=0)
+        std = np.where(std < 1e-12, 1.0, std)
+        return StandardScalerModel(
+            mean=mean if self.get_param("with_mean") else np.zeros_like(mean),
+            std=std if self.get_param("with_std") else np.ones_like(std),
+            operation_name=self.operation_name, output_type=self.output_type)
+
+
+class StandardScalerModel(Model):
+    def __init__(self, mean: np.ndarray, std: np.ndarray, operation_name: str = "stdScaler",
+                 output_type=T.OPVector, uid: Optional[str] = None, **kw):
+        super().__init__(operation_name, output_type, uid=uid, **kw)
+        self.mean = np.asarray(mean, dtype=np.float32)
+        self.std = np.asarray(std, dtype=np.float32)
+
+    def transform_columns(self, cols: Sequence[Column]) -> VectorColumn:
+        col = cols[0]
+        assert isinstance(col, VectorColumn)
+        out = (col.values - self.mean) / self.std
+        vm = col.metadata
+        if vm is not None:
+            vm = VectorMetadata(self.get_outputs()[0].name, vm.columns)
+            self.metadata["vector_metadata"] = vm
+        return VectorColumn(T.OPVector, out.astype(np.float32), vm)

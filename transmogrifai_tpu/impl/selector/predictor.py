@@ -1,0 +1,95 @@
+"""Predictor stage abstraction: (RealNN label, OPVector features) -> Prediction.
+
+Reference parity: ``OpPredictorWrapper`` / ``OpPredictorWrapperModel``
+(stages/sparkwrappers/specific/OpPredictorWrapper.scala:71,121) — the uniform
+contract every model in the selector grid satisfies.  Instead of wrapping
+Spark ``Predictor``s, each concrete predictor implements an *array-level*
+interface:
+
+- ``fit_arrays(X, y, w) -> params`` — a jit'd fixed-shape training function,
+- ``predict_arrays(params, X) -> (prediction, raw, probability)``,
+
+so the ModelSelector's fold × grid sweep can call straight into XLA with no
+per-row or per-stage overhead, and vmap/shard_map over candidates
+(SURVEY §2.7 axis 2).  ``SparkModelConverter.toOP``'s role (turn a fitted
+model into a row transformer) is played by ``PredictorModel`` itself.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Type
+
+import numpy as np
+
+from ... import types as T
+from ...columns import (Column, Dataset, NumericColumn, PredictionColumn, VectorColumn)
+from ...stages.base import AllowLabelAsInput, BinaryEstimator, Model
+
+
+class PredictorEstimator(BinaryEstimator, AllowLabelAsInput):
+    """Base estimator for all selector-grid models."""
+
+    #: classification predictors emit probability/raw columns
+    is_classifier: bool = True
+
+    def __init__(self, operation_name: str, uid: Optional[str] = None, **params):
+        super().__init__(operation_name=operation_name, output_type=T.Prediction,
+                         uid=uid, **params)
+
+    def check_input_types(self, features) -> None:
+        super().check_input_types(features)
+        label, vec = features
+        if not issubclass(vec.ftype, T.OPVector):
+            raise ValueError(f"{type(self).__name__} second input must be OPVector, "
+                             f"got {vec.ftype.__name__}")
+        if not label.is_response:
+            raise ValueError("First input (label) must be a response feature "
+                             "(CheckIsResponseValues analog)")
+
+    # ---- array-level contract ---------------------------------------------
+    def fit_arrays(self, X: np.ndarray, y: np.ndarray,
+                   w: Optional[np.ndarray] = None) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    @classmethod
+    def predict_arrays(cls, params: Dict[str, Any], X: np.ndarray
+                       ) -> Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]:
+        """Returns (prediction[n], raw[n,k]|None, probability[n,k]|None)."""
+        raise NotImplementedError
+
+    # ---- grid support ------------------------------------------------------
+    def copy_with_params(self, overrides: Dict[str, Any]) -> "PredictorEstimator":
+        merged = {**self._params, **overrides}
+        return type(self)(**merged)
+
+    # ---- Dataset-level fit -------------------------------------------------
+    def fit_columns(self, cols: Sequence[Column], dataset: Dataset) -> "PredictorModel":
+        label_col, vec_col = cols
+        assert isinstance(label_col, NumericColumn) and isinstance(vec_col, VectorColumn)
+        X = vec_col.values
+        y = label_col.values.astype(np.float32)
+        if not label_col.mask.all():  # unlabeled rows never train
+            keep = label_col.mask
+            X, y = X[keep], y[keep]
+        params = self.fit_arrays(X, y)
+        return PredictorModel(predictor_class=type(self), model_params=params,
+                              operation_name=self.operation_name)
+
+
+class PredictorModel(Model):
+    """Fitted predictor: applies ``predict_arrays`` to the feature vector."""
+
+    def __init__(self, predictor_class: Type[PredictorEstimator] = PredictorEstimator,
+                 model_params: Optional[Dict[str, Any]] = None,
+                 operation_name: str = "predictor", uid: Optional[str] = None, **kw):
+        super().__init__(operation_name, T.Prediction, uid=uid, **kw)
+        self.predictor_class = predictor_class
+        self.model_params = model_params or {}
+
+    def transform_columns(self, cols: Sequence[Column]) -> PredictionColumn:
+        vec_col = cols[-1]
+        assert isinstance(vec_col, VectorColumn)
+        pred, raw, prob = self.predictor_class.predict_arrays(self.model_params,
+                                                              vec_col.values)
+        return PredictionColumn(T.Prediction, np.asarray(pred, dtype=np.float64),
+                                None if raw is None else np.asarray(raw, np.float64),
+                                None if prob is None else np.asarray(prob, np.float64))

@@ -1,0 +1,301 @@
+"""Linear-model training kernels — jit'd, vmap-able, TPU-first.
+
+The reference trains its linear models through Spark MLlib's breeze
+LBFGS/OWLQN solvers on the JVM (SURVEY §2.6, netlib BLAS).  Here each fit is
+a fixed-iteration, static-shape XLA computation:
+
+- smooth objectives (L2-regularized logistic / softmax / linear / squared
+  hinge) use full-batch Newton or L-BFGS via ``lax`` loops,
+- L1/elastic-net objectives use FISTA proximal gradient,
+- every trainer takes ``(X, y, sample_weight, hyperparams)`` with
+  hyperparameters as traced scalars, so a whole ModelSelector grid vmaps into
+  ONE compiled program and shards over chips (SURVEY §2.7 axis 2 — the
+  north-star speedup: Spark trains the grid as 8 JVM threads, we train it as
+  one batched XLA launch).
+
+All math in float32 (MXU native); reductions accumulate in float32 which is
+ample at tabular scale.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class LinearFit(NamedTuple):
+    """Fitted linear parameters: coefficients [d, k] and intercept [k]."""
+
+    coef: jax.Array
+    intercept: jax.Array
+
+
+def _add_intercept_grad(g_coef, g_int, fit_intercept):
+    return g_coef, jnp.where(fit_intercept, g_int, jnp.zeros_like(g_int))
+
+
+def _soft_threshold(x, thr):
+    return jnp.sign(x) * jnp.maximum(jnp.abs(x) - thr, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Logistic regression (binary, sigmoid) — Newton/IRLS for L2, FISTA for L1.
+# Reference analog: OpLogisticRegression (impl/classification/OpLogisticRegression.scala)
+# wrapping Spark's LogisticRegression (regParam, elasticNetParam, maxIter, tol).
+# ---------------------------------------------------------------------------
+@functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept"))
+def fit_logistic_newton(X, y, sample_weight, l2, max_iter: int = 25,
+                        fit_intercept: bool = True) -> LinearFit:
+    """Weighted binary logistic regression with L2, full-batch Newton.
+
+    X: f32[n, d]; y: f32[n] in {0, 1}; sample_weight: f32[n]; l2: scalar
+    (lambda, matching Spark's regParam with standardization off).
+
+    Iteration count is fixed (static shape for vmap across a grid); there is
+    deliberately no data-dependent convergence break — Newton on these convex
+    objectives converges well inside ``max_iter``.
+    """
+    n, d = X.shape
+    X1 = jnp.concatenate([X, jnp.ones((n, 1), X.dtype)], axis=1) if fit_intercept else X
+    p = X1.shape[1]
+    w_sum = jnp.maximum(sample_weight.sum(), 1e-12)
+
+    reg = jnp.full((p,), l2, X.dtype)
+    if fit_intercept:
+        reg = reg.at[-1].set(0.0)  # intercept not penalized (Spark semantics)
+
+    def newton_step(beta, _):
+        z = X1 @ beta
+        mu = jax.nn.sigmoid(z)
+        wvar = jnp.maximum(mu * (1.0 - mu), 1e-6) * sample_weight
+        grad = X1.T @ (sample_weight * (mu - y)) / w_sum + reg * beta
+        H = (X1.T * wvar) @ X1 / w_sum + jnp.diag(reg) + 1e-8 * jnp.eye(p, dtype=X.dtype)
+        delta = jnp.linalg.solve(H, grad)
+        return beta - delta, None
+
+    beta0 = jnp.zeros((p,), X.dtype)
+    beta, _ = lax.scan(newton_step, beta0, None, length=max_iter)
+    if fit_intercept:
+        return LinearFit(coef=beta[:-1], intercept=beta[-1:])
+    return LinearFit(coef=beta, intercept=jnp.zeros((1,), X.dtype))
+
+
+def _logistic_loss_grad(beta, X1, y, sample_weight, l2_vec, w_sum):
+    z = X1 @ beta
+    mu = jax.nn.sigmoid(z)
+    grad = X1.T @ (sample_weight * (mu - y)) / w_sum + l2_vec * beta
+    return grad
+
+
+@functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept"))
+def fit_logistic_fista(X, y, sample_weight, l1, l2, max_iter: int = 200,
+                       fit_intercept: bool = True) -> LinearFit:
+    """Elastic-net logistic regression via FISTA proximal gradient.
+
+    Matches Spark's (regParam, elasticNetParam) parameterization when called
+    with ``l1 = regParam * alpha``, ``l2 = regParam * (1 - alpha)``.
+    """
+    n, d = X.shape
+    X1 = jnp.concatenate([X, jnp.ones((n, 1), X.dtype)], axis=1) if fit_intercept else X
+    p = X1.shape[1]
+    w_sum = jnp.maximum(sample_weight.sum(), 1e-12)
+    l2_vec = jnp.full((p,), l2, X.dtype)
+    l1_vec = jnp.full((p,), l1, X.dtype)
+    if fit_intercept:
+        l2_vec = l2_vec.at[-1].set(0.0)
+        l1_vec = l1_vec.at[-1].set(0.0)
+    # Lipschitz bound for the logistic loss: ||X||^2/(4*w_sum) weighted
+    L = 0.25 * jnp.sum((X1 * X1).T * sample_weight) / w_sum + l2 + 1e-6
+    step = 1.0 / L
+
+    def body(carry, _):
+        beta, z, t = carry
+        grad = _logistic_loss_grad(z, X1, y, sample_weight, l2_vec, w_sum)
+        beta_next = _soft_threshold(z - step * grad, step * l1_vec)
+        t_next = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
+        z_next = beta_next + ((t - 1.0) / t_next) * (beta_next - beta)
+        return (beta_next, z_next, t_next), None
+
+    beta0 = jnp.zeros((p,), X.dtype)
+    (beta, _, _), _ = lax.scan(body, (beta0, beta0, jnp.array(1.0, X.dtype)), None,
+                               length=max_iter)
+    if fit_intercept:
+        return LinearFit(coef=beta[:-1], intercept=beta[-1:])
+    return LinearFit(coef=beta, intercept=jnp.zeros((1,), X.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Multinomial softmax regression (multiclass LR)
+# ---------------------------------------------------------------------------
+@functools.partial(jax.jit, static_argnames=("num_classes", "max_iter", "fit_intercept"))
+def fit_softmax(X, y, sample_weight, l2, num_classes: int, max_iter: int = 100,
+                fit_intercept: bool = True, l1=0.0) -> LinearFit:
+    """Weighted multinomial logistic regression, elastic net, accelerated
+    proximal gradient (FISTA; soft-threshold prox handles the L1 term).
+    """
+    n, d = X.shape
+    X1 = jnp.concatenate([X, jnp.ones((n, 1), X.dtype)], axis=1) if fit_intercept else X
+    p = X1.shape[1]
+    w_sum = jnp.maximum(sample_weight.sum(), 1e-12)
+    Y = jax.nn.one_hot(y.astype(jnp.int32), num_classes, dtype=X.dtype)
+    l2m = jnp.full((p, num_classes), l2, X.dtype)
+    l1m = jnp.full((p, num_classes), l1, X.dtype)
+    if fit_intercept:
+        l2m = l2m.at[-1, :].set(0.0)
+        l1m = l1m.at[-1, :].set(0.0)
+    L = 0.5 * jnp.sum((X1 * X1).T * sample_weight) / w_sum + l2 + 1e-6
+    step = 1.0 / L
+
+    def grad_fn(B):
+        z = X1 @ B
+        mu = jax.nn.softmax(z, axis=-1)
+        return X1.T @ (sample_weight[:, None] * (mu - Y)) / w_sum + l2m * B
+
+    def body(carry, _):
+        B, Z, t = carry
+        B_next = _soft_threshold(Z - step * grad_fn(Z), step * l1m)
+        t_next = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
+        Z_next = B_next + ((t - 1.0) / t_next) * (B_next - B)
+        return (B_next, Z_next, t_next), None
+
+    B0 = jnp.zeros((p, num_classes), X.dtype)
+    (B, _, _), _ = lax.scan(body, (B0, B0, jnp.array(1.0, X.dtype)), None, length=max_iter)
+    if fit_intercept:
+        return LinearFit(coef=B[:-1], intercept=B[-1])
+    return LinearFit(coef=B, intercept=jnp.zeros((num_classes,), X.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Linear regression — ridge closed form; elastic net via FISTA.
+# Reference analog: OpLinearRegression wrapping Spark LinearRegression ("auto"
+# solver = normal equations for small d, exactly what we do).
+# ---------------------------------------------------------------------------
+@functools.partial(jax.jit, static_argnames=("fit_intercept",))
+def fit_ridge(X, y, sample_weight, l2, fit_intercept: bool = True) -> LinearFit:
+    n, d = X.shape
+    X1 = jnp.concatenate([X, jnp.ones((n, 1), X.dtype)], axis=1) if fit_intercept else X
+    p = X1.shape[1]
+    w_sum = jnp.maximum(sample_weight.sum(), 1e-12)
+    reg = jnp.full((p,), l2, X.dtype)
+    if fit_intercept:
+        reg = reg.at[-1].set(0.0)
+    A = (X1.T * sample_weight) @ X1 / w_sum + jnp.diag(reg) + 1e-9 * jnp.eye(p, dtype=X.dtype)
+    b = X1.T @ (sample_weight * y) / w_sum
+    beta = jnp.linalg.solve(A, b)
+    if fit_intercept:
+        return LinearFit(coef=beta[:-1], intercept=beta[-1:])
+    return LinearFit(coef=beta, intercept=jnp.zeros((1,), X.dtype))
+
+
+@functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept"))
+def fit_linear_fista(X, y, sample_weight, l1, l2, max_iter: int = 300,
+                     fit_intercept: bool = True) -> LinearFit:
+    """Elastic-net linear regression via FISTA (lasso path analog)."""
+    n, d = X.shape
+    X1 = jnp.concatenate([X, jnp.ones((n, 1), X.dtype)], axis=1) if fit_intercept else X
+    p = X1.shape[1]
+    w_sum = jnp.maximum(sample_weight.sum(), 1e-12)
+    l2_vec = jnp.full((p,), l2, X.dtype)
+    l1_vec = jnp.full((p,), l1, X.dtype)
+    if fit_intercept:
+        l2_vec = l2_vec.at[-1].set(0.0)
+        l1_vec = l1_vec.at[-1].set(0.0)
+    # Lipschitz: largest eigenvalue of weighted gram; bound by trace
+    L = jnp.sum((X1 * X1).T * sample_weight) / w_sum + l2 + 1e-6
+    step = 1.0 / L
+
+    def grad_fn(beta):
+        r = X1 @ beta - y
+        return X1.T @ (sample_weight * r) / w_sum + l2_vec * beta
+
+    def body(carry, _):
+        beta, z, t = carry
+        beta_next = _soft_threshold(z - step * grad_fn(z), step * l1_vec)
+        t_next = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
+        z_next = beta_next + ((t - 1.0) / t_next) * (beta_next - beta)
+        return (beta_next, z_next, t_next), None
+
+    beta0 = jnp.zeros((p,), X.dtype)
+    (beta, _, _), _ = lax.scan(body, (beta0, beta0, jnp.array(1.0, X.dtype)), None,
+                               length=max_iter)
+    if fit_intercept:
+        return LinearFit(coef=beta[:-1], intercept=beta[-1:])
+    return LinearFit(coef=beta, intercept=jnp.zeros((1,), X.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Linear SVC — squared-hinge + L2 (smooth), Nesterov accelerated GD.
+# Reference analog: OpLinearSVC wrapping Spark LinearSVC (hinge + OWLQN);
+# squared hinge is the standard smooth surrogate (liblinear L2-loss SVC).
+# ---------------------------------------------------------------------------
+@functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept"))
+def fit_linear_svc(X, y, sample_weight, l2, max_iter: int = 200,
+                   fit_intercept: bool = True) -> LinearFit:
+    n, d = X.shape
+    X1 = jnp.concatenate([X, jnp.ones((n, 1), X.dtype)], axis=1) if fit_intercept else X
+    p = X1.shape[1]
+    w_sum = jnp.maximum(sample_weight.sum(), 1e-12)
+    ypm = 2.0 * y - 1.0  # {0,1} -> {-1,+1}
+    l2_vec = jnp.full((p,), l2, X.dtype)
+    if fit_intercept:
+        l2_vec = l2_vec.at[-1].set(0.0)
+    L = 2.0 * jnp.sum((X1 * X1).T * sample_weight) / w_sum + l2 + 1e-6
+    step = 1.0 / L
+
+    def grad_fn(beta):
+        m = 1.0 - ypm * (X1 @ beta)
+        active = jnp.maximum(m, 0.0)
+        return X1.T @ (sample_weight * (-2.0 * ypm * active)) / w_sum + l2_vec * beta
+
+    def body(carry, _):
+        beta, z, t = carry
+        beta_next = z - step * grad_fn(z)
+        t_next = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
+        z_next = beta_next + ((t - 1.0) / t_next) * (beta_next - beta)
+        return (beta_next, z_next, t_next), None
+
+    beta0 = jnp.zeros((p,), X.dtype)
+    (beta, _, _), _ = lax.scan(body, (beta0, beta0, jnp.array(1.0, X.dtype)), None,
+                               length=max_iter)
+    if fit_intercept:
+        return LinearFit(coef=beta[:-1], intercept=beta[-1:])
+    return LinearFit(coef=beta, intercept=jnp.zeros((1,), X.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Prediction kernels
+# ---------------------------------------------------------------------------
+@jax.jit
+def predict_binary_logistic(X, coef, intercept):
+    """Returns (raw [n,2], prob [n,2], pred [n]) matching the reference's
+    Prediction schema (rawPrediction_*, probability_*, prediction)."""
+    z = X @ coef + intercept[0]
+    p1 = jax.nn.sigmoid(z)
+    raw = jnp.stack([-z, z], axis=-1)
+    prob = jnp.stack([1.0 - p1, p1], axis=-1)
+    pred = (p1 >= 0.5).astype(jnp.float32)
+    return raw, prob, pred
+
+
+@jax.jit
+def predict_softmax(X, coef, intercept):
+    z = X @ coef + intercept
+    prob = jax.nn.softmax(z, axis=-1)
+    pred = jnp.argmax(z, axis=-1).astype(jnp.float32)
+    return z, prob, pred
+
+
+@jax.jit
+def predict_linear(X, coef, intercept):
+    return X @ coef + intercept[0]
+
+
+@jax.jit
+def predict_svc(X, coef, intercept):
+    z = X @ coef + intercept[0]
+    raw = jnp.stack([-z, z], axis=-1)
+    pred = (z >= 0.0).astype(jnp.float32)
+    return raw, pred

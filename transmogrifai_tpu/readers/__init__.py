@@ -1,0 +1,55 @@
+"""Data ingestion (reference readers/ module).
+
+Factory surface mirrors ``DataReaders.Simple.* / Aggregate.* / Conditional.*``
+(readers/.../DataReaders.scala:44).
+"""
+from .base import (
+    AggregateDataReader,
+    ConditionalDataReader,
+    CustomReader,
+    DataReader,
+    Reader,
+)
+from .files import (
+    AggregateAvroReader,
+    AggregateCSVReader,
+    AggregateParquetReader,
+    AvroReader,
+    ConditionalAvroReader,
+    ConditionalCSVReader,
+    ConditionalParquetReader,
+    CSVAutoReader,
+    CSVProductReader,
+    CSVReader,
+    ParquetProductReader,
+    ParquetReader,
+)
+from .joined import JoinedReader, StreamingReader
+
+
+class DataReaders:
+    """Factory namespace (DataReaders.scala:44)."""
+
+    class Simple:
+        csv = CSVReader
+        csv_auto = CSVAutoReader
+        csv_product = CSVProductReader
+        avro = AvroReader
+        parquet = ParquetReader
+        custom = CustomReader
+
+    class Aggregate:
+        csv = AggregateCSVReader
+        avro = AggregateAvroReader
+        parquet = AggregateParquetReader
+
+    class Conditional:
+        csv = ConditionalCSVReader
+        avro = ConditionalAvroReader
+        parquet = ConditionalParquetReader
+
+    class Streaming:
+        custom = StreamingReader
+
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,131 @@
+"""File-format readers: CSV / Parquet / Avro (+ aggregate/conditional variants).
+
+Reference parity: readers/.../{CSVReaders,AvroReaders,ParquetProductReader,
+CSVProductReaders}.scala.  CSV comes in schema'd (``CSVReader`` — explicit
+column names, the Avro-schema'd analog), header-inferring (``CSVAutoReader``)
+and typed-record (``CSVProductReader``) flavors.  Parquet rides pyarrow.
+Avro support is gated on an avro library being importable (fastavro is not in
+the image; the reader raises a clear error if used without one).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+from .base import AggregateDataReader, ConditionalDataReader, DataReader
+
+
+class CSVReader(DataReader):
+    """Schema'd CSV without header (CSVReaders.scala:54)."""
+
+    def __init__(self, path: str, schema: Sequence[str],
+                 key: Union[str, Callable, None] = None, **read_kwargs):
+        super().__init__(key=key)
+        self.path = path
+        self.schema = list(schema)
+        self.read_kwargs = read_kwargs
+
+    def read(self, params: Optional[Dict[str, Any]] = None):
+        import pandas as pd
+
+        path = (params or {}).get("path", self.path)
+        return pd.read_csv(path, header=None, names=self.schema, **self.read_kwargs)
+
+
+class CSVAutoReader(DataReader):
+    """Header-inferring CSV (CSVReaders.scala CSVAutoReader)."""
+
+    def __init__(self, path: str, key: Union[str, Callable, None] = None, **read_kwargs):
+        super().__init__(key=key)
+        self.path = path
+        self.read_kwargs = read_kwargs
+
+    def read(self, params: Optional[Dict[str, Any]] = None):
+        import pandas as pd
+
+        path = (params or {}).get("path", self.path)
+        return pd.read_csv(path, **self.read_kwargs)
+
+
+class CSVProductReader(CSVAutoReader):
+    """Typed-record CSV (CSVProductReaders.scala:49) — with pandas the record
+    type is the column schema itself; kept as a named alias for API parity."""
+
+
+class ParquetReader(DataReader):
+    """Parquet via pyarrow (ParquetProductReader.scala:47)."""
+
+    def __init__(self, path: str, key: Union[str, Callable, None] = None):
+        super().__init__(key=key)
+        self.path = path
+
+    def read(self, params: Optional[Dict[str, Any]] = None):
+        import pandas as pd
+
+        path = (params or {}).get("path", self.path)
+        return pd.read_parquet(path)
+
+
+ParquetProductReader = ParquetReader
+
+
+class AvroReader(DataReader):
+    """Avro records (AvroReaders.scala:55) — requires an avro codec library."""
+
+    def __init__(self, path: str, key: Union[str, Callable, None] = None):
+        super().__init__(key=key)
+        self.path = path
+
+    def read(self, params: Optional[Dict[str, Any]] = None):
+        path = (params or {}).get("path", self.path)
+        try:
+            import fastavro  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "AvroReader requires the 'fastavro' package, which is not "
+                "installed in this environment. Convert the data to CSV/Parquet "
+                "or install fastavro.") from e
+        with open(path, "rb") as fh:
+            return list(fastavro.reader(fh))
+
+
+def _with_aggregate(reader_cls):
+    """Build an Aggregate variant of a simple reader class."""
+
+    class _Agg(AggregateDataReader):
+        def __init__(self, path_or_args, key, time_fn, cutoff_time_ms, **kw):
+            AggregateDataReader.__init__(self, key=key, time_fn=time_fn,
+                                         cutoff_time_ms=cutoff_time_ms)
+            self._inner = reader_cls(path_or_args, key=key, **kw) \
+                if not isinstance(path_or_args, dict) else reader_cls(**path_or_args)
+
+        def read(self, params=None):
+            return self._inner.read(params)
+
+    _Agg.__name__ = f"Aggregate{reader_cls.__name__}"
+    return _Agg
+
+
+def _with_conditional(reader_cls):
+    class _Cond(ConditionalDataReader):
+        def __init__(self, path_or_args, key, time_fn, condition, **kw):
+            extra = {k: kw.pop(k) for k in
+                     ("drop_if_no_condition", "response_window_ms", "predictor_window_ms")
+                     if k in kw}
+            ConditionalDataReader.__init__(self, key=key, time_fn=time_fn,
+                                           condition=condition, **extra)
+            self._inner = reader_cls(path_or_args, key=key, **kw) \
+                if not isinstance(path_or_args, dict) else reader_cls(**path_or_args)
+
+        def read(self, params=None):
+            return self._inner.read(params)
+
+    _Cond.__name__ = f"Conditional{reader_cls.__name__}"
+    return _Cond
+
+
+AggregateCSVReader = _with_aggregate(CSVAutoReader)
+AggregateParquetReader = _with_aggregate(ParquetReader)
+AggregateAvroReader = _with_aggregate(AvroReader)
+ConditionalCSVReader = _with_conditional(CSVAutoReader)
+ConditionalParquetReader = _with_conditional(ParquetReader)
+ConditionalAvroReader = _with_conditional(AvroReader)

@@ -1,0 +1,175 @@
+"""DAG computation and layered fitting — the FitStagesUtil analog.
+
+Reference parity: core/.../utils/stages/FitStagesUtil.scala:51 —
+
+- ``compute_dag``: stages grouped into antichain layers by max distance from
+  the result features (:173-198),
+- ``fit_and_transform_dag``: fold over layers fitting estimators then
+  transforming train (+test) (:212),
+- a whole layer's transformers are applied as one fused pass (:96 —
+  applyOpTransformations fuses the layer's row closures into ONE rdd.map;
+  here the layer's pure batch functions execute back-to-back on columnar
+  data and everything dense runs inside XLA),
+- ``cut_dag``: split the DAG into before/during/after the ModelSelector for
+  leakage-free workflow-level CV (:302, at most one ModelSelector :310).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..columns import Dataset
+from ..features.feature import Feature
+from ..features.generator import FeatureGeneratorStage
+from ..stages.base import Estimator, Model, PipelineStage, Transformer
+
+Layer = List[PipelineStage]
+
+
+def compute_dag(result_features: Sequence[Feature]) -> List[Layer]:
+    """Stages layered by max distance from the results, farthest first.
+
+    Raw-feature origin stages (FeatureGeneratorStage) are excluded — their
+    work happens at read time (reference excludes them the same way:
+    FitStagesUtil.computeDAG filters to OPStage estimators/transformers).
+    """
+    dist: Dict[str, int] = {}
+    stages: Dict[str, PipelineStage] = {}
+    for rf in result_features:
+        for stage, d in rf.parent_stages().items():
+            if isinstance(stage, FeatureGeneratorStage):
+                continue
+            if stage.uid not in dist or dist[stage.uid] < d:
+                dist[stage.uid] = d
+                stages[stage.uid] = stage
+    if not dist:
+        return []
+    by_layer: Dict[int, Layer] = {}
+    for uid, d in dist.items():
+        by_layer.setdefault(d, []).append(stages[uid])
+    # farthest from result first; deterministic order within a layer
+    return [sorted(by_layer[d], key=lambda s: s.uid)
+            for d in sorted(by_layer, reverse=True)]
+
+
+@dataclass
+class FittedDAG:
+    """Result of fit_and_transform_dag (FitStagesUtil.FittedDAG)."""
+
+    train: Dataset
+    test: Optional[Dataset]
+    fitted_stages: List[PipelineStage]
+
+
+def _apply_layer_transforms(ds: Dataset, transformers: Sequence[Transformer]) -> Dataset:
+    """Fused layer transform: all outputs computed off the same input batch,
+    then appended at once (applyOpTransformations analog)."""
+    new_cols = {}
+    for t in transformers:
+        out_feats = t.get_outputs()
+        col = t.transform_dataset(ds)
+        if t.n_outputs == 1:
+            new_cols[out_feats[0].name] = col
+        else:
+            for f, c in zip(out_feats, col):
+                new_cols[f.name] = c
+    return ds.with_columns(new_cols)
+
+
+def fit_and_transform_dag(dag: List[Layer], train: Dataset,
+                          test: Optional[Dataset] = None,
+                          fitted_so_far: Optional[Dict[str, PipelineStage]] = None,
+                          ) -> FittedDAG:
+    """Fit estimators layer by layer, transforming train (+test) as we go.
+
+    ``fitted_so_far`` maps stage uid -> already-fitted model — the analog of
+    ``OpWorkflow.withModelStages`` warm-starting (OpWorkflow.scala:468): those
+    stages are applied, not refitted.
+    """
+    fitted_so_far = fitted_so_far or {}
+    fitted: List[PipelineStage] = []
+    for layer in dag:
+        transformers: List[Transformer] = []
+        for stage in layer:
+            if stage.uid in fitted_so_far:
+                model = fitted_so_far[stage.uid]
+                transformers.append(model)
+                fitted.append(model)
+            elif isinstance(stage, Estimator):
+                model = stage.fit(train)
+                transformers.append(model)
+                fitted.append(model)
+            elif isinstance(stage, Transformer):
+                transformers.append(stage)
+                fitted.append(stage)
+            else:
+                raise TypeError(f"Stage {stage} is neither Estimator nor Transformer")
+        train = _apply_layer_transforms(train, transformers)
+        if test is not None:
+            test = _apply_layer_transforms(test, transformers)
+    return FittedDAG(train=train, test=test, fitted_stages=fitted)
+
+
+def apply_transformations_dag(ds: Dataset, dag: List[Layer]) -> Dataset:
+    """Scoring path: all stages must already be transformers
+    (OpWorkflowCore.applyTransformationsDAG, OpWorkflowCore.scala:324)."""
+    for layer in dag:
+        transformers = []
+        for stage in layer:
+            if not isinstance(stage, Transformer):
+                raise TypeError(
+                    f"Scoring DAG contains unfitted estimator {stage}; fit the workflow first")
+            transformers.append(stage)
+        ds = _apply_layer_transforms(ds, transformers)
+    return ds
+
+
+@dataclass
+class CutDAG:
+    """DAG split around the ModelSelector (FitStagesUtil.CutDAG)."""
+
+    model_selector: Optional[PipelineStage]
+    before: List[Layer]
+    during: List[Layer]
+    after: List[Layer]
+
+
+def cut_dag(dag: List[Layer]) -> CutDAG:
+    """Split for workflow-level CV (FitStagesUtil.cutDAG:302): everything at
+    distances > the selector's layer is 'before' (fit once), the selector's
+    ancestors within closer layers form 'during' (refit per fold), the rest
+    'after'.  At most one ModelSelector allowed (:310)."""
+    selectors = [(i, s) for i, layer in enumerate(dag) for s in layer
+                 if getattr(s, "is_model_selector", False)]
+    if not selectors:
+        return CutDAG(None, before=dag, during=[], after=[])
+    if len(selectors) > 1:
+        raise ValueError(
+            f"Only one ModelSelector is supported per workflow, found {len(selectors)}")
+    idx, selector = selectors[0]
+    # ancestors of the selector (stages its inputs depend on)
+    ancestor_uids: Set[str] = set()
+    for f in selector.inputs:
+        for st in f.parent_stages():
+            ancestor_uids.add(st.uid)
+    before: List[Layer] = []
+    during: List[Layer] = []
+    after: List[Layer] = []
+    for i, layer in enumerate(dag):
+        if i < idx:
+            # estimator ancestors of the selector refit per fold; pure
+            # transformers and non-ancestors fit/apply once up front
+            dur = [s for s in layer if s.uid in ancestor_uids and isinstance(s, Estimator)]
+            bef = [s for s in layer if s not in dur]
+            if bef:
+                before.append(bef)
+            if dur:
+                during.append(dur)
+        elif i == idx:
+            rest = [s for s in layer if s is not selector]
+            if rest:
+                after.append(rest)
+            during.append([selector])
+        else:
+            after.append(list(layer))
+    return CutDAG(selector, before=before, during=during, after=after)

@@ -1,0 +1,160 @@
+"""OpWorkflowModel — the fitted workflow.
+
+Reference parity: core/src/main/scala/com/salesforce/op/OpWorkflowModel.scala:60 —
+``score()`` (:261), ``scoreAndEvaluate`` (:298), ``evaluate`` (:326),
+``scoreFn`` (:333 — precompute the DAG once, return a reusable scoring
+function), ``modelInsights`` (:167), ``summary()/summaryPretty`` (:199,209),
+``save`` (:224).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..columns import Dataset, KEY_FIELD
+from ..features.feature import Feature
+from ..stages.base import Model, PipelineStage, Transformer
+from . import dag as dag_util
+from .workflow import OpWorkflowCore
+
+
+class OpWorkflowModel(OpWorkflowCore):
+    """Fitted workflow: every estimator replaced by its fitted model."""
+
+    def __init__(self):
+        super().__init__()
+        self.rff_results = None
+        self.train_data: Optional[Dataset] = None  # transformed training data
+
+    # ---- scoring (OpWorkflowModel.scala:261,333) ---------------------------
+    def score_fn(self) -> Callable[[Dataset], Dataset]:
+        """Precompute the scoring DAG once; returns dataset -> scored dataset."""
+        dag = self.dag
+
+        def fn(raw: Dataset) -> Dataset:
+            full = dag_util.apply_transformations_dag(raw, dag)
+            names = [f.name for f in self.result_features]
+            out = full.select([n for n in names if n in full.columns])
+            return out
+
+        return fn
+
+    def score(self, data: Any = None, params: Optional[Dict[str, Any]] = None,
+              keep_raw_features: bool = False,
+              keep_intermediate_features: bool = False) -> Dataset:
+        """Score a dataset (defaults: KeepRawFeatures=false,
+        KeepIntermediateFeatures=false — OpWorkflowModel.scala:458-463)."""
+        raw = self._raw_for_scoring(data, params)
+        full = dag_util.apply_transformations_dag(raw, self.dag)
+        names = [f.name for f in self.result_features]
+        if keep_intermediate_features:
+            keep = full.column_names()
+        elif keep_raw_features:
+            keep = [f.name for f in self.raw_features if f.name in full.columns] + \
+                   [n for n in names if n in full.columns]
+        else:
+            keep = [n for n in names if n in full.columns]
+        return full.select(dict.fromkeys(keep))
+
+    def _raw_for_scoring(self, data: Any, params: Optional[Dict[str, Any]]) -> Dataset:
+        if isinstance(data, Dataset):
+            return data
+        if data is not None:
+            from ..readers.base import CustomReader
+
+            key = getattr(self.reader, "key", None)
+            return CustomReader(data, key=key).generate_dataset(self.raw_features, params)
+        return self._generate_raw_data(params)
+
+    def score_and_evaluate(self, evaluator, data: Any = None,
+                           params: Optional[Dict[str, Any]] = None
+                           ) -> Tuple[Dataset, Dict[str, float]]:
+        """OpWorkflowModel.scala:298."""
+        raw = self._raw_for_scoring(data, params)
+        full = dag_util.apply_transformations_dag(raw, self.dag)
+        scores = full.select([f.name for f in self.result_features if f.name in full.columns])
+        metrics = self._evaluate_on(evaluator, full)
+        return scores, metrics
+
+    def evaluate(self, evaluator, data: Any = None,
+                 params: Optional[Dict[str, Any]] = None) -> Dict[str, float]:
+        """OpWorkflowModel.scala:326."""
+        raw = self._raw_for_scoring(data, params)
+        full = dag_util.apply_transformations_dag(raw, self.dag)
+        return self._evaluate_on(evaluator, full)
+
+    def _evaluate_on(self, evaluator, full: Dataset) -> Dict[str, float]:
+        label = next((f for f in self.result_features + self.raw_features if f.is_response),
+                     None)
+        pred = next((f for f in self.result_features if not f.is_response), None)
+        label_name = evaluator.label_col or (label.name if label else None)
+        pred_name = evaluator.prediction_col or (pred.name if pred else None)
+        return evaluator.evaluate_all(full, label_col=label_name, prediction_col=pred_name)
+
+    # ---- introspection -----------------------------------------------------
+    def get_origin_stage_of(self, feature: Feature) -> PipelineStage:
+        by_uid = {s.uid: s for s in self.stages}
+        return by_uid.get(feature.origin_stage.uid, feature.origin_stage)
+
+    def get_update_stage_of(self, name: str) -> Optional[PipelineStage]:
+        for s in self.stages:
+            for f in s.get_outputs():
+                if f.name == name:
+                    return s
+        return None
+
+    def summary(self) -> Dict[str, Any]:
+        """Aggregated per-stage summary metadata (OpWorkflowModel.scala:187-199)."""
+        out: Dict[str, Any] = {}
+        for s in self.stages:
+            if s.metadata:
+                out[s.uid] = _jsonable(s.metadata)
+        return out
+
+    def summary_json(self) -> str:
+        return json.dumps(self.summary(), indent=2, default=str)
+
+    def summary_pretty(self) -> str:
+        """Human-readable training summary (OpWorkflowModel.summaryPretty:209)."""
+        from ..impl.insights.model_insights import ModelInsights
+
+        return ModelInsights.extract_from_stages(self).pretty_print()
+
+    def model_insights(self, feature: Optional[Feature] = None):
+        """OpWorkflowModel.scala:167."""
+        from ..impl.insights.model_insights import ModelInsights
+
+        return ModelInsights.extract_from_stages(self, feature)
+
+    # ---- persistence (OpWorkflowModel.scala:224) ---------------------------
+    def save(self, path: str, overwrite: bool = True) -> None:
+        from .serialization import save_model
+
+        save_model(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "OpWorkflowModel":
+        from .serialization import load_model
+
+        return load_model(path)
+
+
+def load_model(path: str) -> OpWorkflowModel:
+    """Module-level loader (OpWorkflow.loadModel analog, OpWorkflow.scala:483)."""
+    return OpWorkflowModel.load(path)
+
+
+def _jsonable(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, (np.floating, np.integer, np.bool_)):
+        return obj.item()
+    if hasattr(obj, "to_json"):
+        return obj.to_json()
+    return obj
