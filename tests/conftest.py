@@ -7,10 +7,16 @@ Our analog: JAX on a virtual 8-device CPU mesh —
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+# The axon sitecustomize force-sets jax_platforms="axon,cpu" at interpreter
+# start (before this conftest runs); flip back to the virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pandas as pd

@@ -1,0 +1,26 @@
+"""Driver entry-point contract: entry() compiles; dryrun_multichip runs on a
+virtual 8-device CPU mesh (the local[2] analog, SURVEY §4)."""
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import pytest
+
+
+def test_entry_compiles():
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    pred, prob = jax.tree.map(lambda x: x.block_until_ready(), out)
+    assert pred.shape == (256,)
+    assert prob.shape == (256, 2)
+
+
+def test_dryrun_multichip_8():
+    import __graft_entry__ as g
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    g.dryrun_multichip(8)

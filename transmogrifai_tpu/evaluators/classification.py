@@ -177,18 +177,26 @@ class OpMultiClassificationEvaluator(OpMultiClassificationEvaluatorBase):
             # labels outside the model's class range never rank (rank = n_classes)
             correct_rank = np.where(found.any(axis=1), np.argmax(found, axis=1),
                                     probability.shape[1])
-            topk: Dict[str, Any] = {}
+            correct_counts: Dict[str, Any] = {}
+            incorrect_counts: Dict[str, Any] = {}
+            no_pred_counts = []
+            for t in self.thresholds:
+                no_pred_counts.append(int((conf < t).sum()))
             for k in self.top_ns:
-                correct_by_thr = []
+                cc, ic = [], []
                 for t in self.thresholds:
                     m = conf >= t
-                    correct = float(((correct_rank < k) & m).sum())
-                    correct_by_thr.append(correct / n)
-                topk[str(k)] = correct_by_thr
+                    correct = int(((correct_rank < k) & m).sum())
+                    cc.append(correct)
+                    ic.append(int(m.sum()) - correct)
+                correct_counts[str(k)] = cc
+                incorrect_counts[str(k)] = ic
             out["ThresholdMetrics"] = {
                 "topNs": self.top_ns,
                 "thresholds": self.thresholds.tolist(),
-                "correctCounts": topk,
+                "correctCounts": correct_counts,
+                "incorrectCounts": incorrect_counts,
+                "noPredictionCounts": no_pred_counts,
             }
         return out
 

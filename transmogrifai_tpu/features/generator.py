@@ -42,6 +42,12 @@ class FieldExtractor(Extractor):
             raw = getattr(record, self.field_name, None)
         if isinstance(raw, float) and raw != raw:  # NaN -> missing
             raw = None
+        if raw is None:
+            # Missing field: fall back to the type default so scoring data
+            # without e.g. the label column still flows (the reference scores
+            # unlabeled data the same way — nullable-everywhere semantics;
+            # RealNN default is 0.0 and evaluators mask unlabeled rows).
+            return T.default_of(self.ftype)
         return T.make(self.ftype, raw)
 
     @property

@@ -1,0 +1,76 @@
+"""Device-mesh utilities — the distributed substrate.
+
+The reference's "communication backend" is Spark shuffle/broadcast/driver RPC
+(SURVEY §2.8: reduceByKey in SanityChecker.scala:272, treeAggregate under
+Statistics.colStats, MLUtils.kFold).  The TPU-native replacement is a
+`jax.sharding.Mesh` with named axes and XLA collectives over ICI:
+
+- axis ``"data"``  — rows sharded across chips (Spark's RDD partitioning
+  analog); statistics are psum/all-gather reductions,
+- axis ``"model"`` — model-grid candidates sharded across chips (the analog
+  of OpValidator's 8-thread JVM pool, OpValidator.scala:373-380); each chip
+  trains its slice of the fold x grid sweep with no communication at all.
+
+Multi-host: `jax.distributed.initialize()` extends the same mesh over DCN —
+the code below is agnostic to how many processes back the device list.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(n_data: Optional[int] = None, n_model: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a 2-D (data, model) mesh over the available devices.
+
+    With ``n_data=None`` all remaining devices go to the data axis.  A single
+    real TPU chip yields a 1x1 mesh — the same program runs unchanged (XLA
+    elides the collectives), which is how the reference runs Spark local-mode
+    as its test backend (TestSparkContext.scala:50).
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if n_data is None:
+        n_data = max(len(devs) // max(n_model, 1), 1)
+    n = n_data * n_model
+    if n > len(devs):
+        raise ValueError(f"mesh {n_data}x{n_model} needs {n} devices, have {len(devs)}")
+    grid = np.array(devs[:n]).reshape(n_data, n_model)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Rows sharded over the data axis; feature dim replicated."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def model_sharding(mesh: Mesh) -> NamedSharding:
+    """Grid candidates sharded over the model axis."""
+    return NamedSharding(mesh, P(MODEL_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(x: np.ndarray, multiple: int, axis: int = 0,
+                    fill: float = 0.0) -> Tuple[np.ndarray, int]:
+    """Pad ``axis`` up to a multiple so shards divide evenly (static shapes).
+
+    Returns (padded, original_length).  Callers mask out padding in
+    reductions — the moral equivalent of Spark's uneven final partition.
+    """
+    n = x.shape[axis]
+    rem = (-n) % multiple
+    if rem == 0:
+        return x, n
+    pad_widths = [(0, 0)] * x.ndim
+    pad_widths[axis] = (0, rem)
+    return np.pad(x, pad_widths, constant_values=fill), n
