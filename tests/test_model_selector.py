@@ -1,0 +1,158 @@
+"""ModelSelector / validators / splitters tests.
+
+Reference analogs: ModelSelectorTest, OpCrossValidationTest, DataBalancerTest,
+DataCutterTest (core/src/test/.../impl/{selector,tuning}/)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.columns import Dataset, NumericColumn, VectorColumn
+from transmogrifai_tpu.evaluators import (OpBinaryClassificationEvaluator,
+                                          OpRegressionEvaluator)
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.impl.classification.logistic import OpLogisticRegression
+from transmogrifai_tpu.impl.classification.svc import OpLinearSVC
+from transmogrifai_tpu.impl.regression.linear import OpLinearRegression
+from transmogrifai_tpu.impl.selector.model_selector import ModelSelector, SelectedModel
+from transmogrifai_tpu.impl.tuning.splitters import (DataBalancer, DataCutter,
+                                                     DataSplitter, Splitter)
+from transmogrifai_tpu.impl.tuning.validators import (OpCrossValidation,
+                                                      OpTrainValidationSplit)
+
+
+def _binary_data(n=400, d=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    beta = rng.standard_normal(d)
+    y = (X @ beta + 0.3 * rng.standard_normal(n) > 0).astype(np.float32)
+    return X, y
+
+
+def _selector_inputs(X, y):
+    label = FeatureBuilder("label", T.RealNN).extract(field="label").as_response()
+    vec = FeatureBuilder("features", T.OPVector).extract(field="features").as_predictor()
+    ds = Dataset({
+        "label": NumericColumn(T.RealNN, y.astype(np.float64), np.ones(len(y), bool)),
+        "features": VectorColumn(T.OPVector, X),
+    })
+    return label, vec, ds
+
+
+def test_cross_validation_selects_reasonable_model():
+    X, y = _binary_data()
+    label, vec, ds = _selector_inputs(X, y)
+    cands = [
+        (OpLogisticRegression(), [{"reg_param": r, "elastic_net_param": a}
+                                  for r in (0.0, 0.01, 0.1) for a in (0.0, 0.5)]),
+        (OpLinearSVC(), [{"reg_param": r} for r in (0.01, 0.1)]),
+    ]
+    sel = ModelSelector(
+        validator=OpCrossValidation(OpBinaryClassificationEvaluator(), num_folds=3,
+                                    stratify=True),
+        splitter=DataBalancer(sample_fraction=0.1, reserve_test_fraction=0.1),
+        models=cands,
+    ).set_input(label, vec)
+    model = sel.fit(ds)
+    assert isinstance(model, SelectedModel)
+    s = model.summary
+    assert s is not None
+    assert len(s.validation_results) == 8
+    assert s.holdout_evaluation is not None
+    assert s.train_evaluation["AuROC"] > 0.85
+    # scoring path
+    out = model.transform_dataset(ds)
+    assert len(out) == len(ds)
+    acc = (out.prediction == y).mean()
+    assert acc > 0.8
+
+
+def test_batched_and_loop_paths_agree():
+    X, y = _binary_data(n=300)
+    ev = OpBinaryClassificationEvaluator()
+    grids = [{"reg_param": r, "elastic_net_param": 0.0} for r in (0.001, 0.1)]
+    est = OpLogisticRegression()
+    cv = OpCrossValidation(ev, num_folds=3, stratify=True)
+    batched = cv.validate([(est, grids)], X, y)
+
+    class NoBatch(OpLogisticRegression):
+        def fit_grid_folds(self, *a, **k):
+            raise NotImplementedError
+
+    loop = cv.validate([(NoBatch(), grids)], X, y)
+    for rb, rl in zip(batched.results, loop.results):
+        assert rb.metric_value == pytest.approx(rl.metric_value, abs=2e-2)
+
+
+def test_train_validation_split_and_failed_model_tolerated():
+    X, y = _binary_data(n=200)
+
+    class Exploding(OpLogisticRegression):
+        def fit_grid_folds(self, *a, **k):
+            raise NotImplementedError
+
+        def fit_arrays(self, *a, **k):
+            raise RuntimeError("boom")
+
+    ev = OpBinaryClassificationEvaluator()
+    tvs = OpTrainValidationSplit(ev, train_ratio=0.75)
+    summary = tvs.validate([(Exploding(), [{}]),
+                            (OpLogisticRegression(), [{"reg_param": 0.01}])], X, y)
+    assert summary.results[0].error is not None
+    assert summary.best.model_name == "OpLogisticRegression"
+    with pytest.raises(RuntimeError):
+        tvs.validate([(Exploding(), [{}])], X, y)
+
+
+def test_regression_selector():
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((300, 5)).astype(np.float32)
+    beta = rng.standard_normal(5)
+    y = (X @ beta + 0.1 * rng.standard_normal(300)).astype(np.float32)
+    label, vec, ds = _selector_inputs(X, y)
+    sel = ModelSelector(
+        validator=OpCrossValidation(OpRegressionEvaluator(), num_folds=3),
+        splitter=DataSplitter(reserve_test_fraction=0.1),
+        models=[(OpLinearRegression(),
+                 [{"reg_param": r} for r in (0.0, 0.01, 0.1)])],
+    ).set_input(label, vec)
+    model = sel.fit(ds)
+    assert model.summary.train_evaluation["R2"] > 0.9
+
+
+def test_data_balancer_proportions():
+    rng = np.random.default_rng(2)
+    y = (rng.random(1000) < 0.03).astype(np.float32)  # 3% positives
+    b = DataBalancer(sample_fraction=0.1)
+    b.pre_validation_prepare(y)
+    w = b.prepare_weights(y)
+    pos_mass = w[y == 1].sum()
+    assert pos_mass / w.sum() == pytest.approx(0.1, rel=0.05)
+    idx = b.prepare_indices(y)
+    yb = y[idx]
+    assert (yb == 1).mean() == pytest.approx(0.1, rel=0.15)
+    # already balanced: no-op
+    y2 = (rng.random(1000) < 0.4).astype(np.float32)
+    b2 = DataBalancer(sample_fraction=0.1)
+    b2.pre_validation_prepare(y2)
+    assert b2.already_balanced
+    assert np.all(b2.prepare_weights(y2) == 1.0)
+
+
+def test_data_cutter_drops_rare_labels():
+    y = np.array([0.0] * 50 + [1.0] * 40 + [2.0] * 9 + [3.0])
+    c = DataCutter(max_label_categories=3, min_label_fraction=0.05)
+    c.pre_validation_prepare(y)
+    assert c.labels_kept == [0.0, 1.0, 2.0]
+    w = c.prepare_weights(y)
+    assert w[y == 3.0].sum() == 0.0
+    idx = c.prepare_indices(y)
+    assert set(np.unique(y[idx])) == {0.0, 1.0, 2.0}
+
+
+def test_splitter_stratified_holdout():
+    y = np.array([1.0] * 20 + [0.0] * 80)
+    s = Splitter(reserve_test_fraction=0.25)
+    tr, ho = s.split(len(y), y)
+    assert len(ho) == 25
+    assert (y[ho] == 1).sum() == 5
+    assert len(np.intersect1d(tr, ho)) == 0

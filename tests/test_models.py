@@ -1,0 +1,145 @@
+"""Model-wrapper tests: trees, boosting, MLP, NB, GLM + selector factories.
+
+Reference analogs: OpRandomForestClassifierTest, OpXGBoostClassifierTest,
+OpGBTRegressorTest, OpNaiveBayesTest, OpMultilayerPerceptronClassifierTest,
+OpGeneralizedLinearRegressionTest (core/src/test/.../impl/...)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.impl.classification.mlp import OpMultilayerPerceptronClassifier
+from transmogrifai_tpu.impl.classification.naive_bayes import OpNaiveBayes
+from transmogrifai_tpu.impl.classification.trees import (
+    OpDecisionTreeClassifier, OpGBTClassifier, OpRandomForestClassifier,
+    OpXGBoostClassifier)
+from transmogrifai_tpu.impl.regression.glm import OpGeneralizedLinearRegression
+from transmogrifai_tpu.impl.regression.trees import (
+    OpDecisionTreeRegressor, OpGBTRegressor, OpRandomForestRegressor,
+    OpXGBoostRegressor)
+
+
+def _xor_data(n=1500, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, 6)).astype(np.float32)
+    y = ((X[:, 0] > 0) ^ (X[:, 1] > 0.3)).astype(np.float32)
+    return X, y
+
+
+def _reg_data(n=1500, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, 5)).astype(np.float32)
+    y = (X[:, 0] ** 2 + 2.0 * X[:, 1] + 0.1 * rng.standard_normal(n)).astype(np.float32)
+    return X, y
+
+
+@pytest.mark.parametrize("est,acc_min", [
+    # XOR targets have zero marginal gain per feature, so feature subsetting
+    # would starve most trees (true for any RF; Spark included) — use "all"
+    (OpRandomForestClassifier(num_trees=20, max_depth=6,
+                              feature_subset_strategy="all"), 0.93),
+    (OpDecisionTreeClassifier(max_depth=6), 0.9),
+    (OpGBTClassifier(max_iter=30, max_depth=3), 0.93),
+    (OpXGBoostClassifier(num_round=40, max_depth=3), 0.93),
+    (OpMultilayerPerceptronClassifier(hidden_layers=(16,), max_iter=400), 0.9),
+])
+def test_nonlinear_classifiers(est, acc_min):
+    X, y = _xor_data()
+    params = est.fit_arrays(X, y)
+    pred, raw, prob = est.predict_arrays(params, X)
+    assert (np.asarray(pred) == y).mean() > acc_min
+    assert prob.shape == (len(y), 2)
+    assert np.allclose(prob.sum(axis=1), 1.0, atol=1e-4)
+
+
+def test_multiclass_forest_and_xgb():
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((1200, 4)).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32) + (X[:, 1] > 0).astype(np.float32)
+    for est, acc_min in ((OpRandomForestClassifier(num_trees=20, max_depth=6), 0.85),
+                         (OpXGBoostClassifier(num_round=30, max_depth=3), 0.95)):
+        params = est.fit_arrays(X, y)
+        pred, raw, prob = est.predict_arrays(params, X)
+        assert prob.shape[1] == 3
+        assert (np.asarray(pred) == y).mean() > acc_min
+
+
+@pytest.mark.parametrize("est,r2_min", [
+    (OpRandomForestRegressor(num_trees=20, max_depth=7,
+                             feature_subset_strategy="all"), 0.9),
+    (OpRandomForestRegressor(num_trees=20, max_depth=7), 0.5),  # onethird subset
+    (OpDecisionTreeRegressor(max_depth=7), 0.8),
+    (OpGBTRegressor(max_iter=40, max_depth=4), 0.9),
+    (OpXGBoostRegressor(num_round=60, max_depth=4, eta=0.2), 0.9),
+])
+def test_nonlinear_regressors(est, r2_min):
+    X, y = _reg_data()
+    params = est.fit_arrays(X, y)
+    pred, _, _ = est.predict_arrays(params, X)
+    r2 = 1.0 - np.mean((pred - y) ** 2) / np.var(y)
+    assert r2 > r2_min
+
+
+def test_naive_bayes():
+    rng = np.random.default_rng(5)
+    n = 1000
+    y = (rng.random(n) < 0.4).astype(np.float32)
+    # nonneg count-ish features correlated with class
+    X = rng.poisson(lam=np.where(y[:, None] > 0, [3.0, 1.0, 0.5], [0.5, 1.0, 3.0]),
+                    size=(n, 3)).astype(np.float32)
+    nb = OpNaiveBayes()
+    params = nb.fit_arrays(X, y)
+    pred, raw, prob = nb.predict_arrays(params, X)
+    assert (pred == y).mean() > 0.85
+    with pytest.raises(ValueError):
+        nb.fit_arrays(-X, y)
+
+
+def test_glm_poisson_and_gaussian():
+    rng = np.random.default_rng(6)
+    X = rng.standard_normal((2000, 3)).astype(np.float32)
+    beta = np.array([0.5, -0.3, 0.2], np.float32)
+    mu = np.exp(X @ beta + 0.5)
+    y = rng.poisson(mu).astype(np.float32)
+    glm = OpGeneralizedLinearRegression(family="poisson")
+    params = glm.fit_arrays(X, y)
+    pred, _, _ = glm.predict_arrays(params, X)
+    corr = np.corrcoef(pred, mu)[0, 1]
+    assert corr > 0.95
+    g2 = OpGeneralizedLinearRegression(family="gaussian")
+    p2 = g2.fit_arrays(X, (X @ beta).astype(np.float32))
+    pr2, _, _ = g2.predict_arrays(p2, X)
+    assert np.corrcoef(pr2, X @ beta)[0, 1] > 0.99
+    with pytest.raises(ValueError):
+        OpGeneralizedLinearRegression(family="nope")
+
+
+def test_selector_factories_smoke():
+    from transmogrifai_tpu.impl.selector.factories import (
+        BinaryClassificationModelSelector, MultiClassificationModelSelector,
+        RegressionModelSelector)
+
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        model_types=["OpLogisticRegression"])
+    assert sel.problem_type == "BinaryClassification"
+    assert len(sel.models) == 1
+    assert sel.validator.evaluator.default_metric == "AuPR"
+    sel2 = MultiClassificationModelSelector.with_train_validation_split()
+    assert len(sel2.models) == 2
+    sel3 = RegressionModelSelector.with_cross_validation()
+    assert len(sel3.models) == 3
+    with pytest.raises(ValueError):
+        BinaryClassificationModelSelector.with_cross_validation(model_types=["Nope"])
+
+
+def test_random_param_builder():
+    from transmogrifai_tpu.impl.selector.defaults import RandomParamBuilder
+
+    grids = (RandomParamBuilder(seed=1)
+             .exponential("reg_param", 1e-4, 1.0)
+             .choice("elastic_net_param", [0.0, 0.5])
+             .int_uniform("max_iter", 10, 50)
+             .subset(7))
+    assert len(grids) == 7
+    for g in grids:
+        assert 1e-4 <= g["reg_param"] <= 1.0
+        assert g["elastic_net_param"] in (0.0, 0.5)
+        assert 10 <= g["max_iter"] <= 50

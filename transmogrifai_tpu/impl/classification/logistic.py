@@ -60,6 +60,62 @@ class OpLogisticRegression(PredictorEstimator):
         return {"coef": np.asarray(fitres.coef), "intercept": np.asarray(fitres.intercept),
                 "num_classes": 2, "multinomial": False}
 
+    def fit_grid_folds(self, X, y, train_w, grids):
+        """Whole fold x grid block as one/two vmapped XLA programs.
+
+        Optimizer consistency with fit_arrays (so CV metrics measure the same
+        model the refit ships): pure-L2 candidates (l1 == 0) train via the
+        Newton kernel, elastic-net candidates via FISTA; multinomial via the
+        softmax kernel.  Only (reg_param, elastic_net_param) are batchable;
+        structural params fall back to the per-candidate loop.
+        """
+        base_fi = bool(self.get_param("fit_intercept", True))
+        base_mi = int(self.get_param("max_iter", 100))
+        base_family = self.get_param("family", "auto")
+        p = self._grid_param_arrays(grids, ("reg_param", "elastic_net_param"))
+        reg, alpha = p["reg_param"], p["elastic_net_param"]
+        l1 = reg * alpha
+        l2 = reg * (1.0 - alpha)
+        Xd = jnp.asarray(X, jnp.float32)
+        yd = jnp.asarray(y, jnp.float32)
+        twd = jnp.asarray(train_w, jnp.float32)
+        F, G = train_w.shape[0], len(grids)
+        num_classes = int(np.max(np.asarray(y))) + 1 if len(y) else 2
+        multinomial = base_family == "multinomial" or (base_family == "auto"
+                                                       and num_classes > 2)
+        if multinomial:
+            fitres = L.fit_softmax_grid_folds(Xd, yd, twd, jnp.asarray(l1),
+                                              jnp.asarray(l2),
+                                              num_classes=max(num_classes, 2),
+                                              max_iter=base_mi, fit_intercept=base_fi)
+            raw, prob, pred = L.predict_softmax_grid(Xd, fitres.coef, fitres.intercept)
+            raw, prob, pred = np.asarray(raw), np.asarray(prob), np.asarray(pred)
+            return [[(pred[f, c], raw[f, c], prob[f, c]) for c in range(G)]
+                    for f in range(F)]
+        # binary: match fit_arrays' optimizer choice per candidate
+        newton_idx = np.where(l1 == 0.0)[0]
+        fista_idx = np.where(l1 != 0.0)[0]
+        d = X.shape[1]
+        coef = np.zeros((F, G, d), np.float32)
+        intercept = np.zeros((F, G, 1), np.float32)
+        if len(newton_idx):
+            fitn = L.fit_logistic_grid_folds_newton(
+                Xd, yd, twd, jnp.asarray(l2[newton_idx]),
+                max_iter=min(max(base_mi // 4, 10), 50), fit_intercept=base_fi)
+            coef[:, newton_idx] = np.asarray(fitn.coef)
+            intercept[:, newton_idx] = np.asarray(fitn.intercept)
+        if len(fista_idx):
+            fitf = L.fit_logistic_grid_folds_fista(
+                Xd, yd, twd, jnp.asarray(l1[fista_idx]), jnp.asarray(l2[fista_idx]),
+                max_iter=max(base_mi, 200), fit_intercept=base_fi)
+            coef[:, fista_idx] = np.asarray(fitf.coef)
+            intercept[:, fista_idx] = np.asarray(fitf.intercept)
+        raw, prob, pred = L.predict_binary_logistic_grid(
+            Xd, jnp.asarray(coef), jnp.asarray(intercept))
+        raw, prob, pred = np.asarray(raw), np.asarray(prob), np.asarray(pred)
+        return [[(pred[f, c], raw[f, c], prob[f, c]) for c in range(G)]
+                for f in range(F)]
+
     @classmethod
     def predict_arrays(cls, params: Dict[str, Any], X: np.ndarray
                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:

@@ -1,0 +1,49 @@
+"""OpMultilayerPerceptronClassifier.
+
+Reference parity: core/.../impl/classification/OpMultilayerPerceptronClassifier.scala
+wrapping Spark's MLP (layers, maxIter, stepSize, seed; sigmoid hidden +
+softmax output).  TPU-native: full-batch Adam over a static topology
+(ops/mlp.py) — one compiled program of MXU matmuls.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops import mlp as M
+from ..selector.predictor import PredictorEstimator
+
+
+class OpMultilayerPerceptronClassifier(PredictorEstimator):
+    is_classifier = True
+
+    def __init__(self, hidden_layers: Tuple[int, ...] = (10,), max_iter: int = 200,
+                 step_size: float = 0.03, seed: int = 42,
+                 uid: Optional[str] = None, **extra):
+        super().__init__(operation_name="OpMultilayerPerceptronClassifier", uid=uid,
+                         hidden_layers=tuple(hidden_layers), max_iter=max_iter,
+                         step_size=step_size, seed=seed, **extra)
+
+    def fit_arrays(self, X: np.ndarray, y: np.ndarray,
+                   w: Optional[np.ndarray] = None) -> Dict[str, Any]:
+        k = max(int(np.max(y)) + 1 if len(y) else 2, 2)
+        layers = (X.shape[1],) + tuple(int(h) for h in
+                                       self.get_param("hidden_layers", (10,))) + (k,)
+        sw = np.ones(len(y), np.float32) if w is None else np.asarray(w, np.float32)
+        params = M.fit_mlp(jnp.asarray(X, jnp.float32),
+                           jnp.asarray(np.asarray(y, np.float32)),
+                           jnp.asarray(sw), layers=layers,
+                           max_iter=int(self.get_param("max_iter", 200)),
+                           lr=float(self.get_param("step_size", 0.03)),
+                           seed=int(self.get_param("seed", 42)))
+        return {"weights": [(np.asarray(W), np.asarray(b)) for W, b in params],
+                "layers": layers, "num_classes": k}
+
+    @classmethod
+    def predict_arrays(cls, params: Dict[str, Any], X: np.ndarray
+                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        p = [(jnp.asarray(W), jnp.asarray(b)) for W, b in params["weights"]]
+        z, prob, pred = M.predict_mlp(p, jnp.asarray(X, jnp.float32))
+        return np.asarray(pred), np.asarray(z), np.asarray(prob)

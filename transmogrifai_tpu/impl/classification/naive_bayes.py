@@ -1,0 +1,76 @@
+"""OpNaiveBayes — multinomial naive Bayes.
+
+Reference parity: core/.../impl/classification/OpNaiveBayes.scala wrapping
+Spark NaiveBayes (smoothing=1.0, modelType multinomial|bernoulli).  Like
+Spark, multinomial/bernoulli require non-negative features; fitting is a
+single weighted aggregation pass (one matmul on the MXU) — no iterations.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..selector.predictor import PredictorEstimator
+
+
+class OpNaiveBayes(PredictorEstimator):
+    is_classifier = True
+
+    def __init__(self, smoothing: float = 1.0, model_type: str = "multinomial",
+                 uid: Optional[str] = None, **extra):
+        if model_type not in ("multinomial", "bernoulli"):
+            raise ValueError("model_type must be multinomial or bernoulli")
+        super().__init__(operation_name="OpNaiveBayes", uid=uid,
+                         smoothing=smoothing, model_type=model_type, **extra)
+
+    def fit_arrays(self, X: np.ndarray, y: np.ndarray,
+                   w: Optional[np.ndarray] = None) -> Dict[str, Any]:
+        X = np.asarray(X, np.float32)
+        if (X < 0).any():
+            raise ValueError("Naive Bayes requires non-negative feature values "
+                             "(Spark NaiveBayes semantics)")
+        y = np.asarray(y)
+        sw = np.ones(len(y), np.float32) if w is None else np.asarray(w, np.float32)
+        k = int(y.max()) + 1 if len(y) else 2
+        k = max(k, 2)
+        smoothing = float(self.get_param("smoothing", 1.0))
+        model_type = self.get_param("model_type", "multinomial")
+        Xd = jnp.asarray(X if model_type == "multinomial" else (X > 0).astype(np.float32))
+        Y = jax.nn.one_hot(jnp.asarray(y, jnp.int32), k, dtype=jnp.float32)
+        Yw = Y * jnp.asarray(sw)[:, None]
+        class_mass = Yw.sum(axis=0)                     # [k]
+        feat_mass = Yw.T @ Xd                           # [k, d] one MXU matmul
+        pi = jnp.log(class_mass + smoothing) - jnp.log(
+            class_mass.sum() + smoothing * k)
+        if model_type == "multinomial":
+            theta = jnp.log(feat_mass + smoothing) - jnp.log(
+                feat_mass.sum(axis=1, keepdims=True) + smoothing * Xd.shape[1])
+        else:
+            doc_mass = class_mass[:, None]
+            p = (feat_mass + smoothing) / (doc_mass + 2.0 * smoothing)
+            theta = jnp.log(p)
+            # bernoulli also needs log(1-p) for absent features
+            return {"pi": np.asarray(pi), "theta": np.asarray(theta),
+                    "theta_neg": np.asarray(jnp.log1p(-p)), "num_classes": k,
+                    "model_type": model_type}
+        return {"pi": np.asarray(pi), "theta": np.asarray(theta),
+                "num_classes": k, "model_type": model_type}
+
+    @classmethod
+    def predict_arrays(cls, params: Dict[str, Any], X: np.ndarray
+                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        X = jnp.asarray(np.asarray(X, np.float32))
+        pi = jnp.asarray(params["pi"])
+        theta = jnp.asarray(params["theta"])
+        if params.get("model_type") == "bernoulli":
+            Xb = (X > 0).astype(jnp.float32)
+            tn = jnp.asarray(params["theta_neg"])
+            z = pi + Xb @ theta.T + (1.0 - Xb) @ tn.T
+        else:
+            z = pi + X @ theta.T
+        prob = jax.nn.softmax(z, axis=-1)
+        pred = jnp.argmax(z, axis=-1).astype(jnp.float32)
+        return np.asarray(pred), np.asarray(z), np.asarray(prob)

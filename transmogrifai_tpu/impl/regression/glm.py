@@ -1,0 +1,57 @@
+"""OpGeneralizedLinearRegression.
+
+Reference parity: core/.../impl/regression/OpGeneralizedLinearRegression.scala
+wrapping Spark GeneralizedLinearRegression (family, link, regParam, maxIter,
+tol, fitIntercept, variancePower).  TPU-native: fixed-iteration IRLS
+(ops.linear.fit_glm_irls) — each step one weighted normal-equation solve.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops import linear as L
+from ..selector.predictor import PredictorEstimator
+
+
+class OpGeneralizedLinearRegression(PredictorEstimator):
+    is_classifier = False
+
+    def __init__(self, family: str = "gaussian", link: Optional[str] = None,
+                 reg_param: float = 0.0, max_iter: int = 25, tol: float = 1e-6,
+                 fit_intercept: bool = True, variance_power: float = 0.0,
+                 uid: Optional[str] = None, **extra):
+        if family not in L.GLM_DEFAULT_LINK:
+            raise ValueError(f"Unsupported GLM family {family!r}; one of "
+                             f"{sorted(L.GLM_DEFAULT_LINK)}")
+        link = link or L.GLM_DEFAULT_LINK[family]
+        if link not in ("identity", "log", "logit", "inverse", "sqrt"):
+            raise ValueError(f"Unsupported link {link!r}")
+        super().__init__(operation_name="OpGeneralizedLinearRegression", uid=uid,
+                         family=family, link=link, reg_param=reg_param,
+                         max_iter=max_iter, tol=tol, fit_intercept=fit_intercept,
+                         variance_power=variance_power, **extra)
+
+    def fit_arrays(self, X: np.ndarray, y: np.ndarray,
+                   w: Optional[np.ndarray] = None) -> Dict[str, Any]:
+        sw = np.ones(len(y), np.float32) if w is None else np.asarray(w, np.float32)
+        fit = L.fit_glm_irls(
+            jnp.asarray(X, jnp.float32), jnp.asarray(np.asarray(y, np.float32)),
+            jnp.asarray(sw), l2=float(self.get_param("reg_param", 0.0)),
+            family=self.get_param("family"), link=self.get_param("link"),
+            max_iter=int(self.get_param("max_iter", 25)),
+            fit_intercept=bool(self.get_param("fit_intercept", True)),
+            variance_power=float(self.get_param("variance_power", 0.0)))
+        return {"coef": np.asarray(fit.coef), "intercept": np.asarray(fit.intercept),
+                "link": self.get_param("link")}
+
+    @classmethod
+    def predict_arrays(cls, params: Dict[str, Any], X: np.ndarray
+                       ) -> Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]:
+        mu = L.predict_glm(jnp.asarray(X, jnp.float32),
+                           jnp.asarray(params["coef"], jnp.float32),
+                           jnp.asarray(params["intercept"], jnp.float32),
+                           link=params["link"])
+        return np.asarray(mu, np.float64), None, None

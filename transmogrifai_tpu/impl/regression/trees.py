@@ -1,0 +1,198 @@
+"""Tree-ensemble regressors: RandomForest / GBT / DecisionTree / XGBoost-style.
+
+Reference parity: core/.../impl/regression/{OpRandomForestRegressor,
+OpGBTRegressor, OpDecisionTreeRegressor, OpXGBoostRegressor}.scala.
+Same histogram kernels as the classifiers (ops/trees.py); variance-impurity
+splitting falls out of the second-order gain with g=-y, h=1.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops import trees as Tr
+from ..selector.predictor import PredictorEstimator
+
+
+class _TreeRegressorBase(PredictorEstimator):
+    is_classifier = False
+
+    def _subset_frac(self, d: int) -> float:
+        strat = str(self.get_param("feature_subset_strategy", "auto"))
+        if strat == "auto":
+            return 1.0 / 3.0  # Spark regression default: onethird
+        if strat == "sqrt":
+            return math.sqrt(d) / d
+        if strat == "onethird":
+            return 1.0 / 3.0
+        if strat == "all":
+            return 1.0
+        try:
+            return float(strat)
+        except ValueError:
+            return 1.0
+
+
+class OpRandomForestRegressor(_TreeRegressorBase):
+    def __init__(self, num_trees: int = 20, max_depth: int = 5, max_bins: int = 32,
+                 min_instances_per_node: int = 1, subsampling_rate: float = 1.0,
+                 feature_subset_strategy: str = "auto", impurity: str = "variance",
+                 seed: int = 42, uid: Optional[str] = None, **extra):
+        super().__init__(operation_name="OpRandomForestRegressor", uid=uid,
+                         num_trees=num_trees, max_depth=max_depth, max_bins=max_bins,
+                         min_instances_per_node=min_instances_per_node,
+                         subsampling_rate=subsampling_rate,
+                         feature_subset_strategy=feature_subset_strategy,
+                         impurity=impurity, seed=seed, **extra)
+
+    def fit_arrays(self, X: np.ndarray, y: np.ndarray,
+                   w: Optional[np.ndarray] = None) -> Dict[str, Any]:
+        n, d = X.shape
+        n_bins = int(self.get_param("max_bins", 32))
+        depth = int(self.get_param("max_depth", 5))
+        n_trees = int(self.get_param("num_trees", 20))
+        rng = np.random.default_rng(int(self.get_param("seed", 42)))
+        Xb, edges = Tr.quantize(X, n_bins)
+        sw = np.ones(n, np.float32) if w is None else np.asarray(w, np.float32)
+        wt = Tr.bootstrap_weights(n, n_trees, rng) * sw[None, :]
+        fms = Tr.feature_masks(d, n_trees, self._subset_frac(d), rng)
+        g = jnp.asarray(-np.asarray(y, np.float32)[:, None])
+        forest = Tr.fit_forest(jnp.asarray(Xb), g, jnp.ones(n, jnp.float32),
+                               jnp.asarray(wt), jnp.asarray(fms),
+                               max_depth=depth, n_bins=n_bins,
+                               min_child_weight=float(
+                                   self.get_param("min_instances_per_node", 1)))
+        return {"split_feat": np.asarray(forest.split_feat),
+                "split_bin": np.asarray(forest.split_bin),
+                "leaf_val": np.asarray(forest.leaf_val),
+                "edges": edges, "max_depth": depth}
+
+    @classmethod
+    def predict_arrays(cls, params: Dict[str, Any], X: np.ndarray
+                       ) -> Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]:
+        Xb = jnp.asarray(Tr.bin_with_edges(X, params["edges"]))
+        forest = Tr.Tree(jnp.asarray(params["split_feat"]),
+                         jnp.asarray(params["split_bin"]),
+                         jnp.asarray(params["leaf_val"]))
+        pred = np.asarray(Tr.predict_forest(Xb, forest, params["max_depth"]))[:, 0]
+        return pred.astype(np.float64), None, None
+
+
+class OpDecisionTreeRegressor(OpRandomForestRegressor):
+    def __init__(self, max_depth: int = 5, max_bins: int = 32,
+                 min_instances_per_node: int = 1, seed: int = 42,
+                 uid: Optional[str] = None, **extra):
+        # drop fixed-by-construction params resurfacing via copy_with_params
+        for k in ("num_trees", "feature_subset_strategy", "subsampling_rate",
+                  "impurity"):
+            extra.pop(k, None)
+        super().__init__(num_trees=1, max_depth=max_depth, max_bins=max_bins,
+                         min_instances_per_node=min_instances_per_node,
+                         feature_subset_strategy="all", seed=seed, uid=uid, **extra)
+        self.operation_name = "OpDecisionTreeRegressor"
+
+    def fit_arrays(self, X, y, w=None):
+        n, d = X.shape
+        n_bins = int(self.get_param("max_bins", 32))
+        depth = int(self.get_param("max_depth", 5))
+        Xb, edges = Tr.quantize(X, n_bins)
+        sw = np.ones(n, np.float32) if w is None else np.asarray(w, np.float32)
+        g = jnp.asarray(-np.asarray(y, np.float32)[:, None])
+        forest = Tr.fit_forest(jnp.asarray(Xb), g, jnp.ones(n, jnp.float32),
+                               jnp.asarray(sw[None, :]),
+                               jnp.asarray(np.ones((1, d), np.float32)),
+                               max_depth=depth, n_bins=n_bins,
+                               min_child_weight=float(
+                                   self.get_param("min_instances_per_node", 1)))
+        return {"split_feat": np.asarray(forest.split_feat),
+                "split_bin": np.asarray(forest.split_bin),
+                "leaf_val": np.asarray(forest.leaf_val),
+                "edges": edges, "max_depth": depth}
+
+
+class _BoostedRegressorBase(_TreeRegressorBase):
+    def _boost_params(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def fit_arrays(self, X: np.ndarray, y: np.ndarray,
+                   w: Optional[np.ndarray] = None) -> Dict[str, Any]:
+        bp = self._boost_params()
+        n, d = X.shape
+        rng = np.random.default_rng(int(self.get_param("seed", 42)))
+        Xb, edges = Tr.quantize(X, bp["n_bins"])
+        sw = np.ones(n, np.float32) if w is None else np.asarray(w, np.float32)
+        rw = Tr.subsample_weights(n, bp["n_rounds"], bp["subsample"], rng)
+        fms = Tr.feature_masks(d, bp["n_rounds"], bp["colsample"], rng)
+        base = float(np.average(y, weights=np.maximum(sw, 1e-12)))
+        trees, _ = Tr.fit_gbt(jnp.asarray(Xb), jnp.asarray(np.asarray(y, np.float32)),
+                              jnp.asarray(sw), jnp.asarray(rw), jnp.asarray(fms),
+                              loss="squared", n_rounds=bp["n_rounds"],
+                              max_depth=bp["max_depth"], n_bins=bp["n_bins"],
+                              eta=bp["eta"], reg_lambda=bp["reg_lambda"],
+                              gamma=bp["gamma"],
+                              min_child_weight=bp["min_child_weight"],
+                              base_score=base)
+        return {"split_feat": np.asarray(trees.split_feat),
+                "split_bin": np.asarray(trees.split_bin),
+                "leaf_val": np.asarray(trees.leaf_val),
+                "edges": edges, "max_depth": bp["max_depth"], "eta": bp["eta"],
+                "base_score": base}
+
+    @classmethod
+    def predict_arrays(cls, params: Dict[str, Any], X: np.ndarray
+                       ) -> Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]:
+        Xb = jnp.asarray(Tr.bin_with_edges(X, params["edges"]))
+        trees = Tr.Tree(jnp.asarray(params["split_feat"]),
+                        jnp.asarray(params["split_bin"]),
+                        jnp.asarray(params["leaf_val"]))
+        F = Tr.predict_gbt(Xb, trees, params["max_depth"], params["eta"],
+                           base_score=params["base_score"])
+        return np.asarray(F[:, 0], np.float64), None, None
+
+
+class OpGBTRegressor(_BoostedRegressorBase):
+    def __init__(self, max_iter: int = 20, max_depth: int = 5, max_bins: int = 32,
+                 step_size: float = 0.1, subsampling_rate: float = 1.0,
+                 min_instances_per_node: int = 1, seed: int = 42,
+                 uid: Optional[str] = None, **extra):
+        super().__init__(operation_name="OpGBTRegressor", uid=uid,
+                         max_iter=max_iter, max_depth=max_depth, max_bins=max_bins,
+                         step_size=step_size, subsampling_rate=subsampling_rate,
+                         min_instances_per_node=min_instances_per_node, seed=seed,
+                         **extra)
+
+    def _boost_params(self):
+        return {"n_rounds": int(self.get_param("max_iter", 20)),
+                "max_depth": int(self.get_param("max_depth", 5)),
+                "n_bins": int(self.get_param("max_bins", 32)),
+                "eta": float(self.get_param("step_size", 0.1)),
+                "subsample": float(self.get_param("subsampling_rate", 1.0)),
+                "colsample": 1.0, "reg_lambda": 1e-6, "gamma": 0.0,
+                "min_child_weight": float(self.get_param("min_instances_per_node", 1))}
+
+
+class OpXGBoostRegressor(_BoostedRegressorBase):
+    def __init__(self, num_round: int = 100, eta: float = 0.3, max_depth: int = 6,
+                 max_bins: int = 64, reg_lambda: float = 1.0, gamma: float = 0.0,
+                 min_child_weight: float = 1.0, subsample: float = 1.0,
+                 colsample_bytree: float = 1.0, seed: int = 42,
+                 uid: Optional[str] = None, **extra):
+        super().__init__(operation_name="OpXGBoostRegressor", uid=uid,
+                         num_round=num_round, eta=eta, max_depth=max_depth,
+                         max_bins=max_bins, reg_lambda=reg_lambda, gamma=gamma,
+                         min_child_weight=min_child_weight, subsample=subsample,
+                         colsample_bytree=colsample_bytree, seed=seed, **extra)
+
+    def _boost_params(self):
+        return {"n_rounds": int(self.get_param("num_round", 100)),
+                "max_depth": int(self.get_param("max_depth", 6)),
+                "n_bins": int(self.get_param("max_bins", 64)),
+                "eta": float(self.get_param("eta", 0.3)),
+                "subsample": float(self.get_param("subsample", 1.0)),
+                "colsample": float(self.get_param("colsample_bytree", 1.0)),
+                "reg_lambda": float(self.get_param("reg_lambda", 1.0)),
+                "gamma": float(self.get_param("gamma", 0.0)),
+                "min_child_weight": float(self.get_param("min_child_weight", 1.0))}

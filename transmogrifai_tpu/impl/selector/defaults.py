@@ -1,0 +1,123 @@
+"""Default hyperparameter grids + random search builder.
+
+Reference parity: core/.../impl/selector/DefaultSelectorParams.scala:37-75
+(values mirrored: MaxDepth=[3,6,12], Regularization=[0.001,0.01,0.1,0.2],
+ElasticNet=[0.1,0.5], MaxTrees=[50], MinInstancesPerNode=[10,100],
+NumRound=[200], Eta=[0.02], MinChildWeight=[1,10], XGB maxDepth=[10],
+XGB gamma=[0.8]) and RandomParamBuilder.scala:52.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+# DefaultSelectorParams values (DefaultSelectorParams.scala:37-75)
+MAX_DEPTH = [3, 6, 12]
+MAX_BIN = [32]
+MIN_INSTANCES_PER_NODE = [10, 100]
+MIN_INFO_GAIN = [0.001, 0.01, 0.1]
+REGULARIZATION = [0.001, 0.01, 0.1, 0.2]
+MAX_ITER_LIN = [50]
+MAX_ITER_TREE = [20]
+SUBSAMPLE_RATE = [1.0]
+STEP_SIZE = [0.1]
+ELASTIC_NET = [0.1, 0.5]
+MAX_TREES = [50]
+NB_SMOOTHING = [1.0]
+NUM_ROUND = [200]
+ETA = [0.02]
+MIN_CHILD_WEIGHT = [1.0, 10.0]
+XGB_MAX_DEPTH = [10]
+XGB_GAMMA = [0.8]
+
+
+def grid(**axes: Sequence[Any]) -> List[Dict[str, Any]]:
+    """Cartesian product of param axes -> list of param dicts (ParamGridBuilder)."""
+    keys = list(axes)
+    out = []
+    for combo in itertools.product(*(axes[k] for k in keys)):
+        out.append(dict(zip(keys, combo)))
+    return out
+
+
+def logistic_regression_grid() -> List[Dict[str, Any]]:
+    return grid(reg_param=REGULARIZATION, elastic_net_param=ELASTIC_NET)
+
+
+def linear_regression_grid() -> List[Dict[str, Any]]:
+    return grid(reg_param=REGULARIZATION, elastic_net_param=ELASTIC_NET)
+
+
+def random_forest_grid() -> List[Dict[str, Any]]:
+    return grid(max_depth=MAX_DEPTH, min_instances_per_node=MIN_INSTANCES_PER_NODE,
+                num_trees=MAX_TREES)
+
+
+def gbt_grid() -> List[Dict[str, Any]]:
+    return grid(max_depth=MAX_DEPTH, min_instances_per_node=MIN_INSTANCES_PER_NODE,
+                max_iter=MAX_ITER_TREE, step_size=STEP_SIZE)
+
+
+def xgboost_grid() -> List[Dict[str, Any]]:
+    return grid(num_round=NUM_ROUND, eta=ETA, min_child_weight=MIN_CHILD_WEIGHT,
+                max_depth=XGB_MAX_DEPTH, gamma=XGB_GAMMA)
+
+
+def linear_svc_grid() -> List[Dict[str, Any]]:
+    return grid(reg_param=REGULARIZATION)
+
+
+def naive_bayes_grid() -> List[Dict[str, Any]]:
+    return grid(smoothing=NB_SMOOTHING)
+
+
+def decision_tree_grid() -> List[Dict[str, Any]]:
+    return grid(max_depth=MAX_DEPTH, min_instances_per_node=MIN_INSTANCES_PER_NODE)
+
+
+class RandomParamBuilder:
+    """Random hyperparameter search (RandomParamBuilder.scala:52):
+    ``subset(n)`` draws n param dicts from declared distributions."""
+
+    def __init__(self, seed: int = 42):
+        self._axes: List[Tuple[str, Any]] = []
+        self._rng = np.random.default_rng(seed)
+
+    def uniform(self, name: str, low: float, high: float) -> "RandomParamBuilder":
+        self._axes.append((name, ("uniform", low, high)))
+        return self
+
+    def exponential(self, name: str, low: float, high: float) -> "RandomParamBuilder":
+        """Log-uniform between low and high (reference exponential)."""
+        if low <= 0 or high <= 0:
+            raise ValueError("exponential bounds must be positive")
+        self._axes.append((name, ("exponential", low, high)))
+        return self
+
+    def choice(self, name: str, values: Sequence[Any]) -> "RandomParamBuilder":
+        self._axes.append((name, ("choice", list(values))))
+        return self
+
+    def int_uniform(self, name: str, low: int, high: int) -> "RandomParamBuilder":
+        self._axes.append((name, ("int", low, high)))
+        return self
+
+    def subset(self, n: int) -> List[Dict[str, Any]]:
+        out = []
+        for _ in range(n):
+            d: Dict[str, Any] = {}
+            for name, spec in self._axes:
+                kind = spec[0]
+                if kind == "uniform":
+                    d[name] = float(self._rng.uniform(spec[1], spec[2]))
+                elif kind == "exponential":
+                    d[name] = float(np.exp(self._rng.uniform(np.log(spec[1]),
+                                                             np.log(spec[2]))))
+                elif kind == "choice":
+                    d[name] = spec[1][self._rng.integers(len(spec[1]))]
+                elif kind == "int":
+                    d[name] = int(self._rng.integers(spec[1], spec[2] + 1))
+            out.append(d)
+        return out

@@ -1,0 +1,184 @@
+"""ModelSelector factories: Binary / Multi classification + Regression.
+
+Reference parity:
+- BinaryClassificationModelSelector.scala:49 (defaults LR+RF+XGB :62-63,
+  metric auPR :172),
+- MultiClassificationModelSelector.scala (defaults LR+RF :62, metric Error),
+- RegressionModelSelector.scala (defaults LinReg+RF+GBT :62, metric RMSE),
+- shared ModelSelectorFactory.scala:43.
+
+API: ``BinaryClassificationModelSelector.with_cross_validation(...)`` /
+``.with_train_validation_split(...)`` / ``.apply()``.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ...evaluators import (Evaluators, OpBinaryClassificationEvaluator,
+                           OpMultiClassificationEvaluator, OpRegressionEvaluator)
+from ...evaluators.base import OpEvaluatorBase
+from ..classification.logistic import OpLogisticRegression
+from ..classification.mlp import OpMultilayerPerceptronClassifier
+from ..classification.naive_bayes import OpNaiveBayes
+from ..classification.svc import OpLinearSVC
+from ..classification.trees import (OpDecisionTreeClassifier, OpGBTClassifier,
+                                    OpRandomForestClassifier, OpXGBoostClassifier)
+from ..regression.glm import OpGeneralizedLinearRegression
+from ..regression.linear import OpLinearRegression
+from ..regression.trees import (OpDecisionTreeRegressor, OpGBTRegressor,
+                                OpRandomForestRegressor, OpXGBoostRegressor)
+from ..tuning.splitters import DataBalancer, DataCutter, DataSplitter, Splitter
+from ..tuning.validators import (DEFAULT_NUM_FOLDS, DEFAULT_TRAIN_RATIO,
+                                 OpCrossValidation, OpTrainValidationSplit)
+from . import defaults as D
+from .model_selector import ModelSelector
+
+Candidates = Sequence[Tuple[Any, Sequence[Dict[str, Any]]]]
+
+
+class _SelectorFactory:
+    """Shared construction logic (ModelSelectorFactory.scala:43)."""
+
+    problem_type = "Unknown"
+
+    @classmethod
+    def _default_models(cls) -> Candidates:
+        raise NotImplementedError
+
+    @classmethod
+    def _default_splitter(cls) -> Splitter:
+        raise NotImplementedError
+
+    @classmethod
+    def _default_evaluator(cls) -> OpEvaluatorBase:
+        raise NotImplementedError
+
+    @classmethod
+    def _models_for(cls, model_types: Optional[Sequence[str]],
+                    models_and_params: Optional[Candidates]) -> Candidates:
+        if models_and_params is not None:
+            return models_and_params
+        models = cls._default_models()
+        if model_types is not None:
+            wanted = set(model_types)
+            models = [(e, g) for e, g in models if type(e).__name__ in wanted]
+            if not models:
+                raise ValueError(f"No candidate models left for types {sorted(wanted)}")
+        return models
+
+    @classmethod
+    def _build(cls, validator, splitter, model_types, models_and_params,
+               evaluators) -> ModelSelector:
+        sel = ModelSelector(
+            validator=validator, splitter=splitter,
+            models=cls._models_for(model_types, models_and_params),
+            evaluators=evaluators)
+        sel.problem_type = cls.problem_type
+        return sel
+
+    @classmethod
+    def with_cross_validation(cls, splitter: Optional[Splitter] = None,
+                              num_folds: int = DEFAULT_NUM_FOLDS,
+                              validation_metric: Optional[OpEvaluatorBase] = None,
+                              trained_model_evaluators: Sequence[OpEvaluatorBase] = (),
+                              seed: int = 42, stratify: bool = False,
+                              parallelism: int = 8,
+                              model_types: Optional[Sequence[str]] = None,
+                              models_and_parameters: Optional[Candidates] = None
+                              ) -> ModelSelector:
+        ev = validation_metric or cls._default_evaluator()
+        return cls._build(
+            OpCrossValidation(ev, num_folds=num_folds, seed=seed, stratify=stratify,
+                              parallelism=parallelism),
+            splitter if splitter is not None else cls._default_splitter(),
+            model_types, models_and_parameters, list(trained_model_evaluators))
+
+    @classmethod
+    def with_train_validation_split(cls, splitter: Optional[Splitter] = None,
+                                    train_ratio: float = DEFAULT_TRAIN_RATIO,
+                                    validation_metric: Optional[OpEvaluatorBase] = None,
+                                    trained_model_evaluators: Sequence[OpEvaluatorBase] = (),
+                                    seed: int = 42, stratify: bool = False,
+                                    parallelism: int = 8,
+                                    model_types: Optional[Sequence[str]] = None,
+                                    models_and_parameters: Optional[Candidates] = None
+                                    ) -> ModelSelector:
+        ev = validation_metric or cls._default_evaluator()
+        return cls._build(
+            OpTrainValidationSplit(ev, train_ratio=train_ratio, seed=seed,
+                                   stratify=stratify, parallelism=parallelism),
+            splitter if splitter is not None else cls._default_splitter(),
+            model_types, models_and_parameters, list(trained_model_evaluators))
+
+    @classmethod
+    def apply(cls) -> ModelSelector:
+        return cls.with_cross_validation()
+
+
+class BinaryClassificationModelSelector(_SelectorFactory):
+    """Defaults: LR + RF + XGBoost grids, DataBalancer, auPR metric
+    (BinaryClassificationModelSelector.scala:62-63,172)."""
+
+    problem_type = "BinaryClassification"
+
+    @classmethod
+    def _default_models(cls) -> Candidates:
+        return [
+            (OpLogisticRegression(max_iter=50), D.logistic_regression_grid()),
+            (OpRandomForestClassifier(), D.random_forest_grid()),
+            (OpXGBoostClassifier(), D.xgboost_grid()),
+        ]
+
+    @classmethod
+    def _default_splitter(cls) -> Splitter:
+        return DataBalancer(sample_fraction=0.1, reserve_test_fraction=0.1)
+
+    @classmethod
+    def _default_evaluator(cls) -> OpEvaluatorBase:
+        return Evaluators.BinaryClassification.auPR()
+
+
+class MultiClassificationModelSelector(_SelectorFactory):
+    """Defaults: LR + RF grids, DataCutter, Error metric
+    (MultiClassificationModelSelector.scala:62,145)."""
+
+    problem_type = "MultiClassification"
+
+    @classmethod
+    def _default_models(cls) -> Candidates:
+        return [
+            (OpLogisticRegression(max_iter=50), D.logistic_regression_grid()),
+            (OpRandomForestClassifier(), D.random_forest_grid()),
+        ]
+
+    @classmethod
+    def _default_splitter(cls) -> Splitter:
+        return DataCutter(max_label_categories=100, min_label_fraction=0.0,
+                          reserve_test_fraction=0.1)
+
+    @classmethod
+    def _default_evaluator(cls) -> OpEvaluatorBase:
+        return Evaluators.MultiClassification.error()
+
+
+class RegressionModelSelector(_SelectorFactory):
+    """Defaults: LinReg + RF + GBT grids, DataSplitter, RMSE metric
+    (RegressionModelSelector.scala:62,157)."""
+
+    problem_type = "Regression"
+
+    @classmethod
+    def _default_models(cls) -> Candidates:
+        return [
+            (OpLinearRegression(max_iter=50), D.linear_regression_grid()),
+            (OpRandomForestRegressor(), D.random_forest_grid()),
+            (OpGBTRegressor(), D.gbt_grid()),
+        ]
+
+    @classmethod
+    def _default_splitter(cls) -> Splitter:
+        return DataSplitter(reserve_test_fraction=0.1)
+
+    @classmethod
+    def _default_evaluator(cls) -> OpEvaluatorBase:
+        return Evaluators.Regression.rmse()

@@ -1,0 +1,228 @@
+"""ModelSelector — the AutoML heart: validate a model grid, pick + refit best.
+
+Reference parity: core/.../impl/selector/ModelSelector.scala:72 —
+``fit()`` (:145): split holdout -> splitter.preValidationPrepare ->
+``findBestEstimator`` (:116, the CV sweep) -> refit best on the full prepared
+train -> evaluate train+holdout with every evaluator -> ``SelectedModel``
+(:224) with a ``ModelSelectorSummary`` (ModelSelectorSummary.scala:61) in
+output metadata.
+
+TPU-first: the sweep is the vmapped fold x grid program (see
+tuning/validators.py); the final refit is one more jit'd fit.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ... import types as T
+from ...columns import Column, Dataset, NumericColumn, VectorColumn
+from ...evaluators.base import OpEvaluatorBase
+from ...stages.base import AllowLabelAsInput, BinaryEstimator
+from ..tuning.splitters import Splitter, SplitterSummary
+from ..tuning.validators import OpValidator, ValidationSummary
+from .predictor import PredictorEstimator, PredictorModel
+
+#: Prediction/label column keys in summaries (reference ModelSelectorNames)
+HOLDOUT_EVAL = "holdoutEvaluation"
+TRAIN_EVAL = "trainEvaluation"
+
+
+def _scrub(obj: Any) -> Any:
+    """Plain-JSON scrub: numpy scalars/arrays -> python values."""
+    if isinstance(obj, dict):
+        return {str(k): _scrub(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_scrub(v) for v in obj]
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, (np.floating, np.integer, np.bool_)):
+        return obj.item()
+    return obj
+
+
+@dataclass
+class ModelSelectorSummary:
+    """Serializable selection report (ModelSelectorSummary.scala:61)."""
+
+    validation_type: str
+    validation_parameters: Dict[str, Any]
+    data_prep_parameters: Dict[str, Any]
+    data_prep_results: Optional[Dict[str, Any]]
+    evaluation_metric: str
+    problem_type: str
+    best_model_uid: str
+    best_model_name: str
+    best_model_type: str
+    best_grid: Dict[str, Any]
+    validation_results: List[Dict[str, Any]] = field(default_factory=list)
+    train_evaluation: Dict[str, Any] = field(default_factory=dict)
+    holdout_evaluation: Optional[Dict[str, Any]] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        return _scrub({
+            "validationType": self.validation_type,
+            "validationParameters": self.validation_parameters,
+            "dataPrepParameters": self.data_prep_parameters,
+            "dataPrepResults": self.data_prep_results,
+            "evaluationMetric": self.evaluation_metric,
+            "problemType": self.problem_type,
+            "bestModelUID": self.best_model_uid,
+            "bestModelName": self.best_model_name,
+            "bestModelType": self.best_model_type,
+            "bestGrid": self.best_grid,
+            "validationResults": self.validation_results,
+            "trainEvaluation": self.train_evaluation,
+            "holdoutEvaluation": self.holdout_evaluation,
+        })
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "ModelSelectorSummary":
+        return ModelSelectorSummary(
+            validation_type=d["validationType"],
+            validation_parameters=d.get("validationParameters", {}),
+            data_prep_parameters=d.get("dataPrepParameters", {}),
+            data_prep_results=d.get("dataPrepResults"),
+            evaluation_metric=d.get("evaluationMetric", ""),
+            problem_type=d.get("problemType", "Unknown"),
+            best_model_uid=d.get("bestModelUID", ""),
+            best_model_name=d.get("bestModelName", ""),
+            best_model_type=d.get("bestModelType", ""),
+            best_grid=d.get("bestGrid", {}),
+            validation_results=d.get("validationResults", []),
+            train_evaluation=d.get("trainEvaluation", {}),
+            holdout_evaluation=d.get("holdoutEvaluation"),
+        )
+
+
+class ModelSelector(BinaryEstimator, AllowLabelAsInput):
+    """(RealNN label, OPVector features) -> Prediction, selecting the best of
+    a model grid (ModelSelector.scala:72)."""
+
+    is_model_selector = True
+    problem_type = "Unknown"
+
+    def __init__(self, validator: OpValidator, splitter: Optional[Splitter],
+                 models: Sequence[Tuple[PredictorEstimator, Sequence[Dict[str, Any]]]],
+                 evaluators: Sequence[OpEvaluatorBase] = (),
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="modelSelector", output_type=T.Prediction,
+                         uid=uid)
+        self.validator = validator
+        self.splitter = splitter
+        self.models = [(est, list(grids) or [{}]) for est, grids in models]
+        if not self.models:
+            raise ValueError("ModelSelector needs at least one candidate model")
+        self.evaluators = list(evaluators)
+        self.validation_summary: Optional[ValidationSummary] = None
+
+    def check_input_types(self, features) -> None:
+        super().check_input_types(features)
+        label, vec = features
+        if not label.is_response:
+            raise ValueError("First ModelSelector input (label) must be a response "
+                             "feature (CheckIsResponseValues analog)")
+        if not issubclass(vec.ftype, T.OPVector):
+            raise ValueError("Second ModelSelector input must be OPVector, got "
+                             f"{vec.ftype.__name__}")
+
+    # ---- the sweep on raw arrays (findBestEstimator analog) ----------------
+    def find_best_estimator(self, X: np.ndarray, y: np.ndarray,
+                            prep_w: Optional[np.ndarray] = None
+                            ) -> Tuple[PredictorEstimator, Dict[str, Any],
+                                       ValidationSummary]:
+        summary = self.validator.validate(self.models, X, y, prep_w)
+        best = summary.best
+        est = next(e for e, _ in self.models if e.uid == best.model_uid)
+        return est, best.grid, summary
+
+    # ---- fit (ModelSelector.scala:145) -------------------------------------
+    def fit_columns(self, cols: Sequence[Column], dataset: Dataset) -> "SelectedModel":
+        label_col, vec_col = cols
+        assert isinstance(label_col, NumericColumn) and isinstance(vec_col, VectorColumn)
+        keep = label_col.mask
+        X = vec_col.values[keep]
+        y = label_col.values[keep].astype(np.float32)
+        n = len(y)
+
+        # 1. holdout reservation (splitter.split, Splitter.scala:58)
+        if self.splitter is not None and self.splitter.reserve_test_fraction > 0.0:
+            train_idx, hold_idx = self.splitter.split(n, y)
+        else:
+            train_idx, hold_idx = np.arange(n), np.array([], dtype=np.int64)
+        Xtr, ytr = X[train_idx], y[train_idx]
+
+        # 2. preValidationPrepare (DataBalancer.estimate etc.)
+        prep_summary: Optional[SplitterSummary] = None
+        prep_w = None
+        if self.splitter is not None:
+            prep_summary = self.splitter.pre_validation_prepare(ytr)
+            prep_w = self.splitter.prepare_weights(ytr)
+
+        # 3. the sweep
+        best_est, best_grid, vsummary = self.find_best_estimator(Xtr, ytr, prep_w)
+        self.validation_summary = vsummary
+
+        # 4. final refit on the full prepared train (validationPrepare ->
+        #    bestEstimator.fit, ModelSelector.scala:181)
+        refit = best_est.copy_with_params(best_grid)
+        if self.splitter is not None:
+            ridx = self.splitter.prepare_indices(ytr)
+        else:
+            ridx = np.arange(len(ytr))
+        params = refit.fit_arrays(Xtr[ridx], ytr[ridx])
+
+        # 5. evaluate train + holdout with every evaluator
+        evaluators = self.evaluators or [self.validator.evaluator]
+        pred_tr, raw_tr, prob_tr = refit.predict_arrays(params, Xtr)
+        train_eval: Dict[str, Any] = {}
+        for ev in evaluators:
+            train_eval.update(ev.evaluate_arrays(ytr, np.asarray(pred_tr),
+                                                 None if prob_tr is None
+                                                 else np.asarray(prob_tr)))
+        holdout_eval = None
+        if len(hold_idx):
+            Xho, yho = X[hold_idx], y[hold_idx]
+            pred_ho, _, prob_ho = refit.predict_arrays(params, Xho)
+            holdout_eval = {}
+            for ev in evaluators:
+                holdout_eval.update(ev.evaluate_arrays(yho, np.asarray(pred_ho),
+                                                       None if prob_ho is None
+                                                       else np.asarray(prob_ho)))
+
+        summary = ModelSelectorSummary(
+            validation_type=vsummary.validation_type,
+            validation_parameters={"seed": self.validator.seed,
+                                   "stratify": self.validator.stratify,
+                                   **({"numFolds": getattr(self.validator, "num_folds")}
+                                      if hasattr(self.validator, "num_folds") else {}),
+                                   **({"trainRatio": getattr(self.validator, "train_ratio")}
+                                      if hasattr(self.validator, "train_ratio") else {})},
+            data_prep_parameters=(prep_summary.params if prep_summary else {}),
+            data_prep_results=(prep_summary.prepared if prep_summary else None),
+            evaluation_metric=vsummary.metric_name,
+            problem_type=self.problem_type,
+            best_model_uid=vsummary.best.model_uid,
+            best_model_name=vsummary.best.model_name,
+            best_model_type=vsummary.best.model_type,
+            best_grid=dict(best_grid),
+            validation_results=vsummary.to_json()["results"],
+            train_evaluation=train_eval,
+            holdout_evaluation=holdout_eval,
+        )
+        model = SelectedModel(predictor_class=type(refit), model_params=params,
+                              operation_name=self.operation_name)
+        model.summary = summary
+        model.metadata = dict(self.metadata)
+        model.metadata["model_selector_summary"] = summary.to_json()
+        return model
+
+
+class SelectedModel(PredictorModel):
+    """The winning candidate wrapped as a transformer (ModelSelector.scala:224)."""
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self.summary: Optional[ModelSelectorSummary] = None

@@ -61,6 +61,32 @@ class PredictorEstimator(BinaryEstimator, AllowLabelAsInput):
         merged = {**self._params, **overrides}
         return type(self)(**merged)
 
+    def fit_grid_folds(self, X: np.ndarray, y: np.ndarray, train_w: np.ndarray,
+                       grids: List[Dict[str, Any]]
+                       ) -> List[List[Tuple[np.ndarray, Optional[np.ndarray],
+                                            Optional[np.ndarray]]]]:
+        """Train the whole fold x grid block as one vmapped XLA program.
+
+        train_w: f32[F, n] fold training weights.  Returns predictions on the
+        FULL X, indexed ``[fold][grid] -> (prediction, raw, probability)``.
+        Estimators without a batched kernel raise NotImplementedError and the
+        validator falls back to a per-candidate fit loop.
+        """
+        raise NotImplementedError
+
+    def _grid_param_arrays(self, grids: List[Dict[str, Any]],
+                           allowed: Tuple[str, ...]) -> Dict[str, np.ndarray]:
+        """Extract batchable params as arrays, defaulting to this estimator's
+        values; raises NotImplementedError on any non-batchable key so the
+        validator falls back to the loop path."""
+        for g in grids:
+            for k in g:
+                if k not in allowed:
+                    raise NotImplementedError(f"non-batchable grid param {k}")
+        return {k: np.array([float(g.get(k, self.get_param(k, 0.0))) for g in grids],
+                            np.float32)
+                for k in allowed}
+
     # ---- Dataset-level fit -------------------------------------------------
     def fit_columns(self, cols: Sequence[Column], dataset: Dataset) -> "PredictorModel":
         label_col, vec_col = cols

@@ -1,0 +1,303 @@
+"""Histogram-based decision-tree / forest / boosting kernels — pure XLA.
+
+The reference gets trees from Spark MLlib (RandomForest/GBT/DecisionTree)
+and the XGBoost C++ core over JNI (`build.gradle:90`,
+core/.../impl/classification/OpXGBoostClassifier.scala:47).  On TPU the
+idiomatic formulation is the *histogram method* with static shapes and no
+per-row control flow (SURVEY §7 "Trees/GBT/XGBoost on TPU"):
+
+- features are pre-quantized to ``n_bins`` integer bins (quantile sketch,
+  Spark's maxBins analog),
+- a tree is grown breadth-first, level by level, over a FIXED full binary
+  heap of ``2^(max_depth+1)-1`` nodes; per level the (node, feature, bin)
+  gradient histograms are built with ``segment_sum`` (one scatter per
+  feature, vmapped) and the best split per node is a pure cumsum/argmax
+  reduction — everything batchable on the VPU/MXU,
+- rows carry a node id; the level update is a gather + compare, no branching,
+- second-order (g, h) statistics make the same builder serve XGBoost-style
+  boosting (Newton leaves), RF regression (g = -y: variance gain, mean
+  leaves), and RF classification (g = -onehot(y): gini-equivalent gain,
+  class-distribution leaves),
+- a forest is ``vmap(grow_tree)`` over bootstrap row-weights and feature
+  masks; boosting is ``lax.scan`` over rounds — so a whole RF trains as ONE
+  XLA launch, and boosting compiles to a single fixed-trip loop.
+
+Trees are stored as flat arrays (heap layout): ``split_feat`` (-1 = leaf),
+``split_bin``, ``leaf_val[heap, c]`` — pytree-friendly and trivially
+serializable.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class Tree(NamedTuple):
+    """One tree in heap layout; leading axes may batch trees/rounds."""
+
+    split_feat: jax.Array  # i32[heap]  (-1 => leaf)
+    split_bin: jax.Array   # i32[heap]  (go right if bin > split_bin)
+    leaf_val: jax.Array    # f32[heap, c]
+
+
+# ---------------------------------------------------------------------------
+# Quantization (host side, once per fit) — Spark maxBins / XGBoost sketch
+# ---------------------------------------------------------------------------
+def quantize(X: np.ndarray, n_bins: int = 32) -> Tuple[np.ndarray, np.ndarray]:
+    """Equi-depth binning: returns (X_binned i32[n, d], edges f32[d, n_bins-1]).
+
+    Bin b holds values in (edges[b-1], edges[b]]; value <= edges[0] is bin 0;
+    value > edges[-1] is bin n_bins-1.  Matches Spark's quantile-based
+    continuous-feature splits (maxBins default 32).
+    """
+    X = np.asarray(X, np.float32)
+    n, d = X.shape
+    qs = np.linspace(0.0, 1.0, n_bins + 1)[1:-1]
+    edges = np.quantile(X, qs, axis=0).T.astype(np.float32)  # [d, n_bins-1]
+    # deduplicate edges per feature to avoid empty bins producing NaN gains
+    Xb = np.empty((n, d), np.int32)
+    for j in range(d):
+        Xb[:, j] = np.searchsorted(edges[j], X[:, j], side="left")
+    return Xb, edges
+
+
+def bin_with_edges(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
+    """Apply fitted edges to new data (scoring path)."""
+    X = np.asarray(X, np.float32)
+    n, d = X.shape
+    Xb = np.empty((n, d), np.int32)
+    for j in range(d):
+        Xb[:, j] = np.searchsorted(edges[j], X[:, j], side="left")
+    return Xb
+
+
+# ---------------------------------------------------------------------------
+# Tree growth
+# ---------------------------------------------------------------------------
+def _level_histograms(Xb, gw, hw, node_local, active, m: int, n_bins: int):
+    """Per-(node, feature, bin) stats for one level.
+
+    Xb: i32[n, d]; gw: f32[n, c]; hw: f32[n]; node_local: i32[n] in [0, m).
+    Returns G [m, d, B, c], H [m, d, B].
+    """
+    B = n_bins
+    base = jnp.where(active, node_local * B, m * B)  # overflow bucket for dead rows
+
+    def per_feature(bins_j):
+        seg = base + jnp.where(active, bins_j, 0)
+        G = jax.ops.segment_sum(gw, seg, num_segments=m * B + 1)[:-1]  # [m*B, c]
+        H = jax.ops.segment_sum(hw, seg, num_segments=m * B + 1)[:-1]
+        return G, H
+
+    G, H = jax.vmap(per_feature, in_axes=1, out_axes=0)(Xb)  # [d, m*B, ...]
+    c = gw.shape[1]
+    G = G.reshape(Xb.shape[1], m, B, c).transpose(1, 0, 2, 3)
+    H = H.reshape(Xb.shape[1], m, B).transpose(1, 0, 2)
+    return G, H
+
+
+def grow_tree(Xb, g, h, w, feat_mask, max_depth: int, n_bins: int,
+              reg_lambda: float = 1.0, gamma: float = 0.0,
+              min_child_weight: float = 1.0) -> Tree:
+    """Grow one second-order histogram tree (traceable; static shapes).
+
+    Xb: i32[n, d] pre-binned features; g: f32[n, c] gradients; h: f32[n]
+    hessians; w: f32[n] row weights (bootstrap/balancing; 0 drops the row);
+    feat_mask: f32[d] 1/0 feature subsampling mask.
+
+    Gain (XGBoost): sum_c GL_c^2/(HL+l) + GR_c^2/(HR+l) - GT_c^2/(HT+l);
+    leaf value: -G/(H+l).  With g=-y, h=1, l=0 this is exactly variance-gain
+    splitting with mean leaves (Spark variance impurity), and with
+    g=-onehot(y) it is gini-equivalent splitting with class-distribution
+    leaves (Spark gini impurity).
+    """
+    n, d = Xb.shape
+    c = g.shape[1]
+    B = n_bins
+    heap = 2 ** (max_depth + 1) - 1
+    split_feat = jnp.full((heap,), -1, jnp.int32)
+    split_bin = jnp.zeros((heap,), jnp.int32)
+    leaf_val = jnp.zeros((heap, c), jnp.float32)
+    node_ids = jnp.zeros((n,), jnp.int32)
+    gw = g * w[:, None]
+    hw = h * w
+
+    for t in range(max_depth + 1):
+        offset = 2 ** t - 1
+        m = 2 ** t
+        active = node_ids >= offset
+        node_local = jnp.clip(node_ids - offset, 0, m - 1)
+        G, H = _level_histograms(Xb, gw, hw, node_local, active, m, B)
+        # node totals are identical across features; take feature 0's sums
+        GT = G[:, 0].sum(axis=1)   # [m, c]
+        HT = H[:, 0].sum(axis=1)   # [m]
+        # leaf values for every active node at this level
+        vals = -GT / (HT + reg_lambda)[:, None]      # [m, c]
+        leaf_val = lax.dynamic_update_slice(leaf_val, vals, (offset, 0))
+        if t == max_depth:
+            break
+        # split search: cumulative left stats over bins
+        GL = jnp.cumsum(G, axis=2)                   # [m, d, B, c]
+        HL = jnp.cumsum(H, axis=2)                   # [m, d, B]
+        GR = GT[:, None, None, :] - GL
+        HR = HT[:, None, None] - HL
+
+        def score(Gp, Hp):
+            return (Gp * Gp).sum(axis=-1) / (Hp + reg_lambda)
+
+        gain = score(GL, HL) + score(GR, HR) - score(GT, HT)[:, None, None]  # [m,d,B]
+        valid = (HL >= min_child_weight) & (HR >= min_child_weight)
+        valid &= feat_mask[None, :, None] > 0.0
+        valid &= jnp.arange(B)[None, None, :] < B - 1  # last bin: empty right
+        gain = jnp.where(valid, gain, -jnp.inf)
+        flat = gain.reshape(m, d * B)
+        best = jnp.argmax(flat, axis=1)              # [m]
+        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+        bf = (best // B).astype(jnp.int32)
+        bb = (best % B).astype(jnp.int32)
+        do_split = best_gain > gamma
+        sf = jnp.where(do_split, bf, -1)
+        split_feat = lax.dynamic_update_slice(split_feat, sf, (offset,))
+        split_bin = lax.dynamic_update_slice(split_bin, bb, (offset,))
+        # route rows: gather this node's split; stay put on leaves
+        nf = split_feat[node_ids]                    # [n]
+        nb = split_bin[node_ids]
+        row_bin = jnp.take_along_axis(Xb, jnp.maximum(nf, 0)[:, None], axis=1)[:, 0]
+        go_right = (row_bin > nb).astype(jnp.int32)
+        child = 2 * node_ids + 1 + go_right
+        node_ids = jnp.where((nf >= 0) & active, child, node_ids)
+    return Tree(split_feat, split_bin, leaf_val)
+
+
+def predict_tree(Xb, tree: Tree, max_depth: int) -> jax.Array:
+    """f32[n, c] — walk the fixed-depth heap; rows rest at leaves."""
+    n = Xb.shape[0]
+    node = jnp.zeros((n,), jnp.int32)
+    for _ in range(max_depth):
+        nf = tree.split_feat[node]
+        nb = tree.split_bin[node]
+        row_bin = jnp.take_along_axis(Xb, jnp.maximum(nf, 0)[:, None], axis=1)[:, 0]
+        child = 2 * node + 1 + (row_bin > nb).astype(jnp.int32)
+        node = jnp.where(nf >= 0, child, node)
+    return tree.leaf_val[node]
+
+
+# ---------------------------------------------------------------------------
+# Random forest — vmap over trees
+# ---------------------------------------------------------------------------
+@functools.partial(jax.jit, static_argnames=("max_depth", "n_bins"))
+def fit_forest(Xb, g, h, w_trees, feat_masks, max_depth: int, n_bins: int,
+               reg_lambda: float = 1e-6, min_child_weight: float = 1.0) -> Tree:
+    """Train all trees of a forest in one launch.
+
+    w_trees: f32[T, n] bootstrap weights; feat_masks: f32[T, d].
+    Returns Tree with leading tree axis.
+    """
+
+    def one(wt, fm):
+        return grow_tree(Xb, g, h, wt, fm, max_depth, n_bins,
+                         reg_lambda=reg_lambda, gamma=0.0,
+                         min_child_weight=min_child_weight)
+
+    return jax.vmap(one)(w_trees, feat_masks)
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def predict_forest(Xb, forest: Tree, max_depth: int) -> jax.Array:
+    """Average the trees' leaf vectors: f32[n, c]."""
+    preds = jax.vmap(lambda t: predict_tree(Xb, t, max_depth))(forest)  # [T, n, c]
+    return preds.mean(axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Gradient boosting — lax.scan over rounds
+# ---------------------------------------------------------------------------
+def _grad_hess(loss: str, F, y, Y_onehot):
+    if loss == "squared":
+        return (F[:, 0] - y)[:, None], jnp.ones_like(y)
+    if loss == "logistic":
+        p = jax.nn.sigmoid(F[:, 0])
+        return (p - y)[:, None], jnp.maximum(p * (1 - p), 1e-6)
+    if loss == "softmax":
+        p = jax.nn.softmax(F, axis=-1)
+        # scalar hessian approximation: mean over classes of p(1-p)
+        return p - Y_onehot, jnp.maximum((p * (1 - p)).mean(axis=-1), 1e-6)
+    raise ValueError(f"unknown loss {loss!r}")
+
+
+@functools.partial(jax.jit, static_argnames=("loss", "n_rounds", "max_depth",
+                                             "n_bins", "n_classes"))
+def fit_gbt(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int,
+            max_depth: int, n_bins: int, eta: float = 0.3,
+            reg_lambda: float = 1.0, gamma: float = 0.0,
+            min_child_weight: float = 1.0, base_score: float = 0.0,
+            n_classes: int = 1) -> Tuple[Tree, jax.Array]:
+    """XGBoost-style boosting: scan over rounds, one histogram tree per round.
+
+    row_w_rounds: f32[R, n] subsample weights per round; feat_mask_rounds:
+    f32[R, d] colsample masks.  Multiclass uses multi-output trees (leaf
+    vector per class) — a TPU-friendly variant of per-class tree sets.
+    Returns (stacked Tree [R, ...], final margins F [n, c]).
+    """
+    n = Xb.shape[0]
+    c = n_classes if loss == "softmax" else 1
+    Y = jax.nn.one_hot(y.astype(jnp.int32), max(c, 2), dtype=jnp.float32) \
+        if loss == "softmax" else jnp.zeros((n, 2), jnp.float32)
+    F0 = jnp.full((n, c), base_score, jnp.float32)
+
+    def round_fn(F, xs):
+        rw, fm = xs
+        g, hh = _grad_hess(loss, F, y, Y)
+        tree = grow_tree(Xb, g, hh, w * rw, fm, max_depth, n_bins,
+                         reg_lambda=reg_lambda, gamma=gamma,
+                         min_child_weight=min_child_weight)
+        F = F + eta * predict_tree(Xb, tree, max_depth)
+        return F, tree
+
+    F, trees = lax.scan(round_fn, F0, (row_w_rounds, feat_mask_rounds))
+    return trees, F
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def predict_gbt(Xb, trees: Tree, max_depth: int, eta: float,
+                base_score: float = 0.0) -> jax.Array:
+    """Sum of shrunken tree outputs: f32[n, c]."""
+    preds = jax.vmap(lambda t: predict_tree(Xb, t, max_depth))(trees)  # [R, n, c]
+    return base_score + eta * preds.sum(axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Host-side helpers for subsampling masks
+# ---------------------------------------------------------------------------
+def bootstrap_weights(n: int, n_trees: int, rng: np.random.Generator,
+                      bootstrap: bool = True) -> np.ndarray:
+    """Poisson(1) bootstrap weights (the with-replacement limit Spark uses)."""
+    if not bootstrap:
+        return np.ones((n_trees, n), np.float32)
+    return rng.poisson(1.0, size=(n_trees, n)).astype(np.float32)
+
+
+def feature_masks(d: int, n_trees: int, frac: float,
+                  rng: np.random.Generator) -> np.ndarray:
+    """Per-tree feature-subset masks (featureSubsetStrategy / colsample)."""
+    if frac >= 1.0:
+        return np.ones((n_trees, d), np.float32)
+    k = max(1, int(round(frac * d)))
+    masks = np.zeros((n_trees, d), np.float32)
+    for t in range(n_trees):
+        masks[t, rng.choice(d, size=k, replace=False)] = 1.0
+    return masks
+
+
+def subsample_weights(n: int, n_rounds: int, frac: float,
+                      rng: np.random.Generator) -> np.ndarray:
+    """Per-round row-subsample masks (GBT subsamplingRate / XGB subsample)."""
+    if frac >= 1.0:
+        return np.ones((n_rounds, n), np.float32)
+    return (rng.random((n_rounds, n)) < frac).astype(np.float32)
