@@ -1,0 +1,262 @@
+"""SanityChecker / MinVarianceFilter / OpStatistics tests.
+
+Mirrors the reference's SanityCheckerTest (fixed small matrices with known
+correlations) and OpStatisticsTest semantics.
+"""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu import Dataset, FeatureBuilder, OpWorkflow
+from transmogrifai_tpu.columns import NumericColumn, VectorColumn
+from transmogrifai_tpu.features.metadata import (NULL_INDICATOR, VectorColumnMetadata,
+                                                 VectorMetadata)
+from transmogrifai_tpu.impl.preparators.sanity_checker import (MinVarianceFilter,
+                                                               SanityChecker)
+from transmogrifai_tpu.utils import stats as S
+
+
+# ---------------------------------------------------------------------------
+# OpStatistics kernels
+# ---------------------------------------------------------------------------
+class TestStats:
+    def test_pearson_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(200, 5))
+        y = X[:, 0] * 2 + rng.normal(size=200) * 0.1
+        _, corr, _ = S.correlations_with_label(X, y)
+        expected = [np.corrcoef(X[:, j], y)[0, 1] for j in range(5)]
+        np.testing.assert_allclose(corr, expected, atol=1e-9)
+
+    def test_spearman_is_rank_pearson(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=100)
+        y = np.exp(x)  # monotone -> spearman == 1
+        _, corr, _ = S.correlations_with_label(x[:, None], y, method="spearman")
+        assert corr[0] == pytest.approx(1.0)
+
+    def test_corr_matrix(self):
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(300, 4))
+        X[:, 3] = X[:, 2]  # perfectly correlated pair
+        _, _, cm = S.correlations_with_label(X, rng.normal(size=300), with_corr_matrix=True)
+        np.testing.assert_allclose(np.diag(cm), 1.0, atol=1e-9)
+        assert cm[2, 3] == pytest.approx(1.0)
+        expected = np.corrcoef(X, rowvar=False)
+        np.testing.assert_allclose(cm, expected, atol=1e-5)  # device matmul is f32
+
+    def test_zero_variance_gives_nan(self):
+        X = np.ones((50, 2))
+        X[:, 1] = np.arange(50)
+        _, corr, _ = S.correlations_with_label(X, np.arange(50).astype(float))
+        assert np.isnan(corr[0])
+        assert corr[1] == pytest.approx(1.0)
+
+    def test_chi_squared_known_value(self):
+        # classic 2x2: chi2 = n(ad-bc)^2 / ((a+b)(c+d)(a+c)(b+d))
+        cont = np.array([[10.0, 20.0], [30.0, 5.0]])
+        cv, stat, p = S.chi_squared(cont)
+        n = cont.sum()
+        a, b, c, d = 10, 20, 30, 5
+        expected = n * (a * d - b * c) ** 2 / ((a + b) * (c + d) * (a + c) * (b + d))
+        assert stat == pytest.approx(expected)
+        assert cv == pytest.approx(np.sqrt(expected / n))
+        assert 0 <= p <= 1
+
+    def test_chi_squared_filters_empty_rows(self):
+        # empty OTHER row must not produce NaN (OpStatistics.filterEmpties:141)
+        cont = np.array([[10.0, 20.0], [0.0, 0.0], [30.0, 5.0]])
+        cv, stat, _ = S.chi_squared(cont)
+        cv2, stat2, _ = S.chi_squared(cont[[0, 2]])
+        assert cv == pytest.approx(cv2) and stat == pytest.approx(stat2)
+
+    def test_chi_squared_degenerate_is_nan(self):
+        cv, stat, p = S.chi_squared(np.array([[5.0, 0.0], [3.0, 0.0]]))
+        assert np.isnan(cv) and np.isnan(stat) and np.isnan(p)
+
+    def test_mutual_info_independent_is_zero(self):
+        cont = np.array([[25.0, 25.0], [25.0, 25.0]])
+        pmi, mi = S.pointwise_mutual_info(cont)
+        assert mi == pytest.approx(0.0)
+        np.testing.assert_allclose(pmi["0"], 0.0)
+
+    def test_mutual_info_deterministic_is_entropy(self):
+        # perfectly predictive feature: MI == label entropy (1 bit here)
+        cont = np.array([[50.0, 0.0], [0.0, 50.0]])
+        _, mi = S.pointwise_mutual_info(cont)
+        assert mi == pytest.approx(1.0)
+
+    def test_max_confidences(self):
+        cont = np.array([[30.0, 10.0], [0.0, 0.0], [5.0, 15.0]])
+        conf, support = S.max_confidences(cont)
+        np.testing.assert_allclose(conf, [0.75, 0.0, 0.75])
+        np.testing.assert_allclose(support, [40 / 60, 0.0, 20 / 60])
+
+    def test_contingency_via_onehot_matmul(self):
+        y = np.array([0, 1, 0, 1, 1])
+        X = np.array([[1, 0], [1, 0], [0, 1], [0, 1], [1, 0]], dtype=float)
+        cont = S.contingency_all_columns(X, y, 2)
+        # col0 hits labels [0,1,1]; col1 hits [0,1]
+        np.testing.assert_allclose(cont, [[1, 2], [1, 1]])
+
+
+# ---------------------------------------------------------------------------
+# SanityChecker
+# ---------------------------------------------------------------------------
+def _make_ds(label, X, meta, label_name="label", vec_name="features"):
+    return Dataset({
+        label_name: NumericColumn(T.RealNN, np.asarray(label, float),
+                                  np.ones(len(label), bool)),
+        vec_name: VectorColumn(T.OPVector, np.asarray(X, np.float32), meta),
+    })
+
+
+def _features(label_name="label", vec_name="features"):
+    lbl = FeatureBuilder(label_name, T.RealNN).extract(field=label_name).as_response()
+    vec = FeatureBuilder(vec_name, T.OPVector).extract(field=vec_name).as_predictor()
+    return lbl, vec
+
+
+def _meta(names, **kw):
+    cols = tuple(VectorColumnMetadata((n,), ("Real",), index=i) for i, n in enumerate(names))
+    return VectorMetadata("features", cols)
+
+
+class TestSanityChecker:
+    def test_drops_low_variance_and_leakage(self):
+        rng = np.random.default_rng(3)
+        n = 500
+        y = rng.integers(0, 2, n).astype(float)
+        good = rng.normal(size=n)
+        constant = np.full(n, 3.0)
+        leak = y * 2 - 1 + rng.normal(size=n) * 1e-4  # |corr| ~ 1
+        X = np.column_stack([good, constant, leak])
+        meta = _meta(["good", "constant", "leak"])
+        lbl, vec = _features()
+        checker = SanityChecker(max_correlation=0.95, min_variance=1e-5).set_input(lbl, vec)
+        model = checker.fit(_make_ds(y, X, meta))
+        out = model.transform_columns([None, VectorColumn(T.OPVector, X.astype(np.float32),
+                                                          meta)])
+        assert out.width == 1
+        summary = model.metadata["sanity_checker_summary"]
+        dropped = set(summary["dropped"])
+        assert any("constant" in d for d in dropped)
+        assert any("leak" in d for d in dropped)
+        reasons = summary["reasons"]
+        assert any("variance" in r for rs in reasons.values() for r in rs)
+        assert any("correlation" in r for rs in reasons.values() for r in rs)
+
+    def test_drops_later_of_redundant_pair(self):
+        rng = np.random.default_rng(4)
+        n = 400
+        y = rng.integers(0, 2, n).astype(float)
+        a = rng.normal(size=n)
+        X = np.column_stack([a, a * 1.0000001, rng.normal(size=n)])
+        meta = _meta(["a", "a_copy", "b"])
+        lbl, vec = _features()
+        checker = SanityChecker(max_feature_corr=0.99).set_input(lbl, vec)
+        model = checker.fit(_make_ds(y, X, meta))
+        summary = model.metadata["sanity_checker_summary"]
+        # the LATER column of the pair is dropped (reasonsToRemove takes
+        # featureCorrs only up to the column's own index)
+        assert any("a_copy" in d for d in summary["dropped"])
+        assert not any(d.startswith("a_0") for d in summary["dropped"])
+
+    def test_cramers_v_group_drop(self):
+        rng = np.random.default_rng(5)
+        n = 600
+        y = rng.integers(0, 2, n).astype(float)
+        # categorical that exactly equals the label -> Cramér's V == 1
+        ind_yes = (y == 1).astype(float)
+        ind_no = (y == 0).astype(float)
+        noise = rng.normal(size=n)
+        X = np.column_stack([ind_yes, ind_no, noise])
+        cols = (
+            VectorColumnMetadata(("cat",), ("PickList",), indicator_value="yes", index=0),
+            VectorColumnMetadata(("cat",), ("PickList",), indicator_value="no", index=1),
+            VectorColumnMetadata(("num",), ("Real",), index=2),
+        )
+        meta = VectorMetadata("features", cols)
+        lbl, vec = _features()
+        checker = SanityChecker(max_cramers_v=0.95, max_correlation=2.0,
+                                max_feature_corr=2.0).set_input(lbl, vec)
+        model = checker.fit(_make_ds(y, X, meta))
+        summary = model.metadata["sanity_checker_summary"]
+        assert len(summary["categoricalStats"]) == 1
+        cs = summary["categoricalStats"][0]
+        assert cs["cramersV"] == pytest.approx(1.0, abs=1e-6)
+        assert len(summary["dropped"]) == 2  # whole group gone, noise kept
+        assert model.indices_to_keep.tolist() == [2]
+
+    def test_rule_confidence_drop(self):
+        # one categorical choice perfectly implies the label with full support
+        n = 400
+        y = np.array([0.0, 1.0] * (n // 2))
+        ind = (y == 1).astype(float)
+        X = np.column_stack([ind, 1 - ind])
+        cols = (
+            VectorColumnMetadata(("c",), ("PickList",), indicator_value="x", index=0),
+            VectorColumnMetadata(("c",), ("PickList",), indicator_value="y", index=1),
+        )
+        meta = VectorMetadata("features", cols)
+        lbl, vec = _features()
+        checker = SanityChecker(max_rule_confidence=0.9, min_required_rule_support=0.1,
+                                max_correlation=2.0, max_cramers_v=2.0,
+                                max_feature_corr=2.0).set_input(lbl, vec)
+        model = checker.fit(_make_ds(y, X, meta))
+        reasons = model.metadata["sanity_checker_summary"]["reasons"]
+        assert any("association rule" in r for rs in reasons.values() for r in rs)
+
+    def test_regression_label_skips_categorical_stats(self):
+        rng = np.random.default_rng(6)
+        n = 300
+        y = rng.normal(size=n)  # continuous label
+        X = rng.normal(size=(n, 3))
+        lbl, vec = _features()
+        checker = SanityChecker().set_input(lbl, vec)
+        model = checker.fit(_make_ds(y, X, _meta(["a", "b", "c"])))
+        assert model.metadata["sanity_checker_summary"]["categoricalStats"] == []
+        assert model.indices_to_keep.tolist() == [0, 1, 2]
+
+    def test_label_never_dropped_and_requires_response(self):
+        lbl = FeatureBuilder("label", T.RealNN).extract(field="label").as_predictor()
+        vec = FeatureBuilder("features", T.OPVector).extract(field="features").as_predictor()
+        with pytest.raises(ValueError, match="response"):
+            SanityChecker().set_input(lbl, vec)
+
+    def test_in_workflow(self, titanic_df):
+        from transmogrifai_tpu.impl.classification.logistic import OpLogisticRegression
+        from transmogrifai_tpu.impl.feature.vectorizers import (OneHotVectorizer,
+                                                                RealVectorizer,
+                                                                VectorsCombiner)
+
+        survived = FeatureBuilder("Survived", T.RealNN).extract(field="Survived").as_response()
+        age = FeatureBuilder("Age", T.Real).extract(field="Age").as_predictor()
+        fare = FeatureBuilder("Fare", T.Real).extract(field="Fare").as_predictor()
+        sex = FeatureBuilder("Sex", T.PickList).extract(field="Sex").as_predictor()
+        real_vec = RealVectorizer().set_input(age, fare).get_output()
+        cat_vec = OneHotVectorizer(top_k=10, min_support=1).set_input(sex).get_output()
+        combined = VectorsCombiner().set_input(real_vec, cat_vec).get_output()
+        checked = SanityChecker().set_input(survived, combined).get_output()
+        pred = OpLogisticRegression().set_input(survived, checked).get_output()
+
+        wf = OpWorkflow().set_input_dataset(titanic_df).set_result_features(pred)
+        model = wf.train()
+        scored = model.score()
+        assert pred.name in scored.columns
+        # summary flows into model.summary()
+        assert any("sanity_checker_summary" in str(v) or "dropped" in str(v)
+                   for v in model.summary().values())
+
+
+class TestMinVarianceFilter:
+    def test_drops_constant_columns(self):
+        rng = np.random.default_rng(7)
+        X = np.column_stack([rng.normal(size=100), np.full(100, 2.0)])
+        vec = FeatureBuilder("features", T.OPVector).extract(field="features").as_predictor()
+        filt = MinVarianceFilter().set_input(vec)
+        ds = Dataset({"features": VectorColumn(T.OPVector, X.astype(np.float32),
+                                               _meta(["a", "b"]))})
+        model = filt.fit(ds)
+        assert model.indices_to_keep.tolist() == [0]
+        assert model.metadata["min_variance_summary"]["dropped"] == ["b_1"]

@@ -14,7 +14,6 @@ XGBoost eta=0.3 numRound=100 maxDepth=6 lambda=1.
 """
 from __future__ import annotations
 
-import math
 from typing import Any, Dict, Optional, Tuple
 
 import jax.numpy as jnp
@@ -22,32 +21,21 @@ import numpy as np
 
 from ...ops import trees as Tr
 from ..selector.predictor import PredictorEstimator
+from ..trees_common import TreeParamsMixin, gbt_boost_params, xgb_boost_params
 
 
 def _as_f32(x):
     return jnp.asarray(np.asarray(x, np.float32))
 
 
-class _TreeClassifierBase(PredictorEstimator):
+class _TreeClassifierBase(TreeParamsMixin, PredictorEstimator):
     """Shared fit plumbing: quantize once, train, store flat arrays."""
 
     is_classifier = True
+    _auto_subset = "sqrt"  # Spark classification-forest default
 
     def _n_classes(self, y: np.ndarray) -> int:
         return max(int(np.max(y)) + 1 if len(y) else 2, 2)
-
-    def _subset_frac(self, d: int) -> float:
-        strat = str(self.get_param("feature_subset_strategy", "auto"))
-        if strat in ("auto", "sqrt"):
-            return math.sqrt(d) / d
-        if strat == "onethird":
-            return 1.0 / 3.0
-        if strat == "all":
-            return 1.0
-        try:
-            return float(strat)
-        except ValueError:
-            return 1.0
 
 
 class OpRandomForestClassifier(_TreeClassifierBase):
@@ -75,7 +63,9 @@ class OpRandomForestClassifier(_TreeClassifierBase):
         Xb, edges = Tr.quantize(X, n_bins)
         Y = np.eye(k, dtype=np.float32)[np.asarray(y, np.int64)]
         sw = np.ones(n, np.float32) if w is None else np.asarray(w, np.float32)
-        wt = Tr.bootstrap_weights(n, n_trees, rng) * sw[None, :]
+        wt = Tr.bootstrap_weights(n, n_trees, rng,
+                                  rate=float(self.get_param("subsampling_rate", 1.0))
+                                  ) * sw[None, :]
         fms = Tr.feature_masks(d, n_trees, self._subset_frac(d), rng)
         forest = Tr.fit_forest(jnp.asarray(Xb), jnp.asarray(-Y), _as_f32(np.ones(n)),
                                jnp.asarray(wt), jnp.asarray(fms),
@@ -203,13 +193,7 @@ class OpGBTClassifier(_BoostedClassifierBase):
                          **extra)
 
     def _boost_params(self):
-        return {"n_rounds": int(self.get_param("max_iter", 20)),
-                "max_depth": int(self.get_param("max_depth", 5)),
-                "n_bins": int(self.get_param("max_bins", 32)),
-                "eta": float(self.get_param("step_size", 0.1)),
-                "subsample": float(self.get_param("subsampling_rate", 1.0)),
-                "colsample": 1.0, "reg_lambda": 1e-6, "gamma": 0.0,
-                "min_child_weight": float(self.get_param("min_instances_per_node", 1))}
+        return gbt_boost_params(self)
 
 
 class OpXGBoostClassifier(_BoostedClassifierBase):
@@ -227,12 +211,4 @@ class OpXGBoostClassifier(_BoostedClassifierBase):
                          colsample_bytree=colsample_bytree, seed=seed, **extra)
 
     def _boost_params(self):
-        return {"n_rounds": int(self.get_param("num_round", 100)),
-                "max_depth": int(self.get_param("max_depth", 6)),
-                "n_bins": int(self.get_param("max_bins", 64)),
-                "eta": float(self.get_param("eta", 0.3)),
-                "subsample": float(self.get_param("subsample", 1.0)),
-                "colsample": float(self.get_param("colsample_bytree", 1.0)),
-                "reg_lambda": float(self.get_param("reg_lambda", 1.0)),
-                "gamma": float(self.get_param("gamma", 0.0)),
-                "min_child_weight": float(self.get_param("min_child_weight", 1.0))}
+        return xgb_boost_params(self)

@@ -7,7 +7,6 @@ splitting falls out of the second-order gain with g=-y, h=1.
 """
 from __future__ import annotations
 
-import math
 from typing import Any, Dict, Optional, Tuple
 
 import jax.numpy as jnp
@@ -15,25 +14,12 @@ import numpy as np
 
 from ...ops import trees as Tr
 from ..selector.predictor import PredictorEstimator
+from ..trees_common import TreeParamsMixin, gbt_boost_params, xgb_boost_params
 
 
-class _TreeRegressorBase(PredictorEstimator):
+class _TreeRegressorBase(TreeParamsMixin, PredictorEstimator):
     is_classifier = False
-
-    def _subset_frac(self, d: int) -> float:
-        strat = str(self.get_param("feature_subset_strategy", "auto"))
-        if strat == "auto":
-            return 1.0 / 3.0  # Spark regression default: onethird
-        if strat == "sqrt":
-            return math.sqrt(d) / d
-        if strat == "onethird":
-            return 1.0 / 3.0
-        if strat == "all":
-            return 1.0
-        try:
-            return float(strat)
-        except ValueError:
-            return 1.0
+    _auto_subset = "onethird"  # Spark regression-forest default
 
 
 class OpRandomForestRegressor(_TreeRegressorBase):
@@ -57,7 +43,9 @@ class OpRandomForestRegressor(_TreeRegressorBase):
         rng = np.random.default_rng(int(self.get_param("seed", 42)))
         Xb, edges = Tr.quantize(X, n_bins)
         sw = np.ones(n, np.float32) if w is None else np.asarray(w, np.float32)
-        wt = Tr.bootstrap_weights(n, n_trees, rng) * sw[None, :]
+        wt = Tr.bootstrap_weights(n, n_trees, rng,
+                                  rate=float(self.get_param("subsampling_rate", 1.0))
+                                  ) * sw[None, :]
         fms = Tr.feature_masks(d, n_trees, self._subset_frac(d), rng)
         g = jnp.asarray(-np.asarray(y, np.float32)[:, None])
         forest = Tr.fit_forest(jnp.asarray(Xb), g, jnp.ones(n, jnp.float32),
@@ -165,13 +153,7 @@ class OpGBTRegressor(_BoostedRegressorBase):
                          **extra)
 
     def _boost_params(self):
-        return {"n_rounds": int(self.get_param("max_iter", 20)),
-                "max_depth": int(self.get_param("max_depth", 5)),
-                "n_bins": int(self.get_param("max_bins", 32)),
-                "eta": float(self.get_param("step_size", 0.1)),
-                "subsample": float(self.get_param("subsampling_rate", 1.0)),
-                "colsample": 1.0, "reg_lambda": 1e-6, "gamma": 0.0,
-                "min_child_weight": float(self.get_param("min_instances_per_node", 1))}
+        return gbt_boost_params(self)
 
 
 class OpXGBoostRegressor(_BoostedRegressorBase):
@@ -187,12 +169,4 @@ class OpXGBoostRegressor(_BoostedRegressorBase):
                          colsample_bytree=colsample_bytree, seed=seed, **extra)
 
     def _boost_params(self):
-        return {"n_rounds": int(self.get_param("num_round", 100)),
-                "max_depth": int(self.get_param("max_depth", 6)),
-                "n_bins": int(self.get_param("max_bins", 64)),
-                "eta": float(self.get_param("eta", 0.3)),
-                "subsample": float(self.get_param("subsample", 1.0)),
-                "colsample": float(self.get_param("colsample_bytree", 1.0)),
-                "reg_lambda": float(self.get_param("reg_lambda", 1.0)),
-                "gamma": float(self.get_param("gamma", 0.0)),
-                "min_child_weight": float(self.get_param("min_child_weight", 1.0))}
+        return xgb_boost_params(self)

@@ -174,12 +174,15 @@ class ModelSelector(BinaryEstimator, AllowLabelAsInput):
             ridx = np.arange(len(ytr))
         params = refit.fit_arrays(Xtr[ridx], ytr[ridx])
 
-        # 5. evaluate train + holdout with every evaluator
+        # 5. evaluate train + holdout with every evaluator; train metrics are
+        #    computed on the PREPARED training data (the reference evaluates
+        #    after validationPrepare — e.g. DataCutter-dropped labels are not
+        #    counted as guaranteed errors, ModelSelector.scala:181-187)
         evaluators = self.evaluators or [self.validator.evaluator]
-        pred_tr, raw_tr, prob_tr = refit.predict_arrays(params, Xtr)
+        pred_tr, raw_tr, prob_tr = refit.predict_arrays(params, Xtr[ridx])
         train_eval: Dict[str, Any] = {}
         for ev in evaluators:
-            train_eval.update(ev.evaluate_arrays(ytr, np.asarray(pred_tr),
+            train_eval.update(ev.evaluate_arrays(ytr[ridx], np.asarray(pred_tr),
                                                  None if prob_tr is None
                                                  else np.asarray(prob_tr)))
         holdout_eval = None

@@ -1,0 +1,70 @@
+"""Shared tree-model parameter plumbing for classifiers and regressors.
+
+Reference parity: the Spark tree params surfaced by
+core/.../impl/classification/OpRandomForestClassifier.scala and
+impl/regression/OpRandomForestRegressor.scala (featureSubsetStrategy,
+subsamplingRate) and the boosting params of OpGBT*/OpXGBoost* wrappers.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict
+
+_SUBSET_STRATEGIES = ("auto", "all", "sqrt", "log2", "onethird")
+
+
+class TreeParamsMixin:
+    """Spark featureSubsetStrategy resolution shared by all tree models.
+
+    ``_auto_subset_frac`` is what "auto" maps to: sqrt for classification
+    forests, onethird for regression forests (Spark RandomForestParams).
+    """
+
+    #: overridden per subclass ("sqrt" | "onethird" | "all")
+    _auto_subset: str = "sqrt"
+
+    def _subset_frac(self, d: int) -> float:
+        strat = str(self.get_param("feature_subset_strategy", "auto")).lower()
+        if strat == "auto":
+            strat = self._auto_subset
+        if strat == "all":
+            return 1.0
+        if strat == "sqrt":
+            return math.sqrt(d) / d
+        if strat == "log2":
+            return max(math.log2(max(d, 2)), 1.0) / d
+        if strat == "onethird":
+            return 1.0 / 3.0
+        try:
+            frac = float(strat)
+        except ValueError:
+            raise ValueError(
+                f"Unknown feature_subset_strategy {strat!r}; expected one of "
+                f"{_SUBSET_STRATEGIES} or a fraction in (0, 1]") from None
+        if not 0.0 < frac <= 1.0:
+            raise ValueError(f"feature_subset_strategy fraction must be in (0, 1], got {frac}")
+        return frac
+
+
+def gbt_boost_params(stage) -> Dict[str, Any]:
+    """Spark GBT param dict (maxIter/stepSize/subsamplingRate…)."""
+    return {"n_rounds": int(stage.get_param("max_iter", 20)),
+            "max_depth": int(stage.get_param("max_depth", 5)),
+            "n_bins": int(stage.get_param("max_bins", 32)),
+            "eta": float(stage.get_param("step_size", 0.1)),
+            "subsample": float(stage.get_param("subsampling_rate", 1.0)),
+            "colsample": 1.0, "reg_lambda": 1e-6, "gamma": 0.0,
+            "min_child_weight": float(stage.get_param("min_instances_per_node", 1))}
+
+
+def xgb_boost_params(stage) -> Dict[str, Any]:
+    """XGBoost param dict (numRound/eta/lambda/gamma/subsample/colsample)."""
+    return {"n_rounds": int(stage.get_param("num_round", 100)),
+            "max_depth": int(stage.get_param("max_depth", 6)),
+            "n_bins": int(stage.get_param("max_bins", 64)),
+            "eta": float(stage.get_param("eta", 0.3)),
+            "subsample": float(stage.get_param("subsample", 1.0)),
+            "colsample": float(stage.get_param("colsample_bytree", 1.0)),
+            "reg_lambda": float(stage.get_param("reg_lambda", 1.0)),
+            "gamma": float(stage.get_param("gamma", 0.0)),
+            "min_child_weight": float(stage.get_param("min_child_weight", 1.0))}

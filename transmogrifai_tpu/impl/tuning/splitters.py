@@ -163,6 +163,28 @@ class DataBalancer(Splitter):
         self._down = 1.0
         self._minority_is_positive = True
 
+    @staticmethod
+    def get_proportions(small: float, big: float, sample_f: float,
+                        max_training_sample: int) -> Tuple[float, float]:
+        """(down_sample, up_sample) — exact port of
+        DataBalancer.getProportions (DataBalancer.scala:84-114): the minority
+        is upsampled by the largest multiplier from {100,50,10,5,4,3,2}
+        that keeps it under the target fraction and under the training cap,
+        then the majority is downsampled to hit the fraction exactly; if the
+        minority alone already exceeds ``maxTrainingSample * sampleF``, both
+        classes are downsampled to the capped size."""
+        def up_ok(m: int) -> bool:
+            return (m * small * (1 - sample_f) < sample_f * big
+                    and max_training_sample * sample_f > small * m)
+
+        if small < max_training_sample * sample_f:
+            up = next((float(m) for m in (100, 50, 10, 5, 4, 3, 2) if up_ok(m)), 1.0)
+            down = (small * up / sample_f - small * up) / big if big > 0 else 1.0
+            return down, up
+        up = (max_training_sample * sample_f) / small
+        down = (1 - sample_f) * max_training_sample / big if big > 0 else 1.0
+        return down, up
+
     def pre_validation_prepare(self, y: np.ndarray) -> SplitterSummary:
         y = np.asarray(y)
         n = max(len(y), 1)
@@ -172,19 +194,14 @@ class DataBalancer(Splitter):
         self._minority_is_positive = pos <= neg
         frac = small / n
         p = self.sample_fraction
-        balanced = frac >= p or small == 0
+        # an explicit already_balanced=True (isDataBalanced) skips rebalancing
+        balanced = self.already_balanced is True or frac >= p or small == 0
         self.already_balanced = balanced
         if balanced:
             self._up, self._down = 1.0, 1.0
         else:
-            # reference getProportions: either downsample the majority or
-            # upsample the minority so small/(small*up + big*down) == p,
-            # respecting maxTrainingSample
-            target_big = small * (1.0 - p) / p
-            if target_big <= big:
-                self._up, self._down = 1.0, target_big / big
-            else:
-                self._up, self._down = (p * big) / ((1.0 - p) * small), 1.0
+            self._down, self._up = self.get_proportions(
+                small, big, p, self.max_training_sample)
         self.summary = SplitterSummary(
             type(self).__name__, self._params(),
             prepared={"positiveFraction": pos / n, "upSample": self._up,
@@ -206,11 +223,15 @@ class DataBalancer(Splitter):
         y = np.asarray(y)
         minority = np.where((y == 1.0) if self._minority_is_positive else (y != 1.0))[0]
         majority = np.setdiff1d(np.arange(len(y)), minority)
-        out = [minority]
-        if self._up > 1.0:
+        out = []
+        if self._up >= 1.0:
+            out.append(minority)
             extra = int(round((self._up - 1.0) * len(minority)))
             if extra > 0 and len(minority):
                 out.append(rng.choice(minority, size=extra, replace=True))
+        elif len(minority):  # capped branch: both classes downsample
+            k = int(round(self._up * len(minority)))
+            out.append(rng.choice(minority, size=k, replace=False))
         if self._down < 1.0:
             k = int(round(self._down * len(majority)))
             out.append(rng.choice(majority, size=k, replace=False))

@@ -276,11 +276,13 @@ def predict_gbt(Xb, trees: Tree, max_depth: int, eta: float,
 # Host-side helpers for subsampling masks
 # ---------------------------------------------------------------------------
 def bootstrap_weights(n: int, n_trees: int, rng: np.random.Generator,
-                      bootstrap: bool = True) -> np.ndarray:
-    """Poisson(1) bootstrap weights (the with-replacement limit Spark uses)."""
+                      bootstrap: bool = True, rate: float = 1.0) -> np.ndarray:
+    """Poisson(rate) bootstrap weights — the with-replacement limit Spark's
+    BaggedPoint uses, with ``rate`` = RF subsamplingRate (each tree sees a
+    bootstrap of expected size ``n * rate``)."""
     if not bootstrap:
         return np.ones((n_trees, n), np.float32)
-    return rng.poisson(1.0, size=(n_trees, n)).astype(np.float32)
+    return rng.poisson(rate, size=(n_trees, n)).astype(np.float32)
 
 
 def feature_masks(d: int, n_trees: int, frac: float,

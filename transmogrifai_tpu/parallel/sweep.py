@@ -63,20 +63,14 @@ def make_fold_weights(n: int, n_folds: int, seed: int = 42,
     return train, val
 
 
-@functools.partial(jax.jit, static_argnames=("max_iter",))
 def fit_logistic_grid_folds(X, y, train_w, l2_grid, max_iter: int = 30):
     """Train every (fold, l2) logistic candidate in one XLA program.
 
     X: f32[n, d]; y: f32[n]; train_w: f32[n_folds, n]; l2_grid: f32[g].
-    Returns coef [n_folds, g, d], intercept [n_folds, g, 1].
+    Returns coef [n_folds, g, d], intercept [n_folds, g, 1].  Thin wrapper
+    over the shared fold×grid kernel in ops/linear.py.
     """
-
-    def fit_one(w, l2):
-        return L.fit_logistic_newton(X, y, w, l2, max_iter=max_iter)
-
-    fit_grid = jax.vmap(fit_one, in_axes=(None, 0))      # over grid
-    fit_all = jax.vmap(fit_grid, in_axes=(0, None))      # over folds
-    res = fit_all(train_w, l2_grid)
+    res = L.fit_logistic_grid_folds_newton(X, y, train_w, l2_grid, max_iter=max_iter)
     return res.coef, res.intercept
 
 

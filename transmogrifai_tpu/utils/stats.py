@@ -1,0 +1,248 @@
+"""Statistics kernels — correlations, contingency stats, column moments.
+
+Reference parity: utils/src/main/scala/com/salesforce/op/utils/stats/OpStatistics.scala
+(``computeCorrelationsWithLabel:71``, ``chiSquaredTest:188``,
+``contingencyStats:300``, ``mutualInfo:234``, ``maxConfidences:280``).
+
+TPU-first design: the reference computes these as Spark treeAggregate passes;
+here every statistic is an XLA reduction over the dense feature matrix:
+
+- column moments + label covariance in ONE fused jit'd pass (matmul-shaped,
+  so XLA tiles it onto the MXU),
+- contingency tables for ALL categorical groups at once as ``X^T @ onehot(y)``
+  — the vectorized columns of a pivoted categorical *are* its indicator
+  one-hots, so a single matmul produces every group's contingency matrix,
+- the optional feature×feature correlation matrix as ``X^T X`` (the O(p²)
+  part the reference computes with Spark's Statistics.corr).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Column moments + correlations (one fused pass)
+# ---------------------------------------------------------------------------
+@dataclass
+class ColStats:
+    """Per-column summary (Statistics.colStats analog)."""
+
+    count: int
+    mean: np.ndarray
+    variance: np.ndarray
+    min: np.ndarray
+    max: np.ndarray
+
+    @property
+    def std(self) -> np.ndarray:
+        return np.sqrt(self.variance)
+
+
+@jax.jit
+def _corr_matrix_kernel(Z):
+    """Correlation matrix of pre-standardized (f64-centered, f32-cast) columns:
+    ``Z^T Z / (n-1)`` — the O(n·p²) MXU matmul (the part worth device time;
+    standardization in f64 on host keeps f32 accumulation well-conditioned)."""
+    n = Z.shape[0]
+    return (Z.T @ Z) / jnp.maximum(n - 1, 1)
+
+
+def _moments(X: np.ndarray, y: np.ndarray):
+    """O(n·d) moments + label covariance in host f64 (exact reference parity;
+    OpStatistics.scala:85-94 uses the n-1 covariance formula)."""
+    n = X.shape[0]
+    mean = X.mean(axis=0)
+    var = X.var(axis=0, ddof=1) if n > 1 else np.zeros_like(mean)
+    xmin = X.min(axis=0)
+    xmax = X.max(axis=0)
+    yc = y - y.mean()
+    cov_label = (X - mean).T @ yc / max(n - 1, 1)
+    y_var = (yc @ yc) / max(n - 1, 1)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        corr = cov_label / np.sqrt(np.maximum(var * y_var, 1e-300))
+    return mean, var, xmin, xmax, corr
+
+
+def col_stats(X: np.ndarray) -> ColStats:
+    """Masked-free column moments (inputs are already filled/dense)."""
+    X = np.asarray(X, dtype=np.float64)
+    if X.shape[0] == 0:
+        d = X.shape[1]
+        z = np.zeros(d)
+        return ColStats(0, z, z.copy(), z.copy(), z.copy())
+    mean, var, xmin, xmax, _ = _moments(X, np.zeros(X.shape[0]))
+    return ColStats(X.shape[0], mean, var, xmin, xmax)
+
+
+def _rank_data(x: np.ndarray) -> np.ndarray:
+    """Average-tie ranks (Spearman prep; matches Spark's Spearman semantics)."""
+    order = np.argsort(x, kind="mergesort")
+    ranks = np.empty_like(order, dtype=np.float64)
+    ranks[order] = np.arange(1, len(x) + 1, dtype=np.float64)
+    # average ranks over ties
+    vals, inv, counts = np.unique(x, return_inverse=True, return_counts=True)
+    sums = np.zeros(len(vals))
+    np.add.at(sums, inv, ranks)
+    return sums[inv] / counts[inv]
+
+
+def correlations_with_label(X: np.ndarray, y: np.ndarray, method: str = "pearson",
+                            with_corr_matrix: bool = False
+                            ) -> Tuple[ColStats, np.ndarray, Optional[np.ndarray]]:
+    """Label correlations for every column (+ optional full feature×feature
+    correlation matrix), in one fused device pass.
+
+    Reference: OpStatistics.computeCorrelationsWithLabel:71; Spearman goes
+    through rank transform first (Spark Statistics.corr(..., "spearman")).
+    Returns (col_stats_of_X, corr_with_label, corr_matrix_or_None).
+    """
+    X = np.asarray(X, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64)
+    n, d = X.shape
+    if n < 2:
+        z = np.zeros(d)
+        return ColStats(n, z, z.copy(), z.copy(), z.copy()), np.full(d, np.nan), None
+    Xr, yr = X, y
+    if method == "spearman":
+        Xr = np.column_stack([_rank_data(X[:, j]) for j in range(d)]) if d else X
+        yr = _rank_data(y)
+    mean, var, xmin, xmax, corr = _moments(Xr, yr)
+    if method == "spearman":
+        # report raw-space moments, rank-space correlations
+        stats = col_stats(X)
+    else:
+        stats = ColStats(n, mean, var, xmin, xmax)
+    zero_var = var <= 0
+    corr = np.where(zero_var, np.nan, corr)
+    corr_matrix = None
+    if with_corr_matrix:
+        std = np.sqrt(np.maximum(var, 1e-300))
+        Z = ((Xr - mean) / std).astype(np.float32)
+        corr_matrix = np.asarray(_corr_matrix_kernel(jnp.asarray(Z)), dtype=np.float64)
+        np.fill_diagonal(corr_matrix, 1.0)
+        corr_matrix[zero_var, :] = np.nan
+        corr_matrix[:, zero_var] = np.nan
+    return stats, corr, corr_matrix
+
+
+# ---------------------------------------------------------------------------
+# Contingency tables via one-hot matmul
+# ---------------------------------------------------------------------------
+@jax.jit
+def _contingency_kernel(X, Y_onehot):
+    return X.T @ Y_onehot
+
+
+def contingency_all_columns(X_indicator: np.ndarray, y_classes: np.ndarray,
+                            n_classes: int) -> np.ndarray:
+    """``counts[j, k] = Σ_i X[i, j] * 1[y_i == k]`` for every indicator column
+    at once — the TPU replacement for the reference's label-grouped contingency
+    reduce (SanityChecker.scala:252-272). One MXU matmul."""
+    Y = np.zeros((len(y_classes), n_classes), dtype=np.float32)
+    Y[np.arange(len(y_classes)), y_classes.astype(int)] = 1.0
+    # f32 integer counts are exact below 2^24 — safe at the 100k sampling cap
+    out = _contingency_kernel(jnp.asarray(X_indicator, dtype=jnp.float32), jnp.asarray(Y))
+    return np.asarray(out, dtype=np.float64)
+
+
+def filter_empties(contingency: np.ndarray) -> np.ndarray:
+    """Strip all-zero rows/cols (OpStatistics.filterEmpties:141 — the always-
+    empty OTHER row from topK pivots must not break the chi-squared test)."""
+    c = np.asarray(contingency, dtype=np.float64)
+    c = c[c.sum(axis=1) > 0][:, None if c.size == 0 else slice(None)]
+    if c.size:
+        c = c[:, c.sum(axis=0) > 0]
+    return c
+
+
+def chi_squared(contingency: np.ndarray) -> Tuple[float, float, float]:
+    """(cramers_v, chi2_stat, p_value) — OpStatistics.chiSquaredTestOnFiltered:202.
+
+    No Yates' correction (explicitly matching the reference). Returns NaNs when
+    the filtered matrix has <2 rows or <2 cols.
+    """
+    c = filter_empties(contingency)
+    r, k = c.shape if c.ndim == 2 else (0, 0)
+    if r < 2 or k < 2:
+        return float("nan"), float("nan"), float("nan")
+    total = c.sum()
+    expected = np.outer(c.sum(axis=1), c.sum(axis=0)) / total
+    stat = float(((c - expected) ** 2 / expected).sum())
+    dof = (r - 1) * (k - 1)
+    p = float(jax.scipy.special.gammaincc(dof / 2.0, stat / 2.0))
+    phi2 = stat / total
+    cramers_v = float(np.sqrt(phi2 / min(r - 1, k - 1)))
+    return cramers_v, stat, p
+
+
+def pointwise_mutual_info(contingency: np.ndarray) -> Tuple[Dict[str, np.ndarray], float]:
+    """PMI per (choice, label) + total MI — OpStatistics.mutualInfo:234.
+
+    Zero-count cells get PMI 0.0 (reference behavior). Returns
+    ({label_index_str: pmi_per_row}, mutual_info).
+    """
+    c = np.asarray(contingency, dtype=np.float64)
+    if c.ndim != 2 or c.size == 0:
+        return {}, float("nan")
+    total = c.sum()
+    row_sums = c.sum(axis=1, keepdims=True)   # per choice
+    col_sums = c.sum(axis=0, keepdims=True)   # per label
+    with np.errstate(divide="ignore", invalid="ignore"):
+        pmi = np.log2(np.maximum(c, 1e-99) * total / (row_sums * col_sums))
+    pmi = np.where((c == 0) | (row_sums == 0) | (col_sums == 0), 0.0, pmi)
+    mi = float((pmi * c / total).sum()) if total > 0 else float("nan")
+    return {str(j): pmi[:, j] for j in range(c.shape[1])}, mi
+
+
+def max_confidences(contingency: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Association-rule (choice => label) max confidence + per-choice support —
+    OpStatistics.maxConfidences:280."""
+    c = np.asarray(contingency, dtype=np.float64)
+    row_sums = c.sum(axis=1)
+    total = row_sums.sum()
+    supports = row_sums / total if total > 0 else np.zeros_like(row_sums)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        conf = np.where(row_sums > 0, c.max(axis=1) / np.maximum(row_sums, 1e-300), 0.0)
+    return conf, supports
+
+
+@dataclass
+class ContingencyStats:
+    """OpStatistics.ContingencyStats analog (OpStatistics.scala:119)."""
+
+    cramers_v: float
+    chi_squared_stat: float
+    p_value: float
+    pointwise_mutual_info: Dict[str, np.ndarray]
+    mutual_info: float
+    max_rule_confidences: np.ndarray
+    supports: np.ndarray
+
+    def to_json(self) -> Dict:
+        return {
+            "cramersV": self.cramers_v,
+            "chiSquaredStat": self.chi_squared_stat,
+            "pValue": self.p_value,
+            "pointwiseMutualInfo": {k: list(v) for k, v in self.pointwise_mutual_info.items()},
+            "mutualInfo": self.mutual_info,
+            "maxRuleConfidences": list(self.max_rule_confidences),
+            "supports": list(self.supports),
+        }
+
+
+def contingency_stats(contingency: np.ndarray) -> ContingencyStats:
+    """All contingency-derived statistics (OpStatistics.contingencyStats:300)."""
+    c = np.asarray(contingency, dtype=np.float64)
+    if c.size == 0 or c.sum() == 0:
+        nrows = c.shape[0] if c.ndim == 2 else 0
+        return ContingencyStats(float("nan"), float("nan"), float("nan"), {},
+                                float("nan"), np.zeros(nrows), np.zeros(nrows))
+    cv, stat, p = chi_squared(c)
+    pmi, mi = pointwise_mutual_info(c)
+    conf, supports = max_confidences(c)
+    return ContingencyStats(cv, stat, p, pmi, mi, conf, supports)
