@@ -1,0 +1,172 @@
+"""ModelInsights + RecordInsightsLOCO tests (reference: ModelInsightsTest,
+RecordInsightsLOCOTest)."""
+import json
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu import Dataset, FeatureBuilder, OpWorkflow
+from transmogrifai_tpu.columns import VectorColumn
+from transmogrifai_tpu.features.metadata import VectorColumnMetadata, VectorMetadata
+from transmogrifai_tpu.impl.classification.logistic import OpLogisticRegression
+from transmogrifai_tpu.impl.feature.vectorizers import (OneHotVectorizer, RealVectorizer,
+                                                        VectorsCombiner)
+from transmogrifai_tpu.impl.insights.model_insights import ModelInsights
+from transmogrifai_tpu.impl.insights.record_insights import (RecordInsightsCorr,
+                                                             RecordInsightsLOCO)
+from transmogrifai_tpu.impl.preparators.sanity_checker import SanityChecker
+from transmogrifai_tpu.impl.selector.factories import BinaryClassificationModelSelector
+
+
+@pytest.fixture(scope="module")
+def fitted_model(titanic_df):
+    survived = FeatureBuilder("Survived", T.RealNN).extract(field="Survived").as_response()
+    age = FeatureBuilder("Age", T.Real).extract(field="Age").as_predictor()
+    fare = FeatureBuilder("Fare", T.Real).extract(field="Fare").as_predictor()
+    sex = FeatureBuilder("Sex", T.PickList).extract(field="Sex").as_predictor()
+    real_vec = RealVectorizer().set_input(age, fare).get_output()
+    cat_vec = OneHotVectorizer(top_k=10, min_support=1).set_input(sex).get_output()
+    combined = VectorsCombiner().set_input(real_vec, cat_vec).get_output()
+    checked = SanityChecker(max_correlation=0.99).set_input(survived, combined).get_output()
+    pred = OpLogisticRegression(reg_param=0.01).set_input(survived, checked).get_output()
+    wf = OpWorkflow().set_input_dataset(titanic_df, key="PassengerId")\
+        .set_result_features(pred)
+    return wf.train(), pred
+
+
+class TestModelInsights:
+    def test_extract_structure(self, fitted_model):
+        model, pred = fitted_model
+        ins = model.model_insights()
+        assert ins.label.label_name is not None
+        assert ins.label.distribution is not None
+        # raw features present with derived columns
+        by_name = {f.feature_name: f for f in ins.features}
+        assert {"Age", "Fare", "Sex"} <= set(by_name)
+        sex = by_name["Sex"]
+        assert sex.feature_type == "PickList"
+        assert len(sex.derived_features) >= 3  # male/female/OTHER/null
+        # derived insights carry stats + corr
+        d0 = by_name["Age"].derived_features[0]
+        assert d0.mean is not None and d0.variance is not None
+        assert d0.corr is not None
+        # linear contributions flow from the fitted coef
+        assert any(d.contribution for f in ins.features for d in f.derived_features)
+
+    def test_categorical_stats_attached(self, fitted_model):
+        model, _ = fitted_model
+        ins = model.model_insights()
+        sex = next(f for f in ins.features if f.feature_name == "Sex")
+        cats = [d for d in sex.derived_features if d.cramers_v is not None]
+        assert cats, "Sex indicator columns should carry Cramér's V"
+
+    def test_json_and_pretty(self, fitted_model):
+        model, _ = fitted_model
+        ins = model.model_insights()
+        parsed = json.loads(ins.to_json())
+        assert {"label", "features", "selectedModelInfo", "trainingParams",
+                "stageInfo"} <= set(parsed)
+        pp = model.summary_pretty()
+        assert "correlations" in pp
+        assert "contributions" in pp
+
+    def test_selector_summary_included(self, titanic_df):
+        survived = FeatureBuilder("Survived", T.RealNN).extract(field="Survived").as_response()
+        age = FeatureBuilder("Age", T.Real).extract(field="Age").as_predictor()
+        fare = FeatureBuilder("Fare", T.Real).extract(field="Fare").as_predictor()
+        vec = RealVectorizer().set_input(age, fare).get_output()
+        feats = VectorsCombiner().set_input(vec).get_output()
+        pred = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=2, model_types=["OpLogisticRegression"],
+        ).set_input(survived, feats).get_output()
+        model = OpWorkflow().set_input_dataset(titanic_df, key="PassengerId")\
+            .set_result_features(pred).train()
+        ins = model.model_insights()
+        assert ins.selected_model_info is not None
+        assert ins.selected_model_info["bestModelType"]
+        pp = ins.pretty_print()
+        assert "Evaluated" in pp and "Selected model" in pp
+
+
+def _loco_fixture():
+    rng = np.random.default_rng(0)
+    n = 300
+    x0 = rng.normal(size=n)
+    x1 = rng.normal(size=n)
+    noise = rng.normal(size=n) * 0.05
+    y = (x0 * 3.0 + noise > 0).astype(np.float64)  # only x0 matters
+    X = np.column_stack([x0, x1]).astype(np.float32)
+    cols = (VectorColumnMetadata(("x0",), ("Real",), index=0),
+            VectorColumnMetadata(("x1",), ("Real",), index=1))
+    meta = VectorMetadata("features", cols)
+    est = OpLogisticRegression(reg_param=1e-4)
+    params = est.fit_arrays(X, y.astype(np.float32))
+    from transmogrifai_tpu.impl.selector.predictor import PredictorModel
+
+    pm = PredictorModel(predictor_class=OpLogisticRegression, model_params=params)
+    return X, meta, pm
+
+
+class TestRecordInsightsLOCO:
+    def test_dominant_feature_wins(self):
+        X, meta, pm = _loco_fixture()
+        feat = FeatureBuilder("features", T.OPVector).extract(field="features").as_predictor()
+        loco = RecordInsightsLOCO(pm, top_k=2).set_input(feat)
+        out = loco.transform_columns([VectorColumn(T.OPVector, X, meta)])
+        assert len(out) == len(X)
+        row = out.values[0]
+        assert set(row) <= {"x0_0", "x1_1"}
+        # x0's |LOCO| must dominate on almost every row
+        wins = 0
+        for i in range(len(X)):
+            m = out.values[i]
+            s0 = abs(json.loads(m["x0_0"])[0][1]) if "x0_0" in m else 0.0
+            s1 = abs(json.loads(m["x1_1"])[0][1]) if "x1_1" in m else 0.0
+            wins += s0 >= s1
+        assert wins > 0.9 * len(X)
+
+    def test_top_k_limits_output(self):
+        X, meta, pm = _loco_fixture()
+        feat = FeatureBuilder("features", T.OPVector).extract(field="features").as_predictor()
+        loco = RecordInsightsLOCO(pm, top_k=1).set_input(feat)
+        out = loco.transform_columns([VectorColumn(T.OPVector, X, meta)])
+        assert all(len(v) == 1 for v in out.values)
+
+    def test_text_group_aggregation(self):
+        # hashed text columns (no indicator/descriptor) aggregate per parent
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(50, 4)).astype(np.float32)
+        cols = (
+            VectorColumnMetadata(("txt",), ("Text",), index=0),
+            VectorColumnMetadata(("txt",), ("Text",), index=1),
+            VectorColumnMetadata(("txt",), ("Text",), index=2),
+            VectorColumnMetadata(("num",), ("Real",), index=3),
+        )
+        meta = VectorMetadata("features", cols)
+        groups = RecordInsightsLOCO._groups(meta, 4)
+        names = [g[0] for g in groups]
+        assert names == ["txt", "num_3"]
+        assert groups[0][1] == [0, 1, 2]
+
+    def test_corr_variant(self):
+        X, meta, pm = _loco_fixture()
+        feat = FeatureBuilder("features", T.OPVector).extract(field="features").as_predictor()
+        corr = RecordInsightsCorr(pm, top_k=2).set_input(feat)
+        out = corr.transform_columns([VectorColumn(T.OPVector, X, meta)])
+        assert len(out) == len(X) and all(len(v) <= 2 for v in out.values)
+
+    def test_in_workflow(self, fitted_model, titanic_df):
+        model, pred = fitted_model
+        # attach LOCO over the checked vector using the fitted selector model
+        selected = model.get_origin_stage_of(pred)
+        checked_feature = selected.inputs[1]
+        loco = RecordInsightsLOCO(selected, top_k=3).set_input(checked_feature)
+        # score the training data up to the checked vector, then LOCO it
+        from transmogrifai_tpu.workflow import dag as dag_util
+
+        full = dag_util.apply_transformations_dag(
+            model._generate_raw_data(None), model.dag)
+        out = loco.transform_columns([full[checked_feature.name]])
+        assert len(out) == len(full)
+        assert all(isinstance(v, dict) and len(v) <= 3 for v in out.values)

@@ -1,0 +1,223 @@
+"""RawFeatureFilter tests — distribution math + exclusion decisions +
+workflow blocklist propagation (reference: RawFeatureFilterTest,
+FeatureDistributionTest)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu import Dataset, FeatureBuilder, OpWorkflow
+from transmogrifai_tpu.impl.filters.raw_feature_filter import (
+    FeatureDistribution, RawFeatureFilter, compute_feature_stats)
+from transmogrifai_tpu.readers.base import CustomReader
+
+
+def _fd(dist, count=10, nulls=0, name="f", key=None):
+    return FeatureDistribution(name, key, count, nulls,
+                               np.asarray(dist, float), np.array([]))
+
+
+class TestFeatureDistribution:
+    def test_fill_rate(self):
+        assert _fd([1], count=10, nulls=4).fill_rate() == pytest.approx(0.6)
+        assert _fd([1], count=0, nulls=0).fill_rate() == 0.0
+
+    def test_relative_fill(self):
+        a, b = _fd([1], 10, 5), _fd([1], 10, 0)
+        assert a.relative_fill_rate(b) == pytest.approx(0.5)
+        assert a.relative_fill_ratio(b) == pytest.approx(2.0)
+        z = _fd([1], 10, 10)
+        assert a.relative_fill_ratio(z) == float("inf")
+
+    def test_js_divergence_identical_is_zero(self):
+        a = _fd([5, 3, 2])
+        assert a.js_divergence(_fd([5, 3, 2])) == pytest.approx(0.0)
+
+    def test_js_divergence_disjoint_is_one(self):
+        a, b = _fd([10, 0, 0, 0]), _fd([0, 0, 5, 5])
+        assert a.js_divergence(b) == pytest.approx(1.0)
+
+    def test_js_divergence_ignores_both_zero_bins(self):
+        a, b = _fd([5, 0, 5]), _fd([5, 0, 5])
+        assert a.js_divergence(b) == pytest.approx(0.0)
+
+    def test_reduce(self):
+        a, b = _fd([1, 2], count=5, nulls=1), _fd([3, 4], count=7, nulls=2)
+        c = a.reduce(b)
+        assert c.count == 12 and c.nulls == 3
+        np.testing.assert_allclose(c.distribution, [4, 6])
+
+
+def _features():
+    lbl = FeatureBuilder("label", T.RealNN).extract(field="label").as_response()
+    x = FeatureBuilder("x", T.Real).extract(field="x").as_predictor()
+    s = FeatureBuilder("s", T.PickList).extract(field="s").as_predictor()
+    m = FeatureBuilder("m", T.TextMap).extract(field="m").as_predictor()
+    return lbl, x, s, m
+
+
+class TestComputeStats:
+    def test_numeric_histogram_and_scoring_reuses_edges(self):
+        lbl, x, s, m = _features()
+        rng = np.random.default_rng(0)
+        df = pd.DataFrame({"label": rng.integers(0, 2, 100).astype(float),
+                           "x": rng.uniform(0, 10, 100)})
+        data = CustomReader(df).generate_dataset([lbl, x], {})
+        resp, pred = compute_feature_stats(data, [lbl, x], bins=10, dist_type="training")
+        assert len(resp) == 1 and len(pred) == 1
+        d = pred[0]
+        # 10 in-range bins + 1 trailing invalid (out-of-range) bucket
+        assert d.distribution.sum() == 100 and len(d.distribution) == 11
+        assert d.distribution[-1] == 0  # training data is in-range by construction
+        # scoring on shifted data reuses training edges
+        df2 = pd.DataFrame({"label": np.zeros(50), "x": rng.uniform(100, 200, 50)})
+        data2 = CustomReader(df2).generate_dataset([lbl, x], {})
+        _, pred2 = compute_feature_stats(data2, [lbl, x], bins=10, dist_type="scoring",
+                                         train_summary={p.feature_key: p for p in pred})
+        np.testing.assert_allclose(pred2[0].summary_info, d.summary_info)
+        # all scoring mass lands in the invalid bucket -> maximal divergence
+        assert pred2[0].distribution[-1] == 50
+        assert pred2[0].js_divergence(d) == pytest.approx(1.0)
+
+    def test_map_expands_per_key(self):
+        lbl, x, s, m = _features()
+        df = pd.DataFrame({"label": [0.0, 1.0, 0.0],
+                           "m": [{"a": "u", "b": "v"}, {"a": "w"}, None]})
+        data = CustomReader(df).generate_dataset([lbl, m], {})
+        _, pred = compute_feature_stats(data, [lbl, m], bins=8, dist_type="training")
+        keys = sorted(d.key for d in pred)
+        assert keys == ["a", "b"]
+        by_key = {d.key: d for d in pred}
+        assert by_key["a"].nulls == 1  # only the None row
+        assert by_key["b"].nulls == 2
+
+
+class TestRawFeatureFilter:
+    def test_min_fill_drop(self):
+        lbl, x, s, m = _features()
+        n = 1000
+        rng = np.random.default_rng(1)
+        df = pd.DataFrame({
+            "label": rng.integers(0, 2, n).astype(float),
+            "x": np.full(n, np.nan),  # fill rate 0 < minFill
+            "s": rng.choice(["a", "b"], n),
+        })
+        rff = RawFeatureFilter(train_reader=CustomReader(df), min_fill=0.001)
+        res = rff.generate_filtered_raw([lbl, x, s])
+        assert [f.name for f in res.dropped_features] == ["x"]
+        reason = next(r for r in res.exclusion_reasons if r.name == "x")
+        assert reason.training_unfilled_state and reason.excluded
+
+    def test_js_divergence_drop_and_protection(self):
+        lbl, x, s, m = _features()
+        n = 600
+        rng = np.random.default_rng(2)
+        train = pd.DataFrame({"label": rng.integers(0, 2, n).astype(float),
+                              "x": rng.uniform(0, 1, n),
+                              "s": rng.choice(["a", "b"], n)})
+        score = pd.DataFrame({"label": np.zeros(n), "x": rng.uniform(5, 6, n),
+                              "s": rng.choice(["a", "b"], n)})
+        rff = RawFeatureFilter(train_reader=CustomReader(train),
+                               score_reader=CustomReader(score),
+                               max_js_divergence=0.5, min_scoring_rows=100)
+        res = rff.generate_filtered_raw([lbl, x, s])
+        assert [f.name for f in res.dropped_features] == ["x"]
+        # protection suppresses the JS check
+        rff2 = RawFeatureFilter(train_reader=CustomReader(train),
+                                score_reader=CustomReader(score),
+                                max_js_divergence=0.5, min_scoring_rows=100,
+                                js_divergence_protected_features=["x"])
+        assert rff2.generate_filtered_raw([lbl, x, s]).dropped_features == []
+
+    def test_small_scoring_set_skips_comparisons(self):
+        lbl, x, s, m = _features()
+        n = 600
+        rng = np.random.default_rng(3)
+        train = pd.DataFrame({"label": rng.integers(0, 2, n).astype(float),
+                              "x": rng.uniform(0, 1, n)})
+        score = pd.DataFrame({"label": np.zeros(10), "x": rng.uniform(5, 6, 10)})
+        rff = RawFeatureFilter(train_reader=CustomReader(train),
+                               score_reader=CustomReader(score),
+                               max_js_divergence=0.1, min_scoring_rows=500)
+        res = rff.generate_filtered_raw([lbl, x])
+        assert res.dropped_features == []  # scoring too small to compare
+        assert res.scoring_distributions == []
+
+    def test_null_label_leakage_drop(self):
+        lbl, x, s, m = _features()
+        n = 500
+        rng = np.random.default_rng(4)
+        y = rng.integers(0, 2, n).astype(float)
+        # x missing exactly when label=1 -> null indicator corr == 1
+        df = pd.DataFrame({"label": y, "x": np.where(y == 1, np.nan, 1.23)})
+        rff = RawFeatureFilter(train_reader=CustomReader(df), max_correlation=0.9)
+        res = rff.generate_filtered_raw([lbl, x])
+        assert [f.name for f in res.dropped_features] == ["x"]
+        reason = next(r for r in res.exclusion_reasons if r.name == "x")
+        assert reason.training_null_label_leaker
+
+    def test_map_key_dropping(self):
+        lbl, x, s, m = _features()
+        n = 400
+        rng = np.random.default_rng(5)
+        # key "bad" almost never present; key "good" always present
+        maps = [{"good": "v", **({"bad": "w"} if rng.random() < 0.0001 else {})}
+                for _ in range(n)]
+        df = pd.DataFrame({"label": rng.integers(0, 2, n).astype(float), "m": maps})
+        rff = RawFeatureFilter(train_reader=CustomReader(df), min_fill=0.01)
+        res = rff.generate_filtered_raw([lbl, m])
+        assert res.dropped_features == []  # map survives
+        assert res.dropped_map_keys == {"m": ["bad"]}
+        # clean() removes the key from data
+        data = CustomReader(df).generate_dataset([lbl, m], {})
+        cleaned = res.clean(data)
+        assert all("bad" not in (v or {}) for v in cleaned["m"].values)
+
+    def test_workflow_integration_blocklist(self, titanic_df):
+        from transmogrifai_tpu.impl.classification.logistic import OpLogisticRegression
+        from transmogrifai_tpu.impl.feature.vectorizers import (RealVectorizer,
+                                                                VectorsCombiner)
+
+        df = titanic_df.copy()
+        df["useless"] = np.nan  # never filled -> RFF must drop it
+        survived = FeatureBuilder("Survived", T.RealNN).extract(field="Survived").as_response()
+        age = FeatureBuilder("Age", T.Real).extract(field="Age").as_predictor()
+        fare = FeatureBuilder("Fare", T.Real).extract(field="Fare").as_predictor()
+        useless = FeatureBuilder("useless", T.Real).extract(field="useless").as_predictor()
+        vec = RealVectorizer().set_input(age, fare, useless).get_output()
+        feats = VectorsCombiner().set_input(vec).get_output()
+        pred = OpLogisticRegression().set_input(survived, feats).get_output()
+        wf = (OpWorkflow().set_input_dataset(df, key="PassengerId")
+              .set_result_features(pred).with_raw_feature_filter())
+        model = wf.train()
+        assert [f.name for f in wf.blocklisted_features] == ["useless"]
+        assert model.rff_results is not None
+        scored = model.score(df)
+        assert pred.name in scored.columns
+
+    def test_numeric_map_key_vanishing_at_scoring(self):
+        # numeric map key present in training, absent from every scoring row:
+        # the scoring pass must follow the TRAINING distribution type so the
+        # comparison flags the drift instead of crashing on shape mismatch
+        lbl = FeatureBuilder("label", T.RealNN).extract(field="label").as_response()
+        rm = FeatureBuilder("rm", T.RealMap).extract(field="rm").as_predictor()
+        n = 600
+        rng = np.random.default_rng(6)
+        train = pd.DataFrame({"label": rng.integers(0, 2, n).astype(float),
+                              "rm": [{"k": float(rng.uniform())} for _ in range(n)]})
+        score = pd.DataFrame({"label": np.zeros(n), "rm": [{} for _ in range(n)]})
+        rff = RawFeatureFilter(train_reader=CustomReader(train),
+                               score_reader=CustomReader(score),
+                               min_scoring_rows=100)
+        res = rff.generate_filtered_raw([lbl, rm])  # must not raise
+        m = next(x for x in res.metrics if x.key == "k")
+        assert m.scoring_fill_rate == 0.0
+        assert res.dropped_features and res.dropped_features[0].name == "rm"
+
+    def test_invalid_params_raise(self):
+        with pytest.raises(ValueError):
+            RawFeatureFilter(min_fill=1.5)
+        with pytest.raises(ValueError):
+            RawFeatureFilter(max_js_divergence=-0.1)
+        with pytest.raises(ValueError, match="training reader"):
+            RawFeatureFilter().generate_filtered_raw([])

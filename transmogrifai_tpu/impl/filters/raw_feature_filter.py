@@ -1,0 +1,539 @@
+"""RawFeatureFilter — pre-modeling raw-feature QA (train vs scoring drift).
+
+Reference parity: core/src/main/scala/com/salesforce/op/filters/
+RawFeatureFilter.scala:90 (defaults from OpWorkflow.withRawFeatureFilter:544:
+bins=100, minFill=0.001, maxFillDifference=0.90, maxFillRatioDiff=20.0,
+maxJSDivergence=0.90, maxCorrelation=0.95, minScoringRows=500),
+FeatureDistribution.scala:58 (fillRate:94, relativeFillRatio:125,
+relativeFillRate:138, jsDivergence:149, reduce:102), Summary.scala:43,
+PreparedFeatures.scala:48, exclusion logic RawFeatureFilter.scala:300-445,
+generateFilteredRaw:486.
+
+Per-feature distributions:
+
+- numerics/dates -> equi-width histogram over the TRAINING min/max (scoring
+  reuses the training bin edges so divergences compare like with like),
+- text/sets/lists -> token counts hashed into ``text_bins`` buckets,
+- map features -> one distribution per observed key (map keys can be dropped
+  individually while the feature survives),
+- every distribution tracks count/nulls for the fill-rate family of checks,
+- null-indicator-vs-label correlation catches leakage through missingness.
+
+The histogram fills are vectorized host-side (columnar batches in, one
+``np.bincount``/``np.searchsorted`` per feature); the decision logic is exact
+reference arithmetic.
+"""
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ... import types as T
+from ...columns import Column, Dataset, NumericColumn, ObjectColumn
+from ...features.feature import Feature
+from ...readers.base import Reader
+
+
+# ---------------------------------------------------------------------------
+# Summary + FeatureDistribution
+# ---------------------------------------------------------------------------
+@dataclass
+class Summary:
+    """min/max/sum/count of a feature's values (Summary.scala:43); for text,
+    sum = total token count and count = number of texts."""
+
+    min: float = float("inf")
+    max: float = float("-inf")
+    sum: float = 0.0
+    count: float = 0.0
+
+    def to_json(self) -> Dict[str, float]:
+        return {"min": self.min, "max": self.max, "sum": self.sum, "count": self.count}
+
+
+def _log2(x: np.ndarray) -> np.ndarray:
+    with np.errstate(divide="ignore"):
+        return np.log2(x)
+
+
+@dataclass
+class FeatureDistribution:
+    """Binned counts + fill info for one feature (or one map key)
+    (FeatureDistribution.scala:58)."""
+
+    name: str
+    key: Optional[str]
+    count: int
+    nulls: int
+    distribution: np.ndarray
+    summary_info: np.ndarray  # bin edges for numerics, [min_tokens, max_tokens] for text
+    dist_type: str = "training"
+
+    @property
+    def feature_key(self) -> Tuple[str, Optional[str]]:
+        return (self.name, self.key)
+
+    def fill_rate(self) -> float:
+        """FeatureDistribution.fillRate:94."""
+        return 0.0 if self.count == 0 else (self.count - self.nulls) / self.count
+
+    def relative_fill_rate(self, other: "FeatureDistribution") -> float:
+        """Absolute fill-rate difference (:138)."""
+        return abs(self.fill_rate() - other.fill_rate())
+
+    def relative_fill_ratio(self, other: "FeatureDistribution") -> float:
+        """Symmetric ratio, larger on top (:125)."""
+        a, b = self.fill_rate(), other.fill_rate()
+        big, small = max(a, b), min(a, b)
+        return float("inf") if small == 0.0 else big / small
+
+    def js_divergence(self, other: "FeatureDistribution") -> float:
+        """Jensen-Shannon divergence in bits (:149): both-zero bins dropped,
+        each distribution normalized, KL terms with a==0 contribute 0."""
+        p, q = np.asarray(self.distribution, float), np.asarray(other.distribution, float)
+        keep = ~((p == 0.0) & (q == 0.0))
+        p, q = p[keep], q[keep]
+        if p.size == 0 or p.sum() == 0.0 or q.sum() == 0.0:
+            return 0.0
+        p, q = p / p.sum(), q / q.sum()
+        m = 0.5 * (p + q)
+        kl_pm = np.where(p == 0.0, 0.0, p * _log2(np.where(p == 0, 1.0, p / m))).sum()
+        kl_qm = np.where(q == 0.0, 0.0, q * _log2(np.where(q == 0, 1.0, q / m))).sum()
+        return float(0.5 * kl_pm + 0.5 * kl_qm)
+
+    def reduce(self, other: "FeatureDistribution") -> "FeatureDistribution":
+        """Monoid combine (:102)."""
+        assert self.feature_key == other.feature_key
+        si = self.summary_info if len(self.summary_info) >= len(other.summary_info) \
+            else other.summary_info
+        return FeatureDistribution(self.name, self.key, self.count + other.count,
+                                   self.nulls + other.nulls,
+                                   self.distribution + other.distribution, si, self.dist_type)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"name": self.name, "key": self.key, "count": self.count,
+                "nulls": self.nulls, "distribution": self.distribution.tolist(),
+                "summaryInfo": self.summary_info.tolist(), "type": self.dist_type}
+
+
+# ---------------------------------------------------------------------------
+# Per-feature distribution computation
+# ---------------------------------------------------------------------------
+def _hash_token(tok: str, bins: int) -> int:
+    """Deterministic token -> bin (the reference hashes tokens with MurmurHash3
+    into ``textBinsFormula(summary, bins)`` buckets; crc32 is our stable hash)."""
+    return zlib.crc32(tok.encode("utf-8", "ignore")) % bins
+
+
+def _tokens_of(v: Any) -> Optional[List[str]]:
+    """Value -> token list; None means null (PreparedFeatures' ProcessedSeq)."""
+    if v is None:
+        return None
+    if isinstance(v, str):
+        return v.split() if v else None
+    if isinstance(v, (list, tuple, set, frozenset)):
+        toks = [str(x) for x in v]
+        return toks if toks else None
+    if isinstance(v, dict):
+        toks = [str(x) for x in v.values()]
+        return toks if toks else None
+    return [str(v)]
+
+
+def _numeric_distribution(name: str, key: Optional[str], vals: np.ndarray,
+                          mask: np.ndarray, bins: int, dist_type: str,
+                          train_edges: Optional[np.ndarray]) -> FeatureDistribution:
+    n = len(vals)
+    present = vals[mask]
+    if train_edges is not None and len(train_edges) > 1:
+        edges = np.asarray(train_edges)
+    elif present.size:
+        lo, hi = float(present.min()), float(present.max())
+        if hi <= lo:
+            hi = lo + 1.0
+        edges = np.linspace(lo, hi, bins + 1)
+    else:
+        edges = np.linspace(0.0, 1.0, bins + 1)
+    hist, _ = np.histogram(present, bins=edges)
+    # out-of-range values land in a trailing "invalid" bucket (the reference
+    # bucketizes with trackInvalid=true, FeatureDistribution.scala:340) so
+    # scoring drift outside the training range still registers as divergence
+    invalid = int(((present < edges[0]) | (present > edges[-1])).sum())
+    full = np.concatenate([hist.astype(np.float64), [float(invalid)]])
+    return FeatureDistribution(name, key, n, int(n - mask.sum()), full, edges, dist_type)
+
+
+def _text_distribution(name: str, key: Optional[str], values: Sequence[Any],
+                       bins: int, dist_type: str) -> FeatureDistribution:
+    dist = np.zeros(bins, dtype=np.float64)
+    nulls = 0
+    n_tokens_min, n_tokens_max = float("inf"), float("-inf")
+    for v in values:
+        toks = _tokens_of(v)
+        if toks is None:
+            nulls += 1
+            continue
+        n_tokens_min = min(n_tokens_min, len(toks))
+        n_tokens_max = max(n_tokens_max, len(toks))
+        for t in toks:
+            dist[_hash_token(t, bins)] += 1.0
+    si = np.array([n_tokens_min, n_tokens_max]) if np.isfinite(n_tokens_max) \
+        else np.array([0.0, 0.0])
+    return FeatureDistribution(name, key, len(values), nulls, dist, si, dist_type)
+
+
+def _is_map_feature(f: Feature) -> bool:
+    return issubclass(f.ftype, T.OPMap) and not issubclass(f.ftype, T.Prediction)
+
+
+def compute_feature_stats(data: Dataset, raw_features: Sequence[Feature], bins: int,
+                          dist_type: str,
+                          train_summary: Optional[Dict[Tuple[str, Optional[str]],
+                                                       FeatureDistribution]] = None
+                          ) -> Tuple[List[FeatureDistribution], List[FeatureDistribution]]:
+    """(response_distributions, predictor_distributions)
+    (RawFeatureFilter.computeFeatureStats:137).  Scoring passes reuse the
+    training bin edges via ``train_summary``."""
+    responses: List[FeatureDistribution] = []
+    predictors: List[FeatureDistribution] = []
+    train_summary = train_summary or {}
+    for f in raw_features:
+        if f.name not in data.columns:
+            continue
+        col = data[f.name]
+        out = responses if f.is_response else predictors
+        if isinstance(col, NumericColumn):
+            prior = train_summary.get((f.name, None))
+            out.append(_numeric_distribution(
+                f.name, None, col.values, col.mask, bins, dist_type,
+                None if prior is None else prior.summary_info))
+        elif _is_map_feature(f) and isinstance(col, ObjectColumn):
+            # one distribution per observed key; numeric-valued maps histogram,
+            # everything else hashes (PreparedFeatures map expansion)
+            keys: List[str] = sorted({k for v in col.values if isinstance(v, dict)
+                                      for k in v})
+            if train_summary:
+                keys = sorted({k for (n, k) in train_summary if n == f.name
+                               and k is not None} | set(keys))
+            for k in keys:
+                vals = [v.get(k) if isinstance(v, dict) else None for v in col.values]
+                prior = train_summary.get((f.name, k))
+                if prior is not None:
+                    # scoring follows the TRAINING distribution's type so the
+                    # histograms stay comparable even when the key vanishes or
+                    # changes type at scoring time (that IS the drift signal);
+                    # numeric distributions carry one slot per bin edge
+                    # (bins + invalid bucket), text ones a [min,max] pair
+                    numeric = len(prior.distribution) == len(prior.summary_info)
+                else:
+                    numeric = all(isinstance(x, (int, float, bool)) or x is None
+                                  for x in vals) \
+                        and any(isinstance(x, (int, float)) and not isinstance(x, bool)
+                                for x in vals)
+                if numeric:
+                    def _coerce(x):
+                        try:
+                            return float(x) if x is not None else None
+                        except (TypeError, ValueError):
+                            return None  # type drift at scoring time -> null
+                    coerced = [_coerce(x) for x in vals]
+                    arr = np.array([x if x is not None else 0.0 for x in coerced])
+                    mask = np.array([x is not None for x in coerced])
+                    out.append(_numeric_distribution(
+                        f.name, k, arr, mask, bins, dist_type,
+                        None if prior is None else prior.summary_info))
+                else:
+                    out.append(_text_distribution(f.name, k, vals, bins, dist_type))
+        elif isinstance(col, ObjectColumn):
+            out.append(_text_distribution(f.name, None, col.values, bins, dist_type))
+        else:  # vector/prediction raw features don't participate
+            continue
+    return responses, predictors
+
+
+# ---------------------------------------------------------------------------
+# Results containers
+# ---------------------------------------------------------------------------
+@dataclass
+class RawFeatureFilterMetrics:
+    """Per-feature metric record (filters/RawFeatureFilterResults.scala)."""
+
+    name: str
+    key: Optional[str]
+    training_fill_rate: float
+    training_null_label_abs_corr: Optional[float]
+    scoring_fill_rate: Optional[float]
+    js_divergence: Optional[float]
+    fill_rate_diff: Optional[float]
+    fill_ratio_diff: Optional[float]
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"name": self.name, "key": self.key,
+                "trainingFillRate": self.training_fill_rate,
+                "trainingNullLabelAbsoluteCorr": self.training_null_label_abs_corr,
+                "scoringFillRate": self.scoring_fill_rate,
+                "jsDivergence": self.js_divergence,
+                "fillRateDiff": self.fill_rate_diff,
+                "fillRatioDiff": self.fill_ratio_diff}
+
+
+@dataclass
+class ExclusionReasons:
+    """Outcome flags of every RFF test for one feature (:445)."""
+
+    name: str
+    key: Optional[str]
+    training_unfilled_state: bool = False
+    training_null_label_leaker: bool = False
+    scoring_unfilled_state: bool = False
+    js_divergence_mismatch: bool = False
+    fill_rate_diff_mismatch: bool = False
+    fill_ratio_diff_mismatch: bool = False
+
+    @property
+    def excluded(self) -> bool:
+        return any([self.training_unfilled_state, self.training_null_label_leaker,
+                    self.scoring_unfilled_state, self.js_divergence_mismatch,
+                    self.fill_rate_diff_mismatch, self.fill_ratio_diff_mismatch])
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"name": self.name, "key": self.key,
+                "trainingUnfilledState": self.training_unfilled_state,
+                "trainingNullLabelLeaker": self.training_null_label_leaker,
+                "scoringUnfilledState": self.scoring_unfilled_state,
+                "jsDivergenceMismatch": self.js_divergence_mismatch,
+                "fillRateDiffMismatch": self.fill_rate_diff_mismatch,
+                "fillRatioDiffMismatch": self.fill_ratio_diff_mismatch,
+                "excluded": self.excluded}
+
+
+@dataclass
+class RawFeatureFilterResults:
+    """Config + metrics + decisions (filters/RawFeatureFilterResults.scala),
+    consumed by OpWorkflow._set_blocklist and ModelInsights."""
+
+    config: Dict[str, Any] = field(default_factory=dict)
+    metrics: List[RawFeatureFilterMetrics] = field(default_factory=list)
+    exclusion_reasons: List[ExclusionReasons] = field(default_factory=list)
+    dropped_features: List[Feature] = field(default_factory=list)
+    dropped_map_keys: Dict[str, List[str]] = field(default_factory=dict)
+    training_distributions: List[FeatureDistribution] = field(default_factory=list)
+    scoring_distributions: List[FeatureDistribution] = field(default_factory=list)
+
+    def clean(self, data: Dataset) -> Dataset:
+        """Drop excluded feature columns + excluded map keys from the data
+        (the cleaned DataFrame of generateFilteredRaw:486)."""
+        drop_names = {f.name for f in self.dropped_features}
+        out = data.drop([n for n in drop_names if n in data.columns])
+        for name, keys in self.dropped_map_keys.items():
+            if name not in out.columns:
+                continue
+            col = out[name]
+            if not isinstance(col, ObjectColumn):
+                continue
+            kset = set(keys)
+            new_vals = np.empty(len(col), dtype=object)
+            for i, v in enumerate(col.values):
+                new_vals[i] = {k: x for k, x in v.items() if k not in kset} \
+                    if isinstance(v, dict) else v
+            out = out.with_column(name, ObjectColumn(col.ftype, new_vals))
+        return out
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "rawFeatureFilterConfig": self.config,
+            "rawFeatureFilterMetrics": [m.to_json() for m in self.metrics],
+            "exclusionReasons": [e.to_json() for e in self.exclusion_reasons],
+            "droppedFeatures": [f.name for f in self.dropped_features],
+            "droppedMapKeys": self.dropped_map_keys,
+            "trainingDistributions": [d.to_json() for d in self.training_distributions],
+            "scoringDistributions": [d.to_json() for d in self.scoring_distributions],
+        }
+
+
+# ---------------------------------------------------------------------------
+# The filter
+# ---------------------------------------------------------------------------
+class RawFeatureFilter:
+    """Train-vs-score distribution QA (RawFeatureFilter.scala:90)."""
+
+    def __init__(self,
+                 train_reader: Optional[Reader] = None,
+                 score_reader: Optional[Reader] = None,
+                 bins: int = 100,
+                 min_fill: float = 0.001,
+                 max_fill_difference: float = 0.90,
+                 max_fill_ratio_diff: float = 20.0,
+                 max_js_divergence: float = 0.90,
+                 max_correlation: float = 0.95,
+                 correlation_type: str = "pearson",
+                 protected_features: Sequence[str] = (),
+                 js_divergence_protected_features: Sequence[str] = (),
+                 min_scoring_rows: int = 500):
+        if not 0.0 <= min_fill <= 1.0:
+            raise ValueError(f"Invalid minFill {min_fill}, must be in [0, 1]")
+        if not 0.0 <= max_fill_difference <= 1.0:
+            raise ValueError(f"Invalid maxFillDifference {max_fill_difference}")
+        if max_fill_ratio_diff < 0.0:
+            raise ValueError(f"Invalid maxFillRatioDiff {max_fill_ratio_diff}")
+        if not 0.0 <= max_js_divergence <= 1.0:
+            raise ValueError(f"Invalid maxJSDivergence {max_js_divergence}")
+        self.train_reader = train_reader
+        self.score_reader = score_reader
+        self.bins = bins
+        self.min_fill = min_fill
+        self.max_fill_difference = max_fill_difference
+        self.max_fill_ratio_diff = max_fill_ratio_diff
+        self.max_js_divergence = max_js_divergence
+        self.max_correlation = max_correlation
+        self.correlation_type = correlation_type
+        self.protected_features = set(protected_features)
+        self.js_protected_features = set(js_divergence_protected_features)
+        self.min_scoring_rows = min_scoring_rows
+
+    def _config_json(self) -> Dict[str, Any]:
+        return {"bins": self.bins, "minFill": self.min_fill,
+                "maxFillDifference": self.max_fill_difference,
+                "maxFillRatioDiff": self.max_fill_ratio_diff,
+                "maxJSDivergence": self.max_js_divergence,
+                "maxCorrelation": self.max_correlation,
+                "correlationType": self.correlation_type,
+                "minScoringRows": self.min_scoring_rows,
+                "protectedFeatures": sorted(self.protected_features),
+                "jsDivergenceProtectedFeatures": sorted(self.js_protected_features)}
+
+    # -- null-indicator label correlation ------------------------------------
+    def _null_label_correlations(self, data: Dataset, raw_features: Sequence[Feature],
+                                 distribs: Sequence[FeatureDistribution]
+                                 ) -> Dict[Tuple[str, Optional[str]], float]:
+        label = next((f for f in raw_features if f.is_response
+                      and f.name in data.columns
+                      and isinstance(data[f.name], NumericColumn)), None)
+        if label is None:
+            return {}
+        lab_col = data[label.name]
+        y = np.where(lab_col.mask, lab_col.values, 0.0)
+        out: Dict[Tuple[str, Optional[str]], float] = {}
+        for d in distribs:
+            col = data.columns.get(d.name)
+            if col is None:
+                continue
+            if isinstance(col, NumericColumn):
+                nulls = (~col.mask).astype(np.float64)
+            elif isinstance(col, ObjectColumn):
+                if d.key is not None:
+                    nulls = np.array([
+                        0.0 if isinstance(v, dict) and _tokens_of(v.get(d.key)) is not None
+                        else 1.0 for v in col.values])
+                else:
+                    nulls = np.array([1.0 if _tokens_of(v) is None else 0.0
+                                      for v in col.values])
+            else:
+                continue
+            if nulls.std() == 0.0 or y.std() == 0.0:
+                continue
+            out[d.feature_key] = float(np.corrcoef(nulls, y)[0, 1])
+        return out
+
+    # -- decision logic (getFeaturesToExclude:445) ---------------------------
+    def _metrics(self, train: List[FeatureDistribution],
+                 score: List[FeatureDistribution],
+                 corr: Dict[Tuple[str, Optional[str]], float]
+                 ) -> List[RawFeatureFilterMetrics]:
+        score_by_key = {d.feature_key: d for d in score}
+        out = []
+        for d in train:
+            s = score_by_key.get(d.feature_key)
+            out.append(RawFeatureFilterMetrics(
+                name=d.name, key=d.key,
+                training_fill_rate=d.fill_rate(),
+                training_null_label_abs_corr=(abs(corr[d.feature_key])
+                                              if d.feature_key in corr else None),
+                scoring_fill_rate=None if s is None else s.fill_rate(),
+                js_divergence=None if s is None else d.js_divergence(s),
+                fill_rate_diff=None if s is None else d.relative_fill_rate(s),
+                fill_ratio_diff=None if s is None else d.relative_fill_ratio(s)))
+        return out
+
+    def _exclusion_reasons(self, train: List[FeatureDistribution],
+                           metrics: List[RawFeatureFilterMetrics],
+                           have_scoring: bool) -> List[ExclusionReasons]:
+        out = []
+        for d, m in zip(train, metrics):
+            r = ExclusionReasons(name=d.name, key=d.key)
+            r.training_unfilled_state = m.training_fill_rate < self.min_fill
+            r.training_null_label_leaker = (
+                m.training_null_label_abs_corr is not None
+                and m.training_null_label_abs_corr > self.max_correlation)
+            if have_scoring:
+                r.scoring_unfilled_state = (m.scoring_fill_rate is not None
+                                            and m.scoring_fill_rate < self.min_fill)
+                r.js_divergence_mismatch = (
+                    d.name not in self.js_protected_features
+                    and m.js_divergence is not None
+                    and m.js_divergence > self.max_js_divergence)
+                r.fill_rate_diff_mismatch = (m.fill_rate_diff is not None
+                                             and m.fill_rate_diff > self.max_fill_difference)
+                r.fill_ratio_diff_mismatch = (m.fill_ratio_diff is not None
+                                              and m.fill_ratio_diff > self.max_fill_ratio_diff)
+            out.append(r)
+        return out
+
+    # -- main entry (generateFilteredRaw:486) --------------------------------
+    def generate_filtered_raw(self, raw_features: Sequence[Feature],
+                              train_reader: Optional[Reader] = None,
+                              parameters: Any = None) -> RawFeatureFilterResults:
+        reader = train_reader or self.train_reader
+        if reader is None:
+            raise ValueError("RawFeatureFilter requires a training reader")
+        reader_params = dict(getattr(parameters, "reader_params", {}) or {})
+        train_data = reader.generate_dataset(raw_features, reader_params)
+        if len(train_data) == 0:
+            raise ValueError("RawFeatureFilter cannot work with empty training data")
+        _, train_pred = compute_feature_stats(train_data, raw_features, self.bins,
+                                              "training")
+        train_by_key = {d.feature_key: d for d in train_pred}
+
+        score_pred: List[FeatureDistribution] = []
+        if self.score_reader is not None:
+            score_data = self.score_reader.generate_dataset(raw_features, reader_params)
+            if len(score_data) >= self.min_scoring_rows:
+                _, score_pred = compute_feature_stats(
+                    score_data, raw_features, self.bins, "scoring", train_by_key)
+
+        corr = self._null_label_correlations(train_data, raw_features, train_pred)
+        metrics = self._metrics(train_pred, score_pred, corr)
+        reasons = self._exclusion_reasons(train_pred, metrics, bool(score_pred))
+
+        # protected features never drop (protectedFeatures, :102)
+        excluded = [(d, r) for d, r in zip(train_pred, reasons)
+                    if r.excluded and d.name not in self.protected_features]
+        # a map feature with surviving keys only loses keys; with every key
+        # excluded it drops entirely (getFeaturesToExclude toDropMapKeys)
+        by_name: Dict[str, List[FeatureDistribution]] = {}
+        for d in train_pred:
+            by_name.setdefault(d.name, []).append(d)
+        excluded_names = {}
+        for d, r in excluded:
+            excluded_names.setdefault(d.name, []).append(d)
+        drop_names: List[str] = []
+        drop_map_keys: Dict[str, List[str]] = {}
+        for name, ds in excluded_names.items():
+            if len(ds) == len(by_name[name]):
+                drop_names.append(name)
+            else:
+                drop_map_keys[name] = sorted(d.key for d in ds if d.key is not None)
+
+        feats_by_name = {f.name: f for f in raw_features}
+        return RawFeatureFilterResults(
+            config=self._config_json(),
+            metrics=metrics,
+            exclusion_reasons=reasons,
+            dropped_features=[feats_by_name[n] for n in drop_names if n in feats_by_name],
+            dropped_map_keys=drop_map_keys,
+            training_distributions=train_pred,
+            scoring_distributions=score_pred,
+        )

@@ -1,0 +1,382 @@
+"""ModelInsights — the model-explainability report.
+
+Reference parity: core/src/main/scala/com/salesforce/op/ModelInsights.scala:74
+(``LabelSummary:293`` with Continuous/Discrete label info, ``FeatureInsights:338``,
+``Insights:375`` per derived column, ``extractFromStages:446`` walking the DAG
+for the last ModelSelector/SanityChecker, ``prettyPrint:101`` rendering the
+summary tables).
+
+Everything here is assembled from stage metadata already computed during
+training (SanityChecker summary, ModelSelector summary, RawFeatureFilter
+results, vector provenance) — no data passes.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...features.feature import Feature
+from ...features.metadata import VectorColumnMetadata, VectorMetadata
+
+
+@dataclass
+class LabelSummary:
+    """ModelInsights.LabelSummary:293."""
+
+    label_name: Optional[str] = None
+    raw_feature_name: List[str] = field(default_factory=list)
+    raw_feature_type: List[str] = field(default_factory=list)
+    stages_applied: List[str] = field(default_factory=list)
+    sample_size: Optional[float] = None
+    #: {"type": "Continuous", min/max/mean/variance} or
+    #: {"type": "Discrete", "domain": [...], "prob": [...]}
+    distribution: Optional[Dict[str, Any]] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"labelName": self.label_name, "rawFeatureName": self.raw_feature_name,
+                "rawFeatureType": self.raw_feature_type,
+                "stagesApplied": self.stages_applied, "sampleSize": self.sample_size,
+                "distribution": self.distribution}
+
+
+@dataclass
+class Insights:
+    """Per derived-column insights (ModelInsights.Insights:375)."""
+
+    derived_feature_name: str
+    stages_applied: List[str] = field(default_factory=list)
+    derived_feature_group: Optional[str] = None
+    derived_feature_value: Optional[str] = None
+    excluded: Optional[bool] = None
+    corr: Optional[float] = None
+    cramers_v: Optional[float] = None
+    mutual_information: Optional[float] = None
+    pointwise_mutual_information: Dict[str, float] = field(default_factory=dict)
+    count_matrix: Dict[str, float] = field(default_factory=dict)
+    contribution: List[float] = field(default_factory=list)
+    min: Optional[float] = None
+    max: Optional[float] = None
+    mean: Optional[float] = None
+    variance: Optional[float] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"derivedFeatureName": self.derived_feature_name,
+                "stagesApplied": self.stages_applied,
+                "derivedFeatureGroup": self.derived_feature_group,
+                "derivedFeatureValue": self.derived_feature_value,
+                "excluded": self.excluded, "corr": self.corr,
+                "cramersV": self.cramers_v,
+                "mutualInformation": self.mutual_information,
+                "pointwiseMutualInformation": self.pointwise_mutual_information,
+                "countMatrix": self.count_matrix,
+                "contribution": self.contribution, "min": self.min, "max": self.max,
+                "mean": self.mean, "variance": self.variance}
+
+
+@dataclass
+class FeatureInsights:
+    """All derived insights for one raw feature (ModelInsights:338)."""
+
+    feature_name: str
+    feature_type: str
+    derived_features: List[Insights] = field(default_factory=list)
+    metrics: List[Dict[str, Any]] = field(default_factory=list)
+    distributions: List[Dict[str, Any]] = field(default_factory=list)
+    exclusion_reasons: List[Dict[str, Any]] = field(default_factory=list)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"featureName": self.feature_name, "featureType": self.feature_type,
+                "derivedFeatures": [d.to_json() for d in self.derived_features],
+                "metrics": self.metrics, "distributions": self.distributions,
+                "exclusionReasons": self.exclusion_reasons}
+
+
+@dataclass
+class ModelInsights:
+    """ModelInsights.scala:74."""
+
+    label: LabelSummary
+    features: List[FeatureInsights]
+    selected_model_info: Optional[Dict[str, Any]]
+    training_params: Dict[str, Any]
+    stage_info: Dict[str, Any]
+
+    def to_json(self, pretty: bool = True) -> str:
+        d = {"label": self.label.to_json(),
+             "features": [f.to_json() for f in self.features],
+             "selectedModelInfo": self.selected_model_info,
+             "trainingParams": self.training_params,
+             "stageInfo": self.stage_info}
+        return json.dumps(d, indent=2 if pretty else None, default=str)
+
+    # -- assembly (extractFromStages:446) ------------------------------------
+    @staticmethod
+    def extract_from_stages(model, feature: Optional[Feature] = None) -> "ModelInsights":
+        checker = None
+        selector = None
+        predictor = None
+        for s in model.stages:
+            md = s.metadata or {}
+            if "sanity_checker_summary" in md:
+                checker = s
+            if "model_selector_summary" in md:
+                selector = s
+            if getattr(s, "model_params", None) is not None:
+                predictor = s  # last fitted predictor (SelectedModel or bare)
+
+        sanity = (checker.metadata.get("sanity_checker_summary") if checker else None) or {}
+        selector_summary = (selector.metadata.get("model_selector_summary")
+                            if selector else None)
+        vector_meta = ModelInsights._input_vector_metadata(model, checker,
+                                                           selector or predictor)
+        contributions = ModelInsights._contributions(selector or predictor)
+
+        # per-column sanity lookups
+        names: List[str] = sanity.get("names", [])
+        corr_vals = (sanity.get("correlationsWLabel") or {}).get("values", [])
+        corr_by_name = dict(zip(names, corr_vals))
+        dropped = set(sanity.get("dropped", []))
+        col_stats_by_name = {r.get("name"): r
+                             for r in sanity.get("featuresStatistics", [])}
+        cat_by_col: Dict[str, Dict[str, Any]] = {}
+        for g in sanity.get("categoricalStats", []):
+            feats = g.get("categoricalFeatures", [])
+            for row, cname in enumerate(feats):
+                pmi = {k: (v[row] if row < len(v) else None)
+                       for k, v in (g.get("pointwiseMutualInfo") or {}).items()}
+                cnt = {k: (v[row] if row < len(v) else None)
+                       for k, v in zip((g.get("pointwiseMutualInfo") or {}).keys(),
+                                       np.asarray(g.get("contingencyMatrix", [])).T.tolist()
+                                       if g.get("contingencyMatrix") else [])}
+                cat_by_col[cname] = {"cramersV": g.get("cramersV"),
+                                     "mutualInfo": g.get("mutualInfo"),
+                                     "pmi": pmi, "counts": cnt}
+
+        # group vector columns by raw parent feature
+        feats_out: Dict[str, FeatureInsights] = {}
+        stages_by_parent: Dict[str, List[str]] = {}
+        if vector_meta is not None:
+            kept_contrib = contributions  # aligned with the MODEL input vector
+            for i, cm in enumerate(vector_meta.columns):
+                col_name = cm.make_col_name()
+                parent = cm.parent_feature_name[0] if cm.parent_feature_name else "?"
+                ptype = cm.parent_feature_type[0] if cm.parent_feature_type else "?"
+                fi = feats_out.setdefault(parent, FeatureInsights(parent, ptype))
+                stats = col_stats_by_name.get(col_name, {})
+                cat = cat_by_col.get(col_name, {})
+                if parent not in stages_by_parent:
+                    stages_by_parent[parent] = ModelInsights._stages_applied(model, parent)
+                ins = Insights(
+                    derived_feature_name=col_name,
+                    stages_applied=stages_by_parent[parent],
+                    derived_feature_group=cm.grouping,
+                    derived_feature_value=cm.indicator_value or cm.descriptor_value,
+                    excluded=(col_name in dropped) if names else None,
+                    corr=corr_by_name.get(col_name),
+                    cramers_v=cat.get("cramersV"),
+                    mutual_information=cat.get("mutualInfo"),
+                    pointwise_mutual_information=cat.get("pmi", {}),
+                    count_matrix=cat.get("counts", {}),
+                    contribution=(kept_contrib.get(ModelInsights._col_identity(cm), [])
+                                  if kept_contrib else []),
+                    min=stats.get("min"), max=stats.get("max"),
+                    mean=stats.get("mean"), variance=stats.get("variance"),
+                )
+                fi.derived_features.append(ins)
+
+        # RFF per-raw-feature results
+        rff = getattr(model, "rff_results", None)
+        if rff is not None:
+            for m in rff.metrics:
+                fi = feats_out.get(m.name)
+                if fi is not None:
+                    fi.metrics.append(m.to_json())
+            for d in rff.training_distributions + rff.scoring_distributions:
+                fi = feats_out.get(d.name)
+                if fi is not None:
+                    fi.distributions.append(d.to_json())
+            for e in rff.exclusion_reasons:
+                fi = feats_out.get(e.name)
+                if fi is not None:
+                    fi.exclusion_reasons.append(e.to_json())
+            for f in rff.dropped_features:
+                fi = feats_out.setdefault(f.name,
+                                          FeatureInsights(f.name, f.ftype.__name__))
+                if not fi.exclusion_reasons:
+                    fi.exclusion_reasons = [e.to_json() for e in rff.exclusion_reasons
+                                            if e.name == f.name]
+
+        label = ModelInsights._label_summary(model, sanity)
+        stage_info = {s.uid: {"operationName": s.operation_name,
+                              "class": type(s).__name__, "params": s.params}
+                      for s in model.stages}
+        return ModelInsights(
+            label=label,
+            features=list(feats_out.values()),
+            selected_model_info=selector_summary,
+            training_params=model.parameters.to_json()
+            if hasattr(model.parameters, "to_json") else {},
+            stage_info=stage_info,
+        )
+
+    @staticmethod
+    def _input_vector_metadata(model, checker, selector) -> Optional[VectorMetadata]:
+        """The PRE-drop provenance of the assembled vector: the reference
+        reports every derived column (dropped ones flagged excluded=true), so
+        we want the checker's INPUT metadata — the vectorizer/combiner output —
+        not its post-drop output."""
+        by_uid = {s.uid: s for s in model.stages}
+        for stage in (checker, selector):
+            if stage is None:
+                continue
+            for f in stage.inputs:
+                fitted = by_uid.get(f.origin_stage.uid, f.origin_stage)
+                vm = (fitted.metadata or {}).get("vector_metadata")
+                if vm is not None:
+                    return vm
+        # no checker/selector: fall back to any stage carrying vector metadata
+        for s in reversed(model.stages):
+            vm = (s.metadata or {}).get("vector_metadata")
+            if vm is not None:
+                return vm
+        return None
+
+    @staticmethod
+    def _stages_applied(model, parent_name: str) -> List[str]:
+        out = []
+        for s in model.stages:
+            if any(parent_name in (f.name,) + tuple(
+                    rf.name for rf in f.raw_features()) for f in s.inputs):
+                out.append(s.operation_name)
+        return out
+
+    @staticmethod
+    def _contributions(selector) -> Dict[str, List[float]]:
+        """Model contributions per input-vector column: |coef| for linear
+        models (weight), split-gain importances are not yet tracked for trees
+        (reference gets them from Spark featureImportances)."""
+        if selector is None:
+            return {}
+        params = getattr(selector, "model_params", None)
+        if params is None:
+            return {}
+        coef = params.get("coef")
+        if coef is None:
+            return {}
+        coef = np.atleast_2d(np.asarray(coef, dtype=np.float64))
+        if coef.shape[0] > coef.shape[1]:
+            coef = coef.T
+        # keyed by column identity (not rendered name — post-drop reindexing
+        # changes the name suffix) via the selector's input vector metadata
+        in_meta = None
+        origin = selector.inputs[-1].origin_stage if selector.inputs else None
+        if origin is not None:
+            in_meta = (origin.metadata or {}).get("vector_metadata")
+        out: Dict[Any, List[float]] = {}
+        if in_meta is not None and in_meta.size == coef.shape[1]:
+            for j, cm in enumerate(in_meta.columns):
+                out[ModelInsights._col_identity(cm)] = coef[:, j].tolist()
+        return out
+
+    @staticmethod
+    def _col_identity(cm: VectorColumnMetadata) -> Tuple:
+        return (cm.parent_feature_name, cm.grouping, cm.indicator_value,
+                cm.descriptor_value)
+
+    @staticmethod
+    def _label_summary(model, sanity: Dict[str, Any]) -> LabelSummary:
+        label_feat = next((f for f in model.raw_features if f.is_response), None)
+        resp = next((f for f in model.result_features if f.is_response), label_feat)
+        summary = LabelSummary(label_name=resp.name if resp else None)
+        if label_feat is not None:
+            summary.raw_feature_name = [label_feat.name]
+            summary.raw_feature_type = [label_feat.ftype.__name__]
+        summary.sample_size = sanity.get("sampleSize")
+        stats = next((r for r in sanity.get("featuresStatistics", [])
+                      if r.get("isLabel")), None)
+        if stats is not None:
+            summary.distribution = {"type": "Continuous", "min": stats.get("min"),
+                                    "max": stats.get("max"), "mean": stats.get("mean"),
+                                    "variance": stats.get("variance")}
+        data = getattr(model, "train_data", None)
+        if summary.distribution is None and data is not None and label_feat is not None \
+                and label_feat.name in data.columns:
+            col = data[label_feat.name]
+            vals = np.asarray(getattr(col, "values", []), dtype=np.float64)
+            mask = getattr(col, "mask", None)
+            if mask is not None:
+                vals = vals[np.asarray(mask, bool)]  # missing labels are not class 0
+            if vals.size:
+                uniq, counts = np.unique(vals, return_counts=True)
+                if len(uniq) <= 30 and np.allclose(uniq, np.round(uniq)):
+                    summary.distribution = {
+                        "type": "Discrete",
+                        "domain": [str(v) for v in uniq.tolist()],
+                        "prob": (counts / counts.sum()).tolist()}
+                else:
+                    summary.distribution = {
+                        "type": "Continuous", "min": float(vals.min()),
+                        "max": float(vals.max()), "mean": float(vals.mean()),
+                        "variance": float(vals.var(ddof=1)) if vals.size > 1 else 0.0}
+        return summary
+
+    # -- pretty printing (prettyPrint:101) -----------------------------------
+    def pretty_print(self, top_k: int = 15) -> str:
+        out: List[str] = []
+        smi = self.selected_model_info or {}
+        results = smi.get("validationResults", [])
+        if smi:
+            model_types = sorted({r.get("modelType", "?") for r in results})
+            out.append("Evaluated %s model%s using %s and %s metric." % (
+                ", ".join(model_types), "s" if len(model_types) > 1 else "",
+                smi.get("validationType", "validation"),
+                smi.get("evaluationMetric", "?")))
+            for mt in model_types:
+                vals = [r.get("metricValue") for r in results
+                        if r.get("modelType") == mt and r.get("metricValue") is not None]
+                if vals:
+                    out.append(
+                        "Evaluated %d %s models with %s metric between [%s, %s]."
+                        % (len(vals), mt, smi.get("evaluationMetric", "?"),
+                           min(vals), max(vals)))
+            out.append("+" * 40)
+            out.append("Selected model: %s" % smi.get("bestModelType", "?"))
+            out.append("Best grid: %s" % json.dumps(smi.get("bestGrid", {}), default=str))
+            for split, key in (("train", "trainEvaluation"),
+                               ("holdout", "holdoutEvaluation")):
+                ev = smi.get(key)
+                if ev:
+                    out.append("Model evaluation on %s data:" % split)
+                    for k, v in ev.items():
+                        out.append("  %-24s %s" % (k, v))
+        else:
+            out.append("No model selector found")
+
+        def top_table(title: str, pairs: List[Tuple[str, float]]):
+            if not pairs:
+                return
+            pairs = sorted(pairs, key=lambda t: -abs(t[1]))[:top_k]
+            out.append("+" * 40)
+            out.append(title)
+            for n, v in pairs:
+                out.append("  %-48s %+.4f" % (n[:48], v))
+
+        corrs, contribs, cramers = [], [], []
+        for fi in self.features:
+            for d in fi.derived_features:
+                if d.corr is not None and not (isinstance(d.corr, float)
+                                               and np.isnan(d.corr)):
+                    corrs.append((d.derived_feature_name, float(d.corr)))
+                if d.contribution:
+                    contribs.append((d.derived_feature_name,
+                                     float(np.max(np.abs(d.contribution)))))
+                if d.cramers_v is not None and not (isinstance(d.cramers_v, float)
+                                                    and np.isnan(d.cramers_v)):
+                    cramers.append((d.derived_feature_name, float(d.cramers_v)))
+        top_table("Top model insights computed as correlations", corrs)
+        top_table("Top model insights computed as contributions", contribs)
+        top_table("Top model insights computed as cramersV", cramers)
+        return "\n".join(out)
