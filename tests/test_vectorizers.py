@@ -1,0 +1,388 @@
+"""Vectorizer suite tests — smart text, hashing, maps, dates, geo, bucketizers,
+and the Transmogrifier dispatch (SURVEY §2.3 'Automatic feature engineering')."""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.columns import Dataset, NumericColumn, ObjectColumn
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.impl.feature import (
+    CollectionHashingVectorizer, DateListPivot, DateListVectorizer,
+    DateToUnitCircleTransformer, DecisionTreeNumericBucketizer,
+    GeolocationMapVectorizer, GeolocationVectorizer, HashSpaceStrategy,
+    JaccardSimilarity, LangDetector, MultiPickListMapVectorizer, NGramSimilarity,
+    NumericBucketizer, OpCountVectorizer, OPMapVectorizer, OpHashingTF,
+    OpIndexToString, OpNGram, OpStopWordsRemover, OpStringIndexer,
+    SmartTextMapVectorizer, SmartTextVectorizer, TextLenTransformer,
+    TextMapPivotVectorizer, TextTokenizer, TimePeriod, TimePeriodTransformer,
+    analyze, detect_language, extract_period, hash_term, transmogrify,
+)
+from transmogrifai_tpu.impl.feature.hashing import _murmur3_32_py, murmur3_32
+
+
+def _feat(name, ftype, is_response=False):
+    fb = FeatureBuilder(name, ftype).from_field()
+    return fb.as_response() if is_response else fb.as_predictor()
+
+
+# ---------------------------------------------------------------------------
+# hashing
+# ---------------------------------------------------------------------------
+def test_murmur3_known_vectors():
+    # MurmurHash3 x86_32 reference vectors (seed 0)
+    assert _murmur3_32_py(b"", 0) == 0
+    assert _murmur3_32_py(b"hello", 0) == 0x248BFA47
+    assert _murmur3_32_py(b"hello, world", 0) == 0x149BBB7F
+    assert _murmur3_32_py(b"The quick brown fox jumps over the lazy dog",
+                          0x9747B28C) == 0x2FA826CD
+    # native agrees when present
+    assert murmur3_32(b"hello", 0) == 0x248BFA47
+
+
+def test_hash_term_stable_and_bounded():
+    idx = [hash_term(t, 64) for t in ("a", "b", "c", "a")]
+    assert all(0 <= i < 64 for i in idx)
+    assert idx[0] == idx[3]
+
+
+def test_collection_hashing_shared_vs_separate():
+    f1, f2 = _feat("t1", T.TextList), _feat("t2", T.TextList)
+    ds = Dataset({
+        "t1": ObjectColumn(T.TextList, [["a", "b"], ["a"], []]),
+        "t2": ObjectColumn(T.TextList, [["a"], [], ["z"]]),
+    })
+    sep = CollectionHashingVectorizer(num_features=32,
+                                      hash_space_strategy=HashSpaceStrategy.Separate)
+    sep.set_input(f1, f2)
+    out = sep.transform_dataset(ds)
+    assert out.values.shape == (3, 64 + 2)  # 2 blocks + 2 null cols
+    assert out.values[2, -2:].tolist() == [0.0, 0.0] or out.values.shape[1] == 66
+    shared = CollectionHashingVectorizer(num_features=32,
+                                         hash_space_strategy=HashSpaceStrategy.Shared)
+    shared.set_input(f1, f2)
+    out2 = shared.transform_dataset(ds)
+    assert out2.values.shape == (3, 32 + 2)
+    # row 1: t2 empty -> its null indicator set
+    assert out2.values[1, -1] == 1.0
+
+
+def test_hashing_tf_counts():
+    f = _feat("txt", T.TextList)
+    stage = OpHashingTF(num_features=16)
+    stage.set_input(f)
+    ds = Dataset({"txt": ObjectColumn(T.TextList, [["x", "x", "y"]])})
+    out = stage.transform_dataset(ds)
+    assert out.values.sum() == 3.0
+    assert out.values.max() == 2.0
+
+
+# ---------------------------------------------------------------------------
+# text processing
+# ---------------------------------------------------------------------------
+def test_analyze_and_tokenizer():
+    toks = analyze("The Quick brown FOX, and the dog!")
+    assert "the" not in toks and "and" not in toks
+    assert "quick" in toks and "fox" in toks
+    tok = TextTokenizer()
+    tok.set_input(_feat("t", T.Text))
+    assert tok.transform_fn(T.Text("Hello the World")).value == ["hello", "world"]
+    assert tok.transform_fn(T.Text(None)).value == []
+
+
+def test_lang_detection():
+    lang, conf = detect_language("the quick brown fox jumps over the lazy dog and the cat")
+    assert lang == "en" and conf > 0
+    lang_fr, _ = detect_language("les enfants dans une grande maison avec leurs parents")
+    assert lang_fr == "fr"
+    det = LangDetector()
+    det.set_input(_feat("t", T.Text))
+    assert det.transform_fn(T.Text("the cat and the dog are there")).value == "en"
+
+
+def test_stopwords_ngram_textlen():
+    sw = OpStopWordsRemover()
+    sw.set_input(_feat("t", T.TextList))
+    assert sw.transform_fn(T.TextList(["the", "fox"])).value == ["fox"]
+    ng = OpNGram(n=2)
+    ng.set_input(_feat("t", T.TextList))
+    assert ng.transform_fn(T.TextList(["a", "b", "c"])).value == ["a b", "b c"]
+    tl = TextLenTransformer()
+    tl.set_input(_feat("t", T.Text))
+    assert tl.transform_fn(T.Text("abcd")).value == 4
+    assert tl.transform_fn(T.Text(None)).value == 0
+
+
+def test_count_vectorizer_vocab_and_counts():
+    f = _feat("toks", T.TextList)
+    est = OpCountVectorizer(vocab_size=2, min_df=1)
+    est.set_input(f)
+    ds = Dataset({"toks": ObjectColumn(
+        T.TextList, [["a", "b", "a"], ["b"], ["b", "c"]])})
+    model = est.fit(ds)
+    assert model.vocabulary == ["b", "a"]  # by doc frequency
+    out = model.transform_dataset(ds)
+    assert out.values[0].tolist() == [1.0, 2.0]
+
+
+def test_string_indexer_roundtrip():
+    f = _feat("s", T.Text)
+    est = OpStringIndexer()
+    est.set_input(f)
+    ds = Dataset({"s": ObjectColumn(T.Text, ["x", "y", "x", None])})
+    model = est.fit(ds)
+    out = model.transform_dataset(ds)
+    assert out.values[:3].tolist() == [0.0, 1.0, 0.0]
+    inv = OpIndexToString(labels=model.labels)
+    inv.set_input(_feat("i", T.RealNN))
+    assert inv.transform_fn(T.RealNN(0)).value == "x"
+
+
+def test_similarities():
+    ns = NGramSimilarity(n=2)
+    ns.set_input(_feat("a", T.Text), _feat("b", T.Text))
+    assert ns.transform_fn(T.Text("abc"), T.Text("abc")).value == 1.0
+    assert ns.transform_fn(T.Text("abc"), T.Text("xyz")).value == 0.0
+    js = JaccardSimilarity()
+    js.set_input(_feat("a", T.MultiPickList), _feat("b", T.MultiPickList))
+    assert js.transform_fn(T.MultiPickList({"a", "b"}),
+                           T.MultiPickList({"b", "c"})).value == pytest.approx(1 / 3)
+
+
+# ---------------------------------------------------------------------------
+# smart text
+# ---------------------------------------------------------------------------
+def test_smart_text_categorical_vs_hashed():
+    cat_vals = ["red", "blue", "red", "green", "blue", "red"] * 5
+    txt_vals = [f"unique free text number {i} with words" for i in range(30)]
+    ds = Dataset({"color": ObjectColumn(T.Text, cat_vals),
+                  "desc": ObjectColumn(T.Text, txt_vals)})
+    f1, f2 = _feat("color", T.Text), _feat("desc", T.Text)
+    est = SmartTextVectorizer(max_cardinality=10, top_k=5, min_support=1,
+                              num_hashes=16)
+    est.set_input(f1, f2)
+    model = est.fit(ds)
+    assert model.is_categorical == [True, False]
+    out = model.transform_dataset(ds)
+    # color: 3 cats + OTHER + null = 5; desc: 16 hashes + null = 17
+    assert out.values.shape == (30, 5 + 17)
+    groups = {c.parent_feature_name[0] for c in out.metadata.columns}
+    assert groups == {"color", "desc"}
+
+
+def test_smart_text_map_vectorizer():
+    maps = [{"color": "red", "note": f"long free text {i} here"} for i in range(25)]
+    ds = Dataset({"m": ObjectColumn(T.TextMap, maps)})
+    f = _feat("m", T.TextMap)
+    est = SmartTextMapVectorizer(max_cardinality=5, top_k=3, min_support=1,
+                                 num_hashes=8)
+    est.set_input(f)
+    model = est.fit(ds)
+    assert model.feature_keys == [["color", "note"]]
+    assert model.is_categorical == [[True, False]]
+    out = model.transform_dataset(ds)
+    keys = {c.grouping for c in out.metadata.columns}
+    assert keys == {"color", "note"}
+
+
+# ---------------------------------------------------------------------------
+# maps
+# ---------------------------------------------------------------------------
+def test_op_map_vectorizer_fill_and_nulls():
+    maps = [{"a": 1.0, "b": 2.0}, {"a": 3.0}, {}]
+    ds = Dataset({"m": ObjectColumn(T.RealMap, maps)})
+    f = _feat("m", T.RealMap)
+    est = OPMapVectorizer(fill_with_mean=True)
+    est.set_input(f)
+    model = est.fit(ds)
+    out = model.transform_dataset(ds)
+    # keys a,b -> (value, null) each
+    assert out.values.shape == (3, 4)
+    a_col = out.values[:, 0]
+    assert a_col[1] == 3.0 and a_col[2] == pytest.approx(2.0)  # mean(1,3)
+    assert out.values[2, 1] == 1.0  # null indicator for a at row 2
+
+
+def test_text_map_pivot_and_multipicklist_map():
+    maps = [{"k": "x"}, {"k": "y"}, {"k": "x"}, {}]
+    ds = Dataset({"m": ObjectColumn(T.PickListMap, maps)})
+    f = _feat("m", T.PickListMap)
+    est = TextMapPivotVectorizer(top_k=5, min_support=1)
+    est.set_input(f)
+    out = est.fit(ds).transform_dataset(ds)
+    # x, y, OTHER, null
+    assert out.values.shape == (4, 4)
+    assert out.values[3, 3] == 1.0
+    ds2 = Dataset({"m": ObjectColumn(T.MultiPickListMap,
+                                     [{"k": {"x", "y"}}, {"k": {"x"}}])})
+    est2 = MultiPickListMapVectorizer(top_k=5, min_support=1)
+    est2.set_input(_feat("m", T.MultiPickListMap))
+    out2 = est2.fit(ds2).transform_dataset(ds2)
+    assert out2.values[0, :2].sum() == 2.0  # both x and y set
+
+
+# ---------------------------------------------------------------------------
+# dates
+# ---------------------------------------------------------------------------
+def test_extract_period_known_date():
+    # 2020-03-01T12:00:00Z = 1583064000000 ms; a Sunday
+    ms = np.array([1583064000000])
+    assert extract_period(ms, TimePeriod.HourOfDay)[0] == 12
+    assert extract_period(ms, TimePeriod.DayOfWeek)[0] == 7
+    assert extract_period(ms, TimePeriod.DayOfMonth)[0] == 1
+    assert extract_period(ms, TimePeriod.MonthOfYear)[0] == 3
+    assert extract_period(ms, TimePeriod.DayOfYear)[0] == 61  # leap year
+
+
+def test_date_to_unit_circle():
+    f = _feat("d", T.Date)
+    stage = DateToUnitCircleTransformer(time_period=TimePeriod.HourOfDay)
+    stage.set_input(f)
+    # 00:00 -> angle 0 -> (sin, cos) = (0, 1)
+    ds = Dataset({"d": NumericColumn(T.Date, np.array([0.0]), np.array([True]))})
+    out = stage.transform_dataset(ds)
+    assert out.values[0, 0] == pytest.approx(0.0, abs=1e-6)
+    assert out.values[0, 1] == pytest.approx(1.0, abs=1e-6)
+    # null -> (0, 0)
+    ds2 = Dataset({"d": NumericColumn(T.Date, np.array([0.0]), np.array([False]))})
+    assert np.all(stage.transform_dataset(ds2).values == 0.0)
+
+
+def test_date_list_vectorizer_since_last_and_mode_day():
+    day = 86400000
+    f = _feat("dl", T.DateList)
+    since = DateListVectorizer(pivot=DateListPivot.SinceLast, reference_date_ms=10 * day)
+    since.set_input(f)
+    ds = Dataset({"dl": ObjectColumn(T.DateList, [[day * 2, day * 7], [], [day * 9]])})
+    out = since.transform_dataset(ds)
+    assert out.values[0, 0] == pytest.approx(3.0)   # 10 - 7
+    assert out.values[1, 1] == 1.0                  # null indicator
+    mode = DateListVectorizer(pivot=DateListPivot.ModeDay)
+    mode.set_input(f)
+    out2 = mode.transform_dataset(ds)
+    assert out2.values.shape == (3, 8)  # 7 days + null
+    assert out2.values[0].sum() == 1.0
+
+
+def test_time_period_transformer_row_parity():
+    f = _feat("d", T.Date)
+    tp = TimePeriodTransformer(time_period=TimePeriod.MonthOfYear)
+    tp.set_input(f)
+    ds = Dataset({"d": NumericColumn(T.Date, np.array([1583064000000.0]),
+                                     np.array([True]))})
+    batch = tp.transform_dataset(ds).to_scalar(0)
+    row = tp.transform_row({"d": T.Date(1583064000000)})
+    assert batch.value == row.value == 3
+
+
+# ---------------------------------------------------------------------------
+# geo
+# ---------------------------------------------------------------------------
+def test_geolocation_vectorizer_midpoint_fill():
+    f = _feat("g", T.Geolocation)
+    vals = [[10.0, 20.0, 1.0], [30.0, 40.0, 1.0], []]
+    ds = Dataset({"g": ObjectColumn(T.Geolocation, vals)})
+    est = GeolocationVectorizer()
+    est.set_input(f)
+    model = est.fit(ds)
+    out = model.transform_dataset(ds)
+    assert out.values.shape == (3, 4)
+    # filled row: within the lat/lon bounding box of the data
+    assert 10.0 <= out.values[2, 0] <= 30.0
+    assert 20.0 <= out.values[2, 1] <= 40.0
+    assert out.values[2, 3] == 1.0  # null tracked
+
+
+def test_geolocation_map_vectorizer():
+    f = _feat("gm", T.GeolocationMap)
+    vals = [{"home": [10.0, 20.0, 1.0]}, {"home": [12.0, 22.0, 1.0], "work": [0.0, 0.0, 1.0]}]
+    ds = Dataset({"gm": ObjectColumn(T.GeolocationMap, vals)})
+    est = GeolocationMapVectorizer()
+    est.set_input(f)
+    out = est.fit(ds).transform_dataset(ds)
+    keys = {c.grouping for c in out.metadata.columns}
+    assert keys == {"home", "work"}
+    assert out.values.shape == (2, 8)
+
+
+# ---------------------------------------------------------------------------
+# bucketizers
+# ---------------------------------------------------------------------------
+def test_numeric_bucketizer():
+    f = _feat("x", T.Real)
+    b = NumericBucketizer(splits=[0.0, 1.0, 2.0], track_nulls=True, track_invalid=True)
+    b.set_input(f)
+    ds = Dataset({"x": NumericColumn(T.Real, np.array([0.5, 1.5, 5.0, 0.0]),
+                                     np.array([True, True, True, False]))})
+    out = b.transform_dataset(ds)
+    assert out.values.shape == (4, 4)  # 2 buckets + invalid + null
+    assert out.values[0].tolist() == [1.0, 0.0, 0.0, 0.0]
+    assert out.values[1].tolist() == [0.0, 1.0, 0.0, 0.0]
+    assert out.values[2].tolist() == [0.0, 0.0, 1.0, 0.0]
+    assert out.values[3].tolist() == [0.0, 0.0, 0.0, 1.0]
+
+
+def test_decision_tree_bucketizer_finds_informative_split():
+    rng = np.random.default_rng(0)
+    x = rng.uniform(0, 1, 500)
+    y = (x > 0.5).astype(float)
+    label = _feat("label", T.RealNN, is_response=True)
+    f = _feat("x", T.Real)
+    est = DecisionTreeNumericBucketizer(max_depth=1)
+    est.set_input(label, f)
+    ds = Dataset({"label": NumericColumn(T.RealNN, y, np.ones_like(y, bool)),
+                  "x": NumericColumn(T.Real, x, np.ones_like(x, bool))})
+    model = est.fit(ds)
+    assert model.did_split
+    inner = [s for s in model.splits if np.isfinite(s)]
+    assert len(inner) == 1 and abs(inner[0] - 0.5) < 0.1
+    out = model.transform_dataset(ds)
+    assert out.values.shape[1] == 4  # 2 buckets + invalid + null
+
+
+def test_decision_tree_bucketizer_uninformative_no_split():
+    rng = np.random.default_rng(1)
+    x = rng.uniform(0, 1, 200)
+    y = rng.integers(0, 2, 200).astype(float)
+    est = DecisionTreeNumericBucketizer(max_depth=2, min_info_gain=0.05)
+    est.set_input(_feat("label", T.RealNN, is_response=True), _feat("x", T.Real))
+    ds = Dataset({"label": NumericColumn(T.RealNN, y, np.ones_like(y, bool)),
+                  "x": NumericColumn(T.Real, x, np.ones_like(x, bool))})
+    model = est.fit(ds)
+    assert not model.did_split
+    assert model.transform_dataset(ds).values.shape == (200, 0)
+
+
+# ---------------------------------------------------------------------------
+# transmogrifier
+# ---------------------------------------------------------------------------
+def test_transmogrify_heterogeneous_end_to_end():
+    n = 40
+    rng = np.random.default_rng(2)
+    ds = Dataset({
+        "age": NumericColumn(T.Real, rng.uniform(20, 60, n),
+                             rng.random(n) > 0.1),
+        "cls": ObjectColumn(T.PickList, [("a" if i % 2 else "b") for i in range(n)]),
+        "desc": ObjectColumn(T.Text, [f"text {i} words here" for i in range(n)]),
+        "when": NumericColumn(T.Date, rng.uniform(0, 1e12, n), np.ones(n, bool)),
+        "tags": ObjectColumn(T.MultiPickList, [{"t1", "t2"} if i % 3 else {"t1"}
+                                               for i in range(n)]),
+        "scores": ObjectColumn(T.RealMap, [{"m": float(i)} for i in range(n)]),
+    })
+    feats = [
+        _feat("age", T.Real), _feat("cls", T.PickList), _feat("desc", T.Text),
+        _feat("when", T.Date), _feat("tags", T.MultiPickList),
+        _feat("scores", T.RealMap),
+    ]
+    combined = transmogrify(feats)
+    assert combined.ftype is T.OPVector
+    # walk the DAG: fit estimators layer by layer manually via the workflow
+    from transmogrifai_tpu import OpWorkflow
+
+    wf = OpWorkflow().set_input_dataset(ds).set_result_features(combined)
+    model = wf.train()
+    scored = model.score(ds)
+    out = scored[combined.name]
+    assert len(out) == n
+    assert out.values.shape[1] > 10
+    parents = {c.parent_feature_name[0] for c in out.metadata.columns}
+    assert parents == {"age", "cls", "desc", "when", "tags", "scores"}

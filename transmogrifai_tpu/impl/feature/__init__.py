@@ -1,1 +1,28 @@
-"""Package."""
+"""Feature-engineering stages (core/.../stages/impl/feature analog)."""
+from .bucketizers import (DecisionTreeNumericBucketizer,
+                          DecisionTreeNumericBucketizerModel, NumericBucketizer,
+                          find_tree_splits)
+from .dates import (DateListPivot, DateListVectorizer, DateToUnitCircleTransformer,
+                    TimePeriod, TimePeriodTransformer, extract_period)
+from .geo import (GeolocationMapVectorizer, GeolocationMapVectorizerModel,
+                  GeolocationVectorizer, GeolocationVectorizerModel,
+                  geographic_midpoint)
+from .hashing import (CollectionHashingVectorizer, HashingFunction, HashSpaceStrategy,
+                      OpHashingTF, OPCollectionHashingVectorizer, hash_term, murmur3_32)
+from .map_vectorizers import (MultiPickListMapVectorizer, OPMapVectorizer,
+                              OPMapVectorizerModel, TextMapPivotVectorizer,
+                              TextMapPivotVectorizerModel)
+from .smart_text import (SmartTextMapVectorizer, SmartTextMapVectorizerModel,
+                         SmartTextVectorizer, SmartTextVectorizerModel, TextStats)
+from .text import (JaccardSimilarity, LangDetector, NGramSimilarity, OpCountVectorizer,
+                   OpCountVectorizerModel, OpIndexToString, OpNGram, OpStopWordsRemover,
+                   OpStringIndexer, OpStringIndexerModel, TextLenTransformer,
+                   TextTokenizer, analyze, detect_language)
+from .transmogrifier import TransmogrifierDefaults, transmogrify
+from .vectorizers import (BinaryVectorizer, IntegralVectorizer, OneHotVectorizer,
+                          OneHotVectorizerModel, OpOneHotVectorizer, OpSetVectorizer,
+                          RealNNVectorizer, RealVectorizer, RealVectorizerModel,
+                          StandardScalerModel, StandardScalerVectorizer,
+                          VectorsCombiner)
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,25 @@
+"""Shared vectorizer plumbing."""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from ... import types as T
+from ...columns import VectorColumn
+from ...features.metadata import VectorColumnMetadata, VectorMetadata
+
+
+def finalize_vector(stage, blocks: Sequence[np.ndarray],
+                    meta: Sequence[VectorColumnMetadata], n: int) -> VectorColumn:
+    """Concatenate transform blocks, re-index the column metadata, stash it on
+    the stage (powers SanityChecker/insights), and wrap as a VectorColumn."""
+    out = (np.concatenate(blocks, axis=1) if len(blocks)
+           else np.zeros((n, 0), dtype=np.float32))
+    cols_meta = tuple(
+        VectorColumnMetadata(c.parent_feature_name, c.parent_feature_type, c.grouping,
+                             c.indicator_value, c.descriptor_value, i)
+        for i, c in enumerate(meta))
+    vm = VectorMetadata(stage.get_outputs()[0].name, cols_meta)
+    stage.metadata["vector_metadata"] = vm
+    return VectorColumn(T.OPVector, out, vm)

@@ -1,0 +1,251 @@
+"""Date / time feature stages — circular encodings and date-list pivots.
+
+Reference parity:
+- ``DateToUnitCircleTransformer``
+  (core/.../impl/feature/DateToUnitCircleTransformer.scala): epoch-millis ->
+  (sin, cos) of the chosen ``TimePeriod`` so midnight/Dec-31 wrap correctly,
+- ``DateListVectorizer`` (DateListVectorizer.scala): pivots SinceFirst /
+  SinceLast / ModeDay / ModeMonth / ModeHour,
+- ``TimePeriod*`` transforms (TimePeriodListTransformer etc.).
+
+All date math is integer arithmetic on epoch milliseconds (the reference's
+joda-millis convention, types/Numerics.scala Date) — vectorized with numpy,
+no Python datetime in the hot path.
+"""
+from __future__ import annotations
+
+import enum
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ... import types as T
+from ...columns import Column, Dataset, NumericColumn, ObjectColumn, VectorColumn
+from ...features.metadata import NULL_INDICATOR, VectorColumnMetadata, VectorMetadata
+from ...stages.base import SequenceTransformer, UnaryTransformer
+from ._util import finalize_vector
+
+MS_PER_SECOND = 1000
+MS_PER_MINUTE = 60 * MS_PER_SECOND
+MS_PER_HOUR = 60 * MS_PER_MINUTE
+MS_PER_DAY = 24 * MS_PER_HOUR
+# 1970-01-01 was a Thursday; reference DayOfWeek is 1=Monday..7=Sunday (joda)
+_EPOCH_DOW_OFFSET = 3
+#: fixed anchor for Since* pivots (the reference anchors on a configured
+#: reference date, not on batch data — batch-dependent anchors would cause
+#: train/serve skew).  2017-01-01T00:00:00Z; override per stage.
+REFERENCE_DATE_MS = 1483228800000
+
+
+class TimePeriod(str, enum.Enum):
+    """TimePeriod enum (core/.../impl/feature/TimePeriod.scala)."""
+
+    DayOfMonth = "DayOfMonth"
+    DayOfWeek = "DayOfWeek"
+    DayOfYear = "DayOfYear"
+    HourOfDay = "HourOfDay"
+    MonthOfYear = "MonthOfYear"
+    WeekOfMonth = "WeekOfMonth"
+    WeekOfYear = "WeekOfYear"
+
+
+def _civil_from_days(days: np.ndarray):
+    """Vectorized days-since-epoch -> (year, month, day, day_of_year).
+
+    Howard Hinnant's civil_from_days algorithm, vectorized."""
+    days = days.astype(np.int64)
+    z = days + 719468
+    era = np.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)  # [0, 365], Mar-1-based
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = np.where(mp < 10, mp + 3, mp - 9)
+    y = np.where(m <= 2, y + 1, y)
+    # day-of-year (Jan-1-based)
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    cum = np.array([0, 31, 59, 90, 120, 151, 181, 212, 243, 273, 304, 334])
+    day_of_year = cum[m - 1] + d + np.where(leap & (m > 2), 1, 0)
+    return y, m, d, day_of_year
+
+
+def extract_period(millis: np.ndarray, period: TimePeriod) -> np.ndarray:
+    """Vectorized TimePeriod value extraction from epoch millis."""
+    millis = millis.astype(np.int64)
+    days = np.floor_divide(millis, MS_PER_DAY)
+    if period is TimePeriod.HourOfDay:
+        return ((millis - days * MS_PER_DAY) // MS_PER_HOUR).astype(np.float64)
+    if period is TimePeriod.DayOfWeek:
+        return ((days + _EPOCH_DOW_OFFSET) % 7 + 1).astype(np.float64)
+    y, m, d, doy = _civil_from_days(days)
+    if period is TimePeriod.DayOfMonth:
+        return d.astype(np.float64)
+    if period is TimePeriod.DayOfYear:
+        return doy.astype(np.float64)
+    if period is TimePeriod.MonthOfYear:
+        return m.astype(np.float64)
+    if period is TimePeriod.WeekOfMonth:
+        return ((d - 1) // 7 + 1).astype(np.float64)
+    if period is TimePeriod.WeekOfYear:
+        return ((doy - 1) // 7 + 1).astype(np.float64)
+    raise ValueError(f"Unknown period {period}")
+
+
+_PERIOD_RADIX = {
+    TimePeriod.DayOfMonth: 31.0,
+    TimePeriod.DayOfWeek: 7.0,
+    TimePeriod.DayOfYear: 366.0,
+    TimePeriod.HourOfDay: 24.0,
+    TimePeriod.MonthOfYear: 12.0,
+    TimePeriod.WeekOfMonth: 5.0,
+    TimePeriod.WeekOfYear: 53.0,
+}
+_PERIOD_OFFSET = {  # 1-based periods shift to 0-based angle
+    TimePeriod.DayOfMonth: 1.0,
+    TimePeriod.DayOfWeek: 1.0,
+    TimePeriod.DayOfYear: 1.0,
+    TimePeriod.HourOfDay: 0.0,
+    TimePeriod.MonthOfYear: 1.0,
+    TimePeriod.WeekOfMonth: 1.0,
+    TimePeriod.WeekOfYear: 1.0,
+}
+
+
+class DateToUnitCircleTransformer(SequenceTransformer):
+    """Date features -> OPVector of (sin, cos) pairs per chosen period
+    (DateToUnitCircleTransformer.scala); null -> (0, 0) which is
+    distinguishable from any on-circle point."""
+
+    def __init__(self, time_period: TimePeriod = TimePeriod.HourOfDay,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="dateToUnitCircle", output_type=T.OPVector,
+                         uid=uid, time_period=str(getattr(time_period, "value", time_period)))
+
+    def transform_columns(self, cols: Sequence[Column]) -> VectorColumn:
+        period = TimePeriod(self.get_param("time_period"))
+        radix = _PERIOD_RADIX[period]
+        offset = _PERIOD_OFFSET[period]
+        n = len(cols[0])
+        blocks, meta = [], []
+        for f, col in zip(self.inputs, cols):
+            assert isinstance(col, NumericColumn)
+            vals = extract_period(col.values, period)
+            angle = 2.0 * np.pi * (vals - offset) / radix
+            sin = np.where(col.mask, np.sin(angle), 0.0).astype(np.float32)
+            cos = np.where(col.mask, np.cos(angle), 0.0).astype(np.float32)
+            blocks.append(np.stack([sin, cos], axis=1))
+            meta.append(VectorColumnMetadata((f.name,), (f.ftype.__name__,),
+                                             descriptor_value=f"x_{period.value}"))
+            meta.append(VectorColumnMetadata((f.name,), (f.ftype.__name__,),
+                                             descriptor_value=f"y_{period.value}"))
+        return finalize_vector(self, blocks, meta, n)
+
+
+class TimePeriodTransformer(UnaryTransformer):
+    """Date -> Integral period value (TimePeriodTransformer.scala)."""
+
+    def __init__(self, time_period: TimePeriod = TimePeriod.DayOfWeek,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="timePeriod", input_type=T.Date,
+                         output_type=T.Integral, uid=uid,
+                         time_period=str(getattr(time_period, "value", time_period)))
+
+    def transform_columns(self, cols: Sequence[Column]) -> NumericColumn:
+        col = cols[0]
+        assert isinstance(col, NumericColumn)
+        period = TimePeriod(self.get_param("time_period"))
+        vals = extract_period(col.values, period)
+        return NumericColumn(T.Integral, np.where(col.mask, vals, 0.0), col.mask)
+
+    def transform_fn(self, value: T.FeatureType) -> T.FeatureType:
+        if value.is_empty:
+            return T.Integral(None)
+        period = TimePeriod(self.get_param("time_period"))
+        return T.Integral(int(extract_period(np.array([value.value]), period)[0]))
+
+
+class DateListPivot(str, enum.Enum):
+    """DateListVectorizer pivot modes (DateListVectorizer.scala)."""
+
+    SinceFirst = "SinceFirst"
+    SinceLast = "SinceLast"
+    ModeDay = "ModeDay"
+    ModeMonth = "ModeMonth"
+    ModeHour = "ModeHour"
+
+
+class DateListVectorizer(SequenceTransformer):
+    """DateList features -> OPVector via the chosen pivot
+    (DateListVectorizer.scala).
+
+    - SinceFirst/SinceLast: days between reference date and first/last event,
+    - ModeDay: one-hot of the most frequent day-of-week (7 columns),
+    - ModeMonth: one-hot of the most frequent month (12 columns),
+    - ModeHour: one-hot of the most frequent hour (24 columns).
+    """
+
+    def __init__(self, pivot: DateListPivot = DateListPivot.SinceLast,
+                 reference_date_ms: int = REFERENCE_DATE_MS, track_nulls: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="vecDateList", output_type=T.OPVector, uid=uid,
+                         pivot=str(getattr(pivot, "value", pivot)),
+                         reference_date_ms=int(reference_date_ms), track_nulls=track_nulls)
+
+    def _mode_period(self, ts: List[int], period: TimePeriod) -> int:
+        vals = extract_period(np.asarray(ts, dtype=np.int64), period).astype(np.int64)
+        counts = np.bincount(vals)
+        return int(np.argmax(counts))
+
+    def transform_columns(self, cols: Sequence[Column]) -> VectorColumn:
+        pivot = DateListPivot(self.get_param("pivot"))
+        track_nulls = bool(self.get_param("track_nulls"))
+        ref_ms = self.get_param("reference_date_ms")
+        n = len(cols[0])
+        blocks, meta = [], []
+        mode_spec = {
+            DateListPivot.ModeDay: (TimePeriod.DayOfWeek, 7, 1),
+            DateListPivot.ModeMonth: (TimePeriod.MonthOfYear, 12, 1),
+            DateListPivot.ModeHour: (TimePeriod.HourOfDay, 24, 0),
+        }
+        for f, col in zip(self.inputs, cols):
+            assert isinstance(col, ObjectColumn)
+            fname, ftype = f.name, f.ftype.__name__
+            if pivot in (DateListPivot.SinceFirst, DateListPivot.SinceLast):
+                ref = REFERENCE_DATE_MS if ref_ms is None else ref_ms
+                days = np.zeros(n, dtype=np.float32)
+                nulls = np.zeros(n, dtype=np.float32)
+                for i in range(n):
+                    v = col.values[i]
+                    if not v:
+                        nulls[i] = 1.0
+                        continue
+                    anchor = min(v) if pivot is DateListPivot.SinceFirst else max(v)
+                    days[i] = (ref - anchor) / MS_PER_DAY
+                cb = [days[:, None]]
+                meta.append(VectorColumnMetadata((fname,), (ftype,),
+                                                 descriptor_value=pivot.value))
+                if track_nulls:
+                    cb.append(nulls[:, None])
+                    meta.append(VectorColumnMetadata((fname,), (ftype,),
+                                                     indicator_value=NULL_INDICATOR))
+                blocks.append(np.concatenate(cb, axis=1))
+            else:
+                period, k, base = mode_spec[pivot]
+                block = np.zeros((n, k + (1 if track_nulls else 0)), dtype=np.float32)
+                for i in range(n):
+                    v = col.values[i]
+                    if not v:
+                        if track_nulls:
+                            block[i, k] = 1.0
+                        continue
+                    block[i, self._mode_period(v, period) - base] = 1.0
+                blocks.append(block)
+                for j in range(k):
+                    meta.append(VectorColumnMetadata((fname,), (ftype,),
+                                                     indicator_value=f"{pivot.value}_{j + base}"))
+                if track_nulls:
+                    meta.append(VectorColumnMetadata((fname,), (ftype,),
+                                                     indicator_value=NULL_INDICATOR))
+        return finalize_vector(self, blocks, meta, n)

@@ -1,0 +1,169 @@
+"""Geolocation vectorizers.
+
+Reference parity: ``GeolocationVectorizer`` /
+``GeolocationMapVectorizer`` (core/.../impl/feature/GeolocationVectorizer.scala,
+GeolocationMapVectorizer.scala): fill missing with the geographic midpoint of
+the training data (mean on the unit sphere) + null-tracking indicator.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ... import types as T
+from ...columns import Column, Dataset, ObjectColumn, VectorColumn
+from ...features.metadata import NULL_INDICATOR, VectorColumnMetadata, VectorMetadata
+from ...stages.base import Model, SequenceEstimator
+from ._util import finalize_vector
+
+
+def geographic_midpoint(latlons: np.ndarray) -> Tuple[float, float]:
+    """Mean position on the unit sphere -> (lat, lon) degrees."""
+    if latlons.shape[0] == 0:
+        return 0.0, 0.0
+    lat = np.radians(latlons[:, 0])
+    lon = np.radians(latlons[:, 1])
+    x = np.cos(lat) * np.cos(lon)
+    y = np.cos(lat) * np.sin(lon)
+    z = np.sin(lat)
+    mx, my, mz = x.mean(), y.mean(), z.mean()
+    hyp = np.hypot(mx, my)
+    if hyp < 1e-12 and abs(mz) < 1e-12:
+        return 0.0, 0.0
+    return float(np.degrees(np.arctan2(mz, hyp))), float(np.degrees(np.arctan2(my, mx)))
+
+
+def _geo_block(values, n: int, fill: Tuple[float, float, float], track_nulls: bool,
+               getter) -> np.ndarray:
+    width = 3 + (1 if track_nulls else 0)
+    block = np.zeros((n, width), dtype=np.float32)
+    for i in range(n):
+        v = getter(values[i])
+        if not v:
+            block[i, 0], block[i, 1], block[i, 2] = fill
+            if track_nulls:
+                block[i, 3] = 1.0
+        else:
+            block[i, 0], block[i, 1] = float(v[0]), float(v[1])
+            block[i, 2] = float(v[2]) if len(v) > 2 else 0.0
+    return block
+
+
+def _geo_meta(fname: str, ftype: str, track_nulls: bool,
+              grouping: Optional[str] = None) -> List[VectorColumnMetadata]:
+    meta = [VectorColumnMetadata((fname,), (ftype,), grouping=grouping,
+                                 descriptor_value=d)
+            for d in ("lat", "lon", "accuracy")]
+    if track_nulls:
+        meta.append(VectorColumnMetadata((fname,), (ftype,), grouping=grouping,
+                                         indicator_value=NULL_INDICATOR))
+    return meta
+
+
+class GeolocationVectorizer(SequenceEstimator):
+    """Geolocation features -> OPVector [lat, lon, accuracy, null?]
+    (GeolocationVectorizer.scala)."""
+
+    def __init__(self, fill_with_midpoint: bool = True, track_nulls: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="vecGeo", output_type=T.OPVector, uid=uid,
+                         fill_with_midpoint=fill_with_midpoint, track_nulls=track_nulls)
+
+    def fit_columns(self, cols: Sequence[Column], dataset: Dataset) -> "GeolocationVectorizerModel":
+        fills = []
+        for col in cols:
+            assert isinstance(col, ObjectColumn)
+            if self.get_param("fill_with_midpoint"):
+                pts = np.array([v[:2] for v in col.values if v], dtype=np.float64)
+                lat, lon = geographic_midpoint(pts.reshape(-1, 2))
+                fills.append((lat, lon, 0.0))
+            else:
+                fills.append((0.0, 0.0, 0.0))
+        return GeolocationVectorizerModel(fills=fills,
+                                          track_nulls=bool(self.get_param("track_nulls")),
+                                          operation_name=self.operation_name,
+                                          output_type=self.output_type)
+
+
+class GeolocationVectorizerModel(Model):
+    def __init__(self, fills: List[Tuple[float, float, float]], track_nulls: bool = True,
+                 operation_name: str = "vecGeo", output_type=T.OPVector,
+                 uid: Optional[str] = None, **kw):
+        super().__init__(operation_name, output_type, uid=uid, **kw)
+        self.fills = [tuple(f) for f in fills]
+        self.track_nulls = bool(track_nulls)
+
+    def transform_columns(self, cols: Sequence[Column]) -> VectorColumn:
+        n = len(cols[0])
+        blocks, meta = [], []
+        for f, col, fill in zip(self.inputs, cols, self.fills):
+            assert isinstance(col, ObjectColumn)
+            blocks.append(_geo_block(col.values, n, fill, self.track_nulls, lambda v: v))
+            meta.extend(_geo_meta(f.name, f.ftype.__name__, self.track_nulls))
+        return finalize_vector(self, blocks, meta, n)
+
+
+class GeolocationMapVectorizer(SequenceEstimator):
+    """GeolocationMap features -> per-key [lat, lon, accuracy, null?] blocks
+    (GeolocationMapVectorizer.scala)."""
+
+    def __init__(self, fill_with_midpoint: bool = True, track_nulls: bool = True,
+                 block_keys: Optional[Sequence[str]] = None, uid: Optional[str] = None):
+        super().__init__(operation_name="vecGeoMap", output_type=T.OPVector, uid=uid,
+                         fill_with_midpoint=fill_with_midpoint, track_nulls=track_nulls,
+                         block_keys=list(block_keys) if block_keys else None)
+
+    def fit_columns(self, cols: Sequence[Column], dataset: Dataset) -> "GeolocationMapVectorizerModel":
+        block = set(self.get_param("block_keys") or ())
+        feature_keys, fills = [], []
+        for col in cols:
+            assert isinstance(col, ObjectColumn)
+            pts_by_key: Dict[str, List] = {}
+            for i in range(len(col)):
+                m = col.values[i] or {}
+                for k, v in m.items():
+                    k = str(k)
+                    if k in block:
+                        continue
+                    pts_by_key.setdefault(k, [])
+                    if v:
+                        pts_by_key[k].append(v[:2])
+            keys = sorted(pts_by_key)
+            feature_keys.append(keys)
+            key_fills = []
+            for k in keys:
+                if self.get_param("fill_with_midpoint") and pts_by_key[k]:
+                    lat, lon = geographic_midpoint(
+                        np.asarray(pts_by_key[k], dtype=np.float64))
+                    key_fills.append((lat, lon, 0.0))
+                else:
+                    key_fills.append((0.0, 0.0, 0.0))
+            fills.append(key_fills)
+        return GeolocationMapVectorizerModel(feature_keys=feature_keys, fills=fills,
+                                             track_nulls=bool(self.get_param("track_nulls")),
+                                             operation_name=self.operation_name,
+                                             output_type=self.output_type)
+
+
+class GeolocationMapVectorizerModel(Model):
+    def __init__(self, feature_keys: List[List[str]],
+                 fills: List[List[Tuple[float, float, float]]], track_nulls: bool = True,
+                 operation_name: str = "vecGeoMap", output_type=T.OPVector,
+                 uid: Optional[str] = None, **kw):
+        super().__init__(operation_name, output_type, uid=uid, **kw)
+        self.feature_keys = feature_keys
+        self.fills = [[tuple(f) for f in fs] for fs in fills]
+        self.track_nulls = bool(track_nulls)
+
+    def transform_columns(self, cols: Sequence[Column]) -> VectorColumn:
+        n = len(cols[0])
+        blocks, meta = [], []
+        for f, col, keys, key_fills in zip(self.inputs, cols, self.feature_keys, self.fills):
+            assert isinstance(col, ObjectColumn)
+            for key, fill in zip(keys, key_fills):
+                blocks.append(_geo_block(col.values, n, fill, self.track_nulls,
+                                         lambda m, key=key: (m or {}).get(key)))
+                meta.extend(_geo_meta(f.name, f.ftype.__name__, self.track_nulls,
+                                      grouping=key))
+        return finalize_vector(self, blocks, meta, n)

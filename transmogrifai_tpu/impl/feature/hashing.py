@@ -1,0 +1,228 @@
+"""Feature hashing — MurMur3-based hashing of text/token features.
+
+Reference parity: ``OPCollectionHashingVectorizer``
+(core/.../impl/feature/OPCollectionHashingVectorizer.scala:59) — HashingTF
+with MurMur3, shared vs separate hash spaces (``HashSpaceStrategy``), binary
+or term-frequency counts, null tracking; ``OpHashingTF``
+(core/.../impl/feature/OpHashingTF.scala:50).
+
+TPU-first design: hashing happens host-side (strings never reach the
+device); the output is a dense float32 block that fuses into the model
+matrix.  The token->index hash is MurMur3 x86/32 with Spark's seed (42) so
+hash layouts match the reference bit-for-bit.  A C++ kernel (ctypes,
+``transmogrifai_tpu.native``) accelerates the hot loop when available.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ... import types as T
+from ...columns import Column, NumericColumn, ObjectColumn, VectorColumn, Dataset
+from ...features.metadata import NULL_INDICATOR, VectorColumnMetadata, VectorMetadata
+from ...stages.base import SequenceTransformer, UnaryTransformer
+from ._util import finalize_vector
+
+
+def _murmur3_32_py(data: bytes, seed: int = 42) -> int:
+    """MurMur3 x86 32-bit (the hash behind Spark's HashingTF)."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed & 0xFFFFFFFF
+    n = len(data)
+    rounded = n - (n % 4)
+    for i in range(0, rounded, 4):
+        k = int.from_bytes(data[i:i + 4], "little")
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & 0xFFFFFFFF
+        h = (h * 5 + 0xE6546B64) & 0xFFFFFFFF
+    k = 0
+    tail = n % 4
+    if tail >= 3:
+        k ^= data[rounded + 2] << 16
+    if tail >= 2:
+        k ^= data[rounded + 1] << 8
+    if tail >= 1:
+        k ^= data[rounded]
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+    h ^= n
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h
+
+
+def murmur3_32(data: bytes, seed: int = 42) -> int:
+    """MurMur3 x86/32; dispatches to the native C++ kernel when built."""
+    from ...native import murmur3 as native_murmur3
+
+    if native_murmur3 is not None:
+        return native_murmur3(data, seed)
+    return _murmur3_32_py(data, seed)
+
+
+def hash_term(term: str, num_features: int, seed: int = 42) -> int:
+    """Token -> bucket, matching Spark HashingTF's nonNegativeMod."""
+    h = murmur3_32(term.encode("utf-8"), seed)
+    # interpret as signed 32-bit then non-negative mod
+    signed = h - 0x100000000 if h >= 0x80000000 else h
+    return ((signed % num_features) + num_features) % num_features
+
+
+class HashSpaceStrategy(str, enum.Enum):
+    """OPCollectionHashingVectorizer.scala HashSpaceStrategy."""
+
+    Shared = "shared"        # all features hash into one space
+    Separate = "separate"    # each feature gets its own block
+    Auto = "auto"            # shared iff many features (> max_for_separate)
+
+
+class HashingFunction:
+    """The shared hashing core (term iteration + bucketing) used by
+    OpHashingTF and OPCollectionHashingVectorizer."""
+
+    def __init__(self, num_features: int = 512, binary_freq: bool = False, seed: int = 42):
+        self.num_features = int(num_features)
+        self.binary_freq = bool(binary_freq)
+        self.seed = int(seed)
+
+    def tf_row(self, terms: Iterable[str], out: np.ndarray, offset: int = 0) -> None:
+        for t in terms:
+            j = offset + hash_term(str(t), self.num_features, self.seed)
+            if self.binary_freq:
+                out[j] = 1.0
+            else:
+                out[j] += 1.0
+
+
+def _terms_of(value: Any) -> List[str]:
+    """Extract hashable tokens from a raw column cell (text or collection)."""
+    if value is None:
+        return []
+    if isinstance(value, str):
+        return [value]
+    if isinstance(value, (list, tuple, set, frozenset)):
+        return [str(v) for v in value]
+    if isinstance(value, dict):
+        # map types: hash "key:value" pairs so keys partition the space
+        return [f"{k}:{v}" for k, v in value.items()]
+    return [str(value)]
+
+
+class OpHashingTF(UnaryTransformer):
+    """TextList -> OPVector term-frequency hashing (OpHashingTF.scala:50)."""
+
+    def __init__(self, num_features: int = 512, binary_freq: bool = False,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="hashingTF", input_type=T.TextList,
+                         output_type=T.OPVector, uid=uid,
+                         num_features=num_features, binary_freq=binary_freq)
+
+    def transform_columns(self, cols: Sequence[Column]) -> VectorColumn:
+        col = cols[0]
+        assert isinstance(col, ObjectColumn)
+        fn = HashingFunction(self.get_param("num_features"), self.get_param("binary_freq"))
+        n = len(col)
+        out = np.zeros((n, fn.num_features), dtype=np.float32)
+        for i in range(n):
+            fn.tf_row(_terms_of(col.values[i]), out[i])
+        f = self.inputs[0]
+        meta = VectorMetadata(self.get_outputs()[0].name, tuple(
+            VectorColumnMetadata((f.name,), (f.ftype.__name__,), index=j,
+                                 descriptor_value=f"hash_{j}")
+            for j in range(fn.num_features)))
+        self.metadata["vector_metadata"] = meta
+        return VectorColumn(T.OPVector, out, meta)
+
+
+class CollectionHashingVectorizer(SequenceTransformer):
+    """Hash N text/list/set/map features into TF blocks
+    (OPCollectionHashingVectorizer.scala:59).
+
+    - ``Shared``: one ``num_features``-wide space, every feature's tokens
+      prefixed with the feature index so identical tokens from different
+      features collide only by chance (matching the reference's
+      feature-prefixed terms in shared spaces).
+    - ``Separate``: each feature owns a ``num_features``-wide block.
+    - ``Auto``: shared when > ``max_for_separate`` features.
+    """
+
+    MAX_NUM_FEATURES = 2 ** 17  # Transmogrifier.scala:56 MaxNumOfFeatures
+
+    def __init__(self, num_features: int = 512, binary_freq: bool = False,
+                 hash_space_strategy: HashSpaceStrategy = HashSpaceStrategy.Auto,
+                 max_for_separate: int = 8, track_nulls: bool = True,
+                 prepend_feature_name: bool = True, uid: Optional[str] = None):
+        if num_features > self.MAX_NUM_FEATURES:
+            raise ValueError(f"num_features {num_features} > max {self.MAX_NUM_FEATURES}")
+        super().__init__(operation_name="vecColHash", output_type=T.OPVector, uid=uid,
+                         num_features=num_features, binary_freq=binary_freq,
+                         hash_space_strategy=str(
+                             getattr(hash_space_strategy, "value", hash_space_strategy)),
+                         max_for_separate=max_for_separate, track_nulls=track_nulls,
+                         prepend_feature_name=prepend_feature_name)
+
+    def is_shared_hash_space(self) -> bool:
+        strat = HashSpaceStrategy(self.get_param("hash_space_strategy"))
+        if strat is HashSpaceStrategy.Shared:
+            return True
+        if strat is HashSpaceStrategy.Separate:
+            return False
+        return len(self.inputs) > int(self.get_param("max_for_separate"))
+
+    def transform_columns(self, cols: Sequence[Column]) -> VectorColumn:
+        n = len(cols[0])
+        num_features = int(self.get_param("num_features"))
+        fn = HashingFunction(num_features, bool(self.get_param("binary_freq")))
+        shared = self.is_shared_hash_space()
+        track_nulls = bool(self.get_param("track_nulls"))
+        prepend = bool(self.get_param("prepend_feature_name"))
+        k = len(cols)
+        width = num_features if shared else num_features * k
+        hashed = np.zeros((n, width), dtype=np.float32)
+        nulls = np.zeros((n, k), dtype=np.float32)
+        for ci, col in enumerate(cols):
+            assert isinstance(col, ObjectColumn), "hashing vectorizer needs host columns"
+            offset = 0 if shared else ci * num_features
+            # shared space: prefix terms with the feature NAME (as the
+            # reference does) so the layout is input-order independent
+            prefix = f"{self.inputs[ci].name}_" if (shared and prepend) else ""
+            for i in range(n):
+                terms = _terms_of(col.values[i])
+                if not terms:
+                    nulls[i, ci] = 1.0
+                    continue
+                if prefix:
+                    terms = [prefix + t for t in terms]
+                fn.tf_row(terms, hashed[i], offset)
+        meta_cols: List[VectorColumnMetadata] = []
+        if shared:
+            all_names = tuple(f.name for f in self.inputs)
+            all_types = tuple(f.ftype.__name__ for f in self.inputs)
+            for j in range(num_features):
+                meta_cols.append(VectorColumnMetadata(all_names, all_types,
+                                                      descriptor_value=f"hash_{j}"))
+        else:
+            for f in self.inputs:
+                for j in range(num_features):
+                    meta_cols.append(VectorColumnMetadata((f.name,), (f.ftype.__name__,),
+                                                          descriptor_value=f"hash_{j}"))
+        blocks = [hashed]
+        if track_nulls:
+            blocks.append(nulls)
+            for f in self.inputs:
+                meta_cols.append(VectorColumnMetadata((f.name,), (f.ftype.__name__,),
+                                                      indicator_value=NULL_INDICATOR))
+        return finalize_vector(self, blocks, meta_cols, n)
+
+
+OPCollectionHashingVectorizer = CollectionHashingVectorizer

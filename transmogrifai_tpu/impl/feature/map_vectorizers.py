@@ -1,0 +1,208 @@
+"""Map-type vectorizers — per-key expansion of all map features.
+
+Reference parity:
+- ``OPMapVectorizer`` (core/.../impl/feature/OPMapVectorizer.scala): numeric /
+  binary / date map types expand to one column per discovered key with
+  mean/constant fill + null tracking; key allowlist/blocklist (``cleanKeys``,
+  RFF-blocklisted map keys),
+- ``TextMapPivotVectorizer`` (TextMapPivotVectorizer.scala): categorical
+  pivot per (key, topK values) with OTHER + null columns,
+- ``MultiPickListMapVectorizer`` (MultiPickListMapVectorizer.scala): same
+  pivot where each key holds a set of values.
+
+Metadata ``grouping`` is the map key throughout — that is what lets
+SanityChecker and RawFeatureFilter reason about individual map keys.
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ... import types as T
+from ...columns import Column, Dataset, ObjectColumn, VectorColumn
+from ...features.metadata import (NULL_INDICATOR, OTHER_INDICATOR,
+                                  VectorColumnMetadata, VectorMetadata)
+from ...stages.base import Model, SequenceEstimator
+from ._util import finalize_vector as _finalize
+
+
+def _filtered_keys(col: ObjectColumn, allow, block) -> List[str]:
+    keys = set()
+    for i in range(len(col)):
+        m = col.values[i] or {}
+        keys.update(str(k) for k in m)
+    if allow is not None:
+        keys &= set(allow)
+    keys -= set(block or ())
+    return sorted(keys)
+
+
+class OPMapVectorizer(SequenceEstimator):
+    """Numeric/binary/date map features -> per-key columns with fill +
+    null tracking (OPMapVectorizer.scala)."""
+
+    def __init__(self, fill_with_mean: bool = True, fill_value: float = 0.0,
+                 track_nulls: bool = True, allow_keys: Optional[Sequence[str]] = None,
+                 block_keys: Optional[Sequence[str]] = None, uid: Optional[str] = None):
+        super().__init__(operation_name="vecMap", output_type=T.OPVector, uid=uid,
+                         fill_with_mean=fill_with_mean, fill_value=fill_value,
+                         track_nulls=track_nulls,
+                         allow_keys=list(allow_keys) if allow_keys else None,
+                         block_keys=list(block_keys) if block_keys else None)
+
+    def fit_columns(self, cols: Sequence[Column], dataset: Dataset) -> "OPMapVectorizerModel":
+        allow = self.get_param("allow_keys")
+        block = self.get_param("block_keys")
+        feature_keys, fills = [], []
+        for col in cols:
+            assert isinstance(col, ObjectColumn), "OPMapVectorizer needs map columns"
+            keys = _filtered_keys(col, allow, block)
+            feature_keys.append(keys)
+            key_fills = []
+            for k in keys:
+                if self.get_param("fill_with_mean"):
+                    vals = [float(m[k]) for m in (col.values[i] or {} for i in range(len(col)))
+                            if k in m and m[k] is not None]
+                    key_fills.append(float(np.mean(vals)) if vals else 0.0)
+                else:
+                    key_fills.append(float(self.get_param("fill_value")))
+            fills.append(key_fills)
+        return OPMapVectorizerModel(feature_keys=feature_keys, fills=fills,
+                                    track_nulls=bool(self.get_param("track_nulls")),
+                                    operation_name=self.operation_name,
+                                    output_type=self.output_type)
+
+
+class OPMapVectorizerModel(Model):
+    def __init__(self, feature_keys: List[List[str]], fills: List[List[float]],
+                 track_nulls: bool = True, operation_name: str = "vecMap",
+                 output_type=T.OPVector, uid: Optional[str] = None, **kw):
+        super().__init__(operation_name, output_type, uid=uid, **kw)
+        self.feature_keys = feature_keys
+        self.fills = fills
+        self.track_nulls = bool(track_nulls)
+
+    def transform_columns(self, cols: Sequence[Column]) -> VectorColumn:
+        n = len(cols[0])
+        blocks, meta = [], []
+        for f, col, keys, key_fills in zip(self.inputs, cols, self.feature_keys, self.fills):
+            assert isinstance(col, ObjectColumn)
+            fname, ftype = f.name, f.ftype.__name__
+            for key, fill in zip(keys, key_fills):
+                vals = np.full(n, fill, dtype=np.float32)
+                nulls = np.zeros(n, dtype=np.float32)
+                for i in range(n):
+                    m = col.values[i] or {}
+                    v = m.get(key)
+                    if v is None:
+                        nulls[i] = 1.0
+                    else:
+                        vals[i] = float(v)
+                blocks.append(vals[:, None])
+                meta.append(VectorColumnMetadata((fname,), (ftype,), grouping=key))
+                if self.track_nulls:
+                    blocks.append(nulls[:, None])
+                    meta.append(VectorColumnMetadata((fname,), (ftype,), grouping=key,
+                                                     indicator_value=NULL_INDICATOR))
+        return _finalize(self, blocks, meta, n)
+
+
+class TextMapPivotVectorizer(SequenceEstimator):
+    """Text map features -> per-key topK categorical pivot with OTHER + null
+    (TextMapPivotVectorizer.scala)."""
+
+    def __init__(self, top_k: int = 20, min_support: int = 10, track_nulls: bool = True,
+                 allow_keys: Optional[Sequence[str]] = None,
+                 block_keys: Optional[Sequence[str]] = None, uid: Optional[str] = None):
+        super().__init__(operation_name="pivotTextMap", output_type=T.OPVector, uid=uid,
+                         top_k=top_k, min_support=min_support, track_nulls=track_nulls,
+                         allow_keys=list(allow_keys) if allow_keys else None,
+                         block_keys=list(block_keys) if block_keys else None)
+
+    @staticmethod
+    def _cell_values(v: Any) -> List[str]:
+        if v is None:
+            return []
+        if isinstance(v, (set, frozenset, list, tuple)):
+            return [str(x) for x in v]
+        return [str(v)]
+
+    def fit_columns(self, cols: Sequence[Column], dataset: Dataset) -> "TextMapPivotVectorizerModel":
+        allow = self.get_param("allow_keys")
+        block = self.get_param("block_keys")
+        top_k = int(self.get_param("top_k"))
+        min_support = int(self.get_param("min_support"))
+        feature_keys, categories = [], []
+        for col in cols:
+            assert isinstance(col, ObjectColumn)
+            keys = _filtered_keys(col, allow, block)
+            feature_keys.append(keys)
+            counts: Dict[str, Counter] = {k: Counter() for k in keys}
+            for i in range(len(col)):
+                m = col.values[i] or {}
+                for k in keys:
+                    counts[k].update(self._cell_values(m.get(k)))
+            key_cats = []
+            for k in keys:
+                keep = [(v, c) for v, c in counts[k].items() if c >= min_support]
+                keep.sort(key=lambda vc: (-vc[1], vc[0]))
+                key_cats.append([v for v, _ in keep[:top_k]])
+            categories.append(key_cats)
+        return TextMapPivotVectorizerModel(feature_keys=feature_keys, categories=categories,
+                                           track_nulls=bool(self.get_param("track_nulls")),
+                                           operation_name=self.operation_name,
+                                           output_type=self.output_type)
+
+
+class TextMapPivotVectorizerModel(Model):
+    def __init__(self, feature_keys: List[List[str]], categories: List[List[List[str]]],
+                 track_nulls: bool = True, operation_name: str = "pivotTextMap",
+                 output_type=T.OPVector, uid: Optional[str] = None, **kw):
+        super().__init__(operation_name, output_type, uid=uid, **kw)
+        self.feature_keys = feature_keys
+        self.categories = categories
+        self.track_nulls = bool(track_nulls)
+
+    def transform_columns(self, cols: Sequence[Column]) -> VectorColumn:
+        n = len(cols[0])
+        blocks, meta = [], []
+        for f, col, keys, key_cats in zip(self.inputs, cols, self.feature_keys,
+                                          self.categories):
+            assert isinstance(col, ObjectColumn)
+            fname, ftype = f.name, f.ftype.__name__
+            for key, cats in zip(keys, key_cats):
+                index = {c: j for j, c in enumerate(cats)}
+                k = len(cats)
+                block = np.zeros((n, k + 2), dtype=np.float32)
+                for i in range(n):
+                    m = col.values[i] or {}
+                    vals = TextMapPivotVectorizer._cell_values(m.get(key))
+                    if not vals:
+                        block[i, k + 1] = 1.0
+                        continue
+                    for v in vals:
+                        j = index.get(v)
+                        if j is None:
+                            block[i, k] = 1.0
+                        else:
+                            block[i, j] = 1.0
+                if not self.track_nulls:
+                    block = block[:, : k + 1]
+                blocks.append(block)
+                for v in cats:
+                    meta.append(VectorColumnMetadata((fname,), (ftype,), grouping=key,
+                                                     indicator_value=v))
+                meta.append(VectorColumnMetadata((fname,), (ftype,), grouping=key,
+                                                 indicator_value=OTHER_INDICATOR))
+                if self.track_nulls:
+                    meta.append(VectorColumnMetadata((fname,), (ftype,), grouping=key,
+                                                     indicator_value=NULL_INDICATOR))
+        return _finalize(self, blocks, meta, n)
+
+
+#: MultiPickListMap pivots identically — each key's cell is a set of values
+#: (MultiPickListMapVectorizer.scala); the pivot path above already handles
+#: set-valued cells.
+MultiPickListMapVectorizer = TextMapPivotVectorizer

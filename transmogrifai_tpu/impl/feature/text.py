@@ -1,0 +1,410 @@
+"""Text processing stages — tokenization, TF counting, n-grams, similarity.
+
+Reference parity (core/.../impl/feature/ + core/.../utils/text/):
+- ``TextTokenizer`` (TextTokenizer.scala:125) with Lucene-style analyzers
+  (``LuceneTextAnalyzer:87``): lowercase, unicode-word split, min token
+  length, per-language stopword removal, optional language auto-detection.
+- ``OpStopWordsRemover`` (OpStopWordsRemover.scala:48),
+- ``OpNGram`` (OpNGram.scala:52),
+- ``OpCountVectorizer`` (OpCountVectorizer.scala:44) — vocab-building TF,
+- ``TextLenTransformer`` (TextLenTransformer.scala), ``OpStringIndexer`` /
+  ``OpIndexToString`` (OpStringIndexer.scala:53),
+- ``NGramSimilarity`` / ``JaccardSimilarity`` (NGramSimilarity.scala:42).
+
+The analyzers here are pure Python/C++ (no Lucene): a unicode-aware regex
+analyzer plus language-specific stopword lists covers the reference's
+default analysis chain; everything downstream is dense columnar math.
+"""
+from __future__ import annotations
+
+import re
+import unicodedata
+from collections import Counter
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ... import types as T
+from ...columns import Column, Dataset, NumericColumn, ObjectColumn, VectorColumn
+from ...features.metadata import VectorColumnMetadata, VectorMetadata
+from ...stages.base import (BinaryTransformer, Model, SequenceEstimator,
+                            UnaryEstimator, UnaryTransformer)
+
+# ---------------------------------------------------------------------------
+# Analyzers (LuceneTextAnalyzer analog)
+# ---------------------------------------------------------------------------
+_WORD_RE = re.compile(r"\w+", re.UNICODE)
+
+# Minimal per-language stopword lists (Lucene's default analyzers ship the
+# same concept; lists abbreviated to the high-frequency heads).
+STOP_WORDS: Dict[str, Set[str]] = {
+    "en": {"a", "an", "and", "are", "as", "at", "be", "but", "by", "for", "if",
+           "in", "into", "is", "it", "no", "not", "of", "on", "or", "such",
+           "that", "the", "their", "then", "there", "these", "they", "this",
+           "to", "was", "will", "with"},
+    "fr": {"au", "aux", "avec", "ce", "ces", "dans", "de", "des", "du", "elle",
+           "en", "et", "eux", "il", "je", "la", "le", "les", "leur", "lui",
+           "ma", "mais", "me", "même", "mes", "moi", "mon", "ne", "nos",
+           "notre", "nous", "on", "ou", "par", "pas", "pour", "qu", "que",
+           "qui", "sa", "se", "ses", "son", "sur", "ta", "te", "tes", "toi",
+           "ton", "tu", "un", "une", "vos", "votre", "vous"},
+    "de": {"aber", "als", "am", "an", "auch", "auf", "aus", "bei", "bin",
+           "bis", "bist", "da", "damit", "das", "dass", "dein", "deine",
+           "dem", "den", "der", "des", "dessen", "die", "dir", "du", "ein",
+           "eine", "einem", "einen", "einer", "eines", "er", "es", "für",
+           "hatte", "hatten", "hattest", "hattet", "hier", "hinter", "ich",
+           "ihr", "ihre", "im", "in", "ist", "ja", "jede", "jedem", "jeden",
+           "jeder", "jedes", "jener", "jenes", "jetzt", "kann", "kannst",
+           "können", "könnt", "machen", "mein", "meine", "mit", "muss",
+           "musst", "müssen", "müsst", "nach", "nachdem", "nein", "nicht",
+           "nun", "oder", "seid", "sein", "seine", "sich", "sie", "sind",
+           "soll", "sollen", "sollst", "sollt", "sonst", "soweit", "sowie",
+           "und", "unser", "unsere", "unter", "vom", "von", "vor", "wann",
+           "warum", "was", "weiter", "weitere", "wenn", "wer", "werde",
+           "werden", "werdet", "weshalb", "wie", "wieder", "wieso", "wir",
+           "wird", "wirst", "wo", "woher", "wohin", "zu", "zum", "zur",
+           "über"},
+    "es": {"a", "al", "algo", "algunas", "algunos", "ante", "antes", "como",
+           "con", "contra", "cual", "cuando", "de", "del", "desde", "donde",
+           "durante", "e", "el", "ella", "ellas", "ellos", "en", "entre",
+           "era", "es", "esa", "ese", "eso", "esta", "este", "esto", "la",
+           "las", "le", "les", "lo", "los", "me", "mi", "mis", "mucho",
+           "muchos", "muy", "más", "ni", "no", "nos", "nosotros", "o",
+           "otra", "otros", "para", "pero", "poco", "por", "porque", "que",
+           "quien", "se", "sin", "sobre", "son", "su", "sus", "también",
+           "tanto", "te", "tiene", "toda", "todos", "tu", "un", "una",
+           "uno", "unos", "y", "ya", "yo"},
+}
+DEFAULT_LANGUAGE = "en"
+MIN_TOKEN_LENGTH = 1
+
+
+def analyze(text: Optional[str], language: str = DEFAULT_LANGUAGE,
+            min_token_length: int = MIN_TOKEN_LENGTH,
+            to_lowercase: bool = True, remove_stops: bool = True) -> List[str]:
+    """Default analysis chain: NFC normalize -> lowercase -> unicode word
+    split -> min length -> per-language stopwords."""
+    if not text:
+        return []
+    s = unicodedata.normalize("NFC", text)
+    if to_lowercase:
+        s = s.lower()
+    tokens = _WORD_RE.findall(s)
+    if min_token_length > 1:
+        tokens = [t for t in tokens if len(t) >= min_token_length]
+    if remove_stops:
+        stops = STOP_WORDS.get(language, set())
+        if stops:
+            tokens = [t for t in tokens if t not in stops]
+    return tokens
+
+
+# ---------------------------------------------------------------------------
+# Language detection (optimaize langdetect analog — char-trigram profiles)
+# ---------------------------------------------------------------------------
+_LANG_PROFILES: Dict[str, Set[str]] = {
+    # top distinctive character trigrams per language (hand-built micro
+    # profiles — the reference wraps optimaize; LangDetector.scala:46)
+    "en": {"the", "and", "ing", "ion", "tio", "ent", "for", "hat", "her", "tha"},
+    "fr": {"les", "que", "des", "ent", "ais", "our", "ait", "eur", "une", "dan"},
+    "de": {"der", "die", "und", "ein", "ich", "sch", "den", "cht", "ung", "gen"},
+    "es": {"que", "los", "del", "ent", "cio", "ado", "par", "las", "una", "con"},
+}
+
+
+def detect_language(text: Optional[str]) -> Tuple[str, float]:
+    """(language, confidence) from character trigram overlap."""
+    if not text:
+        return DEFAULT_LANGUAGE, 0.0
+    s = re.sub(r"[^\w\s]", "", text.lower())
+    trigrams = Counter(s[i:i + 3] for i in range(max(0, len(s) - 2))
+                       if not s[i:i + 3].isspace())
+    if not trigrams:
+        return DEFAULT_LANGUAGE, 0.0
+    scores = {}
+    for lang, profile in _LANG_PROFILES.items():
+        scores[lang] = sum(c for t, c in trigrams.items() if t in profile)
+    best = max(scores, key=scores.get)
+    total = sum(trigrams.values())
+    conf = scores[best] / total if total else 0.0
+    if scores[best] == 0:
+        return DEFAULT_LANGUAGE, 0.0
+    return best, conf
+
+
+class LangDetector(UnaryTransformer):
+    """Text -> PickList language code (LangDetector.scala:46)."""
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(operation_name="langDetect", input_type=T.Text,
+                         output_type=T.PickList, uid=uid)
+
+    def transform_fn(self, value: T.FeatureType) -> T.FeatureType:
+        if value.is_empty:
+            return T.PickList(None)
+        lang, conf = detect_language(value.value)
+        return T.PickList(lang if conf > 0 else None)
+
+
+# ---------------------------------------------------------------------------
+# Tokenization stages
+# ---------------------------------------------------------------------------
+class TextTokenizer(UnaryTransformer):
+    """Text -> TextList tokens (TextTokenizer.scala:125).
+
+    ``auto_detect_language`` switches the stopword list per row based on the
+    detected language (threshold ``auto_detect_threshold``, reference default
+    0.99 — relaxed here because the micro-profiles are coarser).
+    """
+
+    def __init__(self, language: str = DEFAULT_LANGUAGE, min_token_length: int = 1,
+                 to_lowercase: bool = True, filter_stopwords: bool = True,
+                 auto_detect_language: bool = False, auto_detect_threshold: float = 0.15,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="textToken", input_type=T.Text,
+                         output_type=T.TextList, uid=uid,
+                         language=language, min_token_length=min_token_length,
+                         to_lowercase=to_lowercase, filter_stopwords=filter_stopwords,
+                         auto_detect_language=auto_detect_language,
+                         auto_detect_threshold=auto_detect_threshold)
+
+    def tokenize(self, text: Optional[str]) -> List[str]:
+        lang = self.get_param("language", DEFAULT_LANGUAGE)
+        if self.get_param("auto_detect_language") and text:
+            detected, conf = detect_language(text)
+            if conf >= float(self.get_param("auto_detect_threshold", 0.15)):
+                lang = detected
+        return analyze(text, language=lang,
+                       min_token_length=int(self.get_param("min_token_length", 1)),
+                       to_lowercase=bool(self.get_param("to_lowercase", True)),
+                       remove_stops=bool(self.get_param("filter_stopwords", True)))
+
+    def transform_fn(self, value: T.FeatureType) -> T.FeatureType:
+        return T.TextList(self.tokenize(value.value))
+
+
+class OpStopWordsRemover(UnaryTransformer):
+    """TextList -> TextList minus stopwords (OpStopWordsRemover.scala:48)."""
+
+    def __init__(self, stop_words: Optional[Sequence[str]] = None,
+                 case_sensitive: bool = False, uid: Optional[str] = None):
+        words = list(stop_words) if stop_words is not None else sorted(STOP_WORDS["en"])
+        super().__init__(operation_name="stopWords", input_type=T.TextList,
+                         output_type=T.TextList, uid=uid,
+                         stop_words=words, case_sensitive=case_sensitive)
+
+    def transform_fn(self, value: T.FeatureType) -> T.FeatureType:
+        words = self.get_param("stop_words")
+        if self.get_param("case_sensitive"):
+            stops = set(words)
+            return T.TextList([t for t in value.value if t not in stops])
+        stops = {w.lower() for w in words}
+        return T.TextList([t for t in value.value if t.lower() not in stops])
+
+
+class OpNGram(UnaryTransformer):
+    """TextList -> TextList of space-joined n-grams (OpNGram.scala:52)."""
+
+    def __init__(self, n: int = 2, uid: Optional[str] = None):
+        if n < 1:
+            raise ValueError("n must be >= 1")
+        super().__init__(operation_name="ngram", input_type=T.TextList,
+                         output_type=T.TextList, uid=uid, n=n)
+
+    def transform_fn(self, value: T.FeatureType) -> T.FeatureType:
+        n = int(self.get_param("n"))
+        toks = value.value
+        return T.TextList([" ".join(toks[i:i + n]) for i in range(len(toks) - n + 1)])
+
+
+class TextLenTransformer(UnaryTransformer):
+    """Text/TextList -> Integral total character length (TextLenTransformer)."""
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(operation_name="textLen", input_type=T.Text,
+                         output_type=T.Integral, uid=uid)
+
+    def transform_fn(self, value: T.FeatureType) -> T.FeatureType:
+        v = value.value
+        if v is None:
+            return T.Integral(0)
+        if isinstance(v, str):
+            return T.Integral(len(v))
+        return T.Integral(sum(len(t) for t in v))
+
+
+# ---------------------------------------------------------------------------
+# Count vectorization (vocabulary TF)
+# ---------------------------------------------------------------------------
+class OpCountVectorizer(UnaryEstimator):
+    """TextList -> OPVector term counts over a fitted vocabulary
+    (OpCountVectorizer.scala:44; Spark CountVectorizer semantics: vocab of
+    top ``vocab_size`` terms with doc frequency >= ``min_df``)."""
+
+    def __init__(self, vocab_size: int = 512, min_df: int = 1, binary: bool = False,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="countVec", input_type=T.TextList,
+                         output_type=T.OPVector, uid=uid,
+                         vocab_size=vocab_size, min_df=min_df, binary=binary)
+
+    def fit_columns(self, cols: Sequence[Column], dataset: Dataset) -> "OpCountVectorizerModel":
+        col = cols[0]
+        assert isinstance(col, ObjectColumn)
+        df_counts: Counter = Counter()
+        for i in range(len(col)):
+            toks = col.values[i] or []
+            df_counts.update(set(toks))
+        min_df = int(self.get_param("min_df"))
+        vocab = [(t, c) for t, c in df_counts.items() if c >= min_df]
+        vocab.sort(key=lambda tc: (-tc[1], tc[0]))
+        vocab = [t for t, _ in vocab[: int(self.get_param("vocab_size"))]]
+        return OpCountVectorizerModel(vocabulary=vocab,
+                                      binary=bool(self.get_param("binary")),
+                                      operation_name=self.operation_name,
+                                      output_type=self.output_type)
+
+
+class OpCountVectorizerModel(Model):
+    def __init__(self, vocabulary: List[str], binary: bool = False,
+                 operation_name: str = "countVec", output_type=T.OPVector,
+                 uid: Optional[str] = None, **kw):
+        super().__init__(operation_name, output_type, uid=uid, **kw)
+        self.vocabulary = list(vocabulary)
+        self.binary = bool(binary)
+
+    def transform_columns(self, cols: Sequence[Column]) -> VectorColumn:
+        col = cols[0]
+        assert isinstance(col, ObjectColumn)
+        index = {t: j for j, t in enumerate(self.vocabulary)}
+        n, k = len(col), len(self.vocabulary)
+        out = np.zeros((n, k), dtype=np.float32)
+        for i in range(n):
+            for tok in (col.values[i] or []):
+                j = index.get(tok)
+                if j is not None:
+                    out[i, j] = 1.0 if self.binary else out[i, j] + 1.0
+        f = self.inputs[0]
+        vm = VectorMetadata(self.get_outputs()[0].name, tuple(
+            VectorColumnMetadata((f.name,), (f.ftype.__name__,), index=j, indicator_value=t)
+            for j, t in enumerate(self.vocabulary)))
+        self.metadata["vector_metadata"] = vm
+        return VectorColumn(T.OPVector, out, vm)
+
+
+# ---------------------------------------------------------------------------
+# String indexing
+# ---------------------------------------------------------------------------
+class OpStringIndexer(UnaryEstimator):
+    """Text -> RealNN index by descending frequency (OpStringIndexer.scala:53).
+
+    ``handle_invalid``: 'error' | 'skip'-as-NaN | 'keep' (unseen -> n_labels),
+    matching Spark StringIndexer's modes.
+    """
+
+    def __init__(self, handle_invalid: str = "keep", uid: Optional[str] = None):
+        assert handle_invalid in ("error", "skip", "keep")
+        super().__init__(operation_name="strIdx", input_type=T.Text,
+                         output_type=T.RealNN, uid=uid, handle_invalid=handle_invalid)
+
+    def fit_columns(self, cols: Sequence[Column], dataset: Dataset) -> "OpStringIndexerModel":
+        col = cols[0]
+        counts: Counter = Counter()
+        for i in range(len(col)):
+            v = col.values[i]
+            if v is not None:
+                counts[str(v)] += 1
+        labels = [t for t, _ in sorted(counts.items(), key=lambda tc: (-tc[1], tc[0]))]
+        return OpStringIndexerModel(labels=labels,
+                                    handle_invalid=str(self.get_param("handle_invalid")),
+                                    operation_name=self.operation_name,
+                                    output_type=self.output_type)
+
+
+class OpStringIndexerModel(Model):
+    def __init__(self, labels: List[str], handle_invalid: str = "keep",
+                 operation_name: str = "strIdx", output_type=T.RealNN,
+                 uid: Optional[str] = None, **kw):
+        super().__init__(operation_name, output_type, uid=uid, **kw)
+        self.labels = list(labels)
+        self.handle_invalid = handle_invalid
+
+    def transform_columns(self, cols: Sequence[Column]) -> NumericColumn:
+        col = cols[0]
+        index = {t: float(j) for j, t in enumerate(self.labels)}
+        n = len(col)
+        vals = np.zeros(n, dtype=np.float64)
+        mask = np.ones(n, dtype=bool)
+        for i in range(n):
+            v = col.values[i] if isinstance(col, ObjectColumn) else (
+                col.values[i] if col.mask[i] else None)
+            key = None if v is None else str(v)
+            j = index.get(key) if key is not None else None
+            if j is not None:
+                vals[i] = j
+            elif self.handle_invalid == "keep":
+                vals[i] = float(len(self.labels))
+            elif self.handle_invalid == "skip":
+                mask[i] = False
+            else:
+                raise ValueError(f"Unseen label {v!r} at row {i}")
+        self.metadata["labels"] = list(self.labels)
+        return NumericColumn(T.RealNN, vals, mask)
+
+
+class OpIndexToString(UnaryTransformer):
+    """RealNN index -> Text label (OpIndexToString.scala; inverse of indexer)."""
+
+    def __init__(self, labels: Sequence[str], uid: Optional[str] = None):
+        super().__init__(operation_name="idxToStr", input_type=T.RealNN,
+                         output_type=T.Text, uid=uid, labels=list(labels))
+
+    def transform_fn(self, value: T.FeatureType) -> T.FeatureType:
+        labels = self.get_param("labels")
+        if value.is_empty:
+            return T.Text(None)
+        i = int(value.value)
+        return T.Text(labels[i] if 0 <= i < len(labels) else None)
+
+
+# ---------------------------------------------------------------------------
+# Similarity transformers
+# ---------------------------------------------------------------------------
+def _char_ngrams(s: str, n: int) -> Set[str]:
+    s = s.lower()
+    if len(s) < n:
+        return {s} if s else set()
+    return {s[i:i + n] for i in range(len(s) - n + 1)}
+
+
+class NGramSimilarity(BinaryTransformer):
+    """(Text, Text) -> RealNN character-ngram Jaccard similarity
+    (NGramSimilarity.scala:42; Lucene NGramDistance analog)."""
+
+    def __init__(self, n: int = 3, uid: Optional[str] = None):
+        super().__init__(operation_name="ngramSim", output_type=T.RealNN, uid=uid, n=n)
+
+    def transform_fn(self, a: T.FeatureType, b: T.FeatureType) -> T.FeatureType:
+        n = int(self.get_param("n"))
+        va = a.value if isinstance(a.value, str) else " ".join(a.value or [])
+        vb = b.value if isinstance(b.value, str) else " ".join(b.value or [])
+        if not va or not vb:
+            return T.RealNN(0.0)
+        ga, gb = _char_ngrams(va, n), _char_ngrams(vb, n)
+        union = len(ga | gb)
+        return T.RealNN(len(ga & gb) / union if union else 0.0)
+
+
+class JaccardSimilarity(BinaryTransformer):
+    """(MultiPickList, MultiPickList) -> RealNN token Jaccard
+    (JaccardSimilarity.scala; utils JaccardSim analog)."""
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(operation_name="jacSim", output_type=T.RealNN, uid=uid)
+
+    def transform_fn(self, a: T.FeatureType, b: T.FeatureType) -> T.FeatureType:
+        sa = set(a.value or ())
+        sb = set(b.value or ())
+        if not sa and not sb:
+            return T.RealNN(1.0)
+        union = len(sa | sb)
+        return T.RealNN(len(sa & sb) / union if union else 0.0)

@@ -1,0 +1,177 @@
+"""Transmogrifier — automatic per-type default vectorization.
+
+Reference parity: ``Transmogrifier``
+(core/.../impl/feature/Transmogrifier.scala:92; dispatch :102-300; defaults
+:52-88): groups features by type and applies each type's default vectorizer,
+then combines everything into one OPVector.  Defaults mirror the reference:
+512 hash features (max 2^17), topK=20, minSupport=10, MurMur3 hashing,
+trackNulls=true, 30-category cutoff for smart text, circular date encodings.
+
+DSL entry: ``transmogrify(features)`` (dsl/RichFeaturesCollection.scala:69).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Type
+
+from ... import types as T
+from ...features.feature import Feature
+from .bucketizers import DecisionTreeNumericBucketizer
+from .dates import DateListVectorizer, DateToUnitCircleTransformer, TimePeriod
+from .geo import GeolocationMapVectorizer, GeolocationVectorizer
+from .hashing import CollectionHashingVectorizer
+from .map_vectorizers import (MultiPickListMapVectorizer, OPMapVectorizer,
+                              TextMapPivotVectorizer)
+from .smart_text import SmartTextMapVectorizer, SmartTextVectorizer
+from .vectorizers import (BinaryVectorizer, IntegralVectorizer, OneHotVectorizer,
+                          RealNNVectorizer, RealVectorizer, VectorsCombiner)
+
+
+class TransmogrifierDefaults:
+    """Transmogrifier.scala:52-88."""
+
+    DefaultNumOfFeatures = 512
+    MaxNumOfFeatures = 2 ** 17
+    TopK = 20
+    MinSupport = 10
+    FillValue = 0
+    BinaryFillValue = False
+    FillWithMean = True
+    FillWithMode = True
+    TrackNulls = True
+    TrackInvalid = False
+    MinInfoGain = 0.01
+    MaxCategoricalCardinality = 30
+    CircularDateRepresentations = [TimePeriod.HourOfDay, TimePeriod.DayOfWeek,
+                                   TimePeriod.DayOfMonth, TimePeriod.DayOfYear]
+
+
+# type groups, dispatched most-specific-first (Transmogrifier.scala:102-300)
+_CATEGORICAL_TEXT = (T.PickList, T.ComboBox, T.Country, T.State, T.City,
+                     T.PostalCode, T.Street, T.ID)
+_FREE_TEXT = (T.TextArea, T.Email, T.URL, T.Phone, T.Base64, T.Text)
+_TEXT_MAPS = (T.TextAreaMap, T.EmailMap, T.URLMap, T.PhoneMap, T.Base64Map,
+              T.IDMap, T.TextMap)
+_PIVOT_MAPS = (T.PickListMap, T.ComboBoxMap, T.CountryMap, T.StateMap, T.CityMap,
+               T.PostalCodeMap, T.StreetMap)
+_NUMERIC_MAPS = (T.CurrencyMap, T.PercentMap, T.RealMap, T.IntegralMap,
+                 T.BinaryMap, T.DateTimeMap, T.DateMap)
+
+
+def _group_by(features: Sequence[Feature], *types: Type[T.FeatureType]
+              ) -> Dict[Type[T.FeatureType], List[Feature]]:
+    """Assign each feature to the FIRST matching type in ``types``."""
+    groups: Dict[Type[T.FeatureType], List[Feature]] = {}
+    for f in features:
+        for t in types:
+            if issubclass(f.ftype, t):
+                groups.setdefault(t, []).append(f)
+                break
+    return groups
+
+
+def transmogrify(features: Sequence[Feature], label: Optional[Feature] = None,
+                 defaults: Type[TransmogrifierDefaults] = TransmogrifierDefaults
+                 ) -> Feature:
+    """Vectorize a heterogeneous feature set with per-type defaults and
+    combine into one OPVector feature (Transmogrifier.scala:92).
+
+    ``label`` enables label-aware paths (DecisionTreeNumericBucketizer adds
+    bucketized views of numeric features next to their linear encoding —
+    the reference's autoBucketize integration).
+    """
+    if not features:
+        raise ValueError("transmogrify requires at least one feature")
+    d = defaults
+    vectors: List[Feature] = []
+
+    # dispatch order: subclasses before bases (DateTime < Date < Integral etc.)
+    dispatch = [
+        # vectors pass through
+        (T.OPVector, lambda fs: [f for f in fs]),
+        (T.Prediction, lambda fs: []),  # predictions are not predictors
+        # geolocation before OPList (Geolocation extends OPList)
+        (T.Geolocation, lambda fs: [
+            GeolocationVectorizer(track_nulls=d.TrackNulls).set_input(*fs).get_output()]),
+        (T.DateList, lambda fs: [
+            DateListVectorizer(track_nulls=d.TrackNulls).set_input(*fs).get_output()]),
+        (T.TextList, lambda fs: [
+            CollectionHashingVectorizer(num_features=d.DefaultNumOfFeatures,
+                                        track_nulls=d.TrackNulls)
+            .set_input(*fs).get_output()]),
+        (T.MultiPickList, lambda fs: [
+            OneHotVectorizer(top_k=d.TopK, min_support=d.MinSupport,
+                             track_nulls=d.TrackNulls).set_input(*fs).get_output()]),
+        # maps
+        (T.GeolocationMap, lambda fs: [
+            GeolocationMapVectorizer(track_nulls=d.TrackNulls).set_input(*fs).get_output()]),
+        (T.MultiPickListMap, lambda fs: [
+            MultiPickListMapVectorizer(top_k=d.TopK, min_support=d.MinSupport,
+                                       track_nulls=d.TrackNulls)
+            .set_input(*fs).get_output()]),
+        *[(t, lambda fs: [
+            TextMapPivotVectorizer(top_k=d.TopK, min_support=d.MinSupport,
+                                   track_nulls=d.TrackNulls).set_input(*fs).get_output()])
+          for t in _PIVOT_MAPS],
+        *[(t, lambda fs: [
+            SmartTextMapVectorizer(max_cardinality=d.MaxCategoricalCardinality,
+                                   top_k=d.TopK, min_support=d.MinSupport,
+                                   num_hashes=d.DefaultNumOfFeatures,
+                                   track_nulls=d.TrackNulls).set_input(*fs).get_output()])
+          for t in _TEXT_MAPS],
+        *[(t, lambda fs: [
+            OPMapVectorizer(fill_with_mean=d.FillWithMean, track_nulls=d.TrackNulls)
+            .set_input(*fs).get_output()]) for t in _NUMERIC_MAPS],
+        # categorical text pivots
+        *[(t, lambda fs: [
+            OneHotVectorizer(top_k=d.TopK, min_support=d.MinSupport,
+                             track_nulls=d.TrackNulls).set_input(*fs).get_output()])
+          for t in _CATEGORICAL_TEXT],
+        # free text: smart categorical-vs-hash decision
+        *[(t, lambda fs: [
+            SmartTextVectorizer(max_cardinality=d.MaxCategoricalCardinality,
+                                top_k=d.TopK, min_support=d.MinSupport,
+                                num_hashes=d.DefaultNumOfFeatures,
+                                track_nulls=d.TrackNulls).set_input(*fs).get_output()])
+          for t in _FREE_TEXT],
+        # dates: circular encodings (before Integral — DateTime < Date < Integral)
+        (T.Date, lambda fs: [
+            DateToUnitCircleTransformer(time_period=p).set_input(*fs).get_output()
+            for p in d.CircularDateRepresentations]),
+        # numerics
+        (T.Binary, lambda fs: [
+            BinaryVectorizer(track_nulls=d.TrackNulls).set_input(*fs).get_output()]),
+        (T.Integral, lambda fs: [
+            IntegralVectorizer(track_nulls=d.TrackNulls).set_input(*fs).get_output()]),
+        (T.RealNN, lambda fs: [RealNNVectorizer().set_input(*fs).get_output()]),
+        (T.Real, lambda fs: _real_outputs(fs, label, d)),
+    ]
+    order = [t for t, _ in dispatch]
+    makers = dict(zip(order, [m for _, m in dispatch]))
+    groups = _group_by(features, *order)
+    unmatched = [f for f in features
+                 if not any(issubclass(f.ftype, t) for t in order)]
+    if unmatched:
+        raise ValueError(
+            f"No default vectorizer for features: "
+            f"{[(f.name, f.ftype.__name__) for f in unmatched]}")
+    for t in order:
+        fs = groups.get(t)
+        if fs:
+            vectors.extend(makers[t](fs))
+    if len(vectors) == 1:
+        return vectors[0]
+    return VectorsCombiner().set_input(*vectors).get_output()
+
+
+def _real_outputs(fs: Sequence[Feature], label: Optional[Feature],
+                  d: Type[TransmogrifierDefaults]) -> List[Feature]:
+    outs = [RealVectorizer(fill_with_mean=d.FillWithMean, track_nulls=d.TrackNulls)
+            .set_input(*fs).get_output()]
+    if label is not None:
+        for f in fs:
+            outs.append(
+                DecisionTreeNumericBucketizer(min_info_gain=d.MinInfoGain,
+                                              track_nulls=d.TrackNulls,
+                                              track_invalid=True)
+                .set_input(label, f).get_output())
+    return outs

@@ -1,0 +1,26 @@
+"""Native (C++) runtime kernels, loaded via ctypes with Python fallbacks.
+
+The reference gets native performance from JVM dependencies (netlib BLAS,
+XGBoost JNI — SURVEY §2.6); here the host-side hot loops (hashing,
+streaming histograms, CSV tokenization) are C++ compiled on first use with
+g++ into ``_libtransmog.so``.  Every entry point has a pure-Python fallback
+so the framework works without a toolchain.
+
+Exports (``None`` when the native library is unavailable):
+- ``murmur3(data: bytes, seed) -> int`` — MurMur3 x86/32.
+- ``hash_terms_batch(...)`` — bulk token hashing for the vectorizers.
+- ``lib`` — the raw ctypes library handle.
+"""
+from __future__ import annotations
+
+from .build import load_native
+
+lib = load_native()
+
+if lib is not None:
+    import ctypes
+
+    def murmur3(data: bytes, seed: int = 42) -> int:
+        return int(lib.tm_murmur3_32(data, len(data), ctypes.c_uint32(seed)))
+else:
+    murmur3 = None

@@ -1,0 +1,70 @@
+"""Build + load the native C++ kernel library.
+
+Compiles ``src/*.cpp`` with g++ -O3 into ``_libtransmog.so`` next to this
+file, caching on mtimes.  Failures (no toolchain, sandboxed env) degrade to
+``None`` and the Python fallbacks take over.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC_DIR = os.path.join(_DIR, "src")
+_LIB_PATH = os.path.join(_DIR, "_libtransmog.so")
+
+
+def _needs_rebuild() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    for name in os.listdir(_SRC_DIR):
+        if name.endswith((".cpp", ".h")):
+            if os.path.getmtime(os.path.join(_SRC_DIR, name)) > lib_mtime:
+                return True
+    return False
+
+
+def build(verbose: bool = False) -> Optional[str]:
+    """Compile the native library; returns its path or None on failure."""
+    if not os.path.isdir(_SRC_DIR):
+        return None
+    sources = [os.path.join(_SRC_DIR, n) for n in sorted(os.listdir(_SRC_DIR))
+               if n.endswith(".cpp")]
+    if not sources:
+        return None
+    if not _needs_rebuild():
+        return _LIB_PATH
+    cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+           "-o", _LIB_PATH] + sources
+    try:
+        res = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if res.returncode != 0:
+        if verbose:
+            print(f"native build failed:\n{res.stderr}", file=sys.stderr)
+        return None
+    return _LIB_PATH
+
+
+def load_native() -> Optional[ctypes.CDLL]:
+    """Build if needed and dlopen; configure ctypes signatures."""
+    if os.environ.get("TRANSMOG_NO_NATIVE"):
+        return None
+    path = build()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    try:
+        lib.tm_murmur3_32.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32]
+        lib.tm_murmur3_32.restype = ctypes.c_uint32
+    except AttributeError:
+        return None
+    return lib

@@ -1,0 +1,51 @@
+// MurMur3 x86/32 — the hash behind the hashing vectorizers
+// (reference: Spark HashingTF's MurmurHash3_x86_32; used by
+// OPCollectionHashingVectorizer.scala:59 and OpHashingTF.scala:50).
+#include <cstdint>
+#include <cstddef>
+
+static inline uint32_t rotl32(uint32_t x, int8_t r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+extern "C" uint32_t tm_murmur3_32(const char* data, size_t len, uint32_t seed) {
+  const uint32_t c1 = 0xcc9e2d51u;
+  const uint32_t c2 = 0x1b873593u;
+  uint32_t h = seed;
+  const size_t nblocks = len / 4;
+  const uint8_t* bytes = reinterpret_cast<const uint8_t*>(data);
+
+  for (size_t i = 0; i < nblocks; ++i) {
+    uint32_t k = static_cast<uint32_t>(bytes[i * 4]) |
+                 (static_cast<uint32_t>(bytes[i * 4 + 1]) << 8) |
+                 (static_cast<uint32_t>(bytes[i * 4 + 2]) << 16) |
+                 (static_cast<uint32_t>(bytes[i * 4 + 3]) << 24);
+    k *= c1;
+    k = rotl32(k, 15);
+    k *= c2;
+    h ^= k;
+    h = rotl32(h, 13);
+    h = h * 5 + 0xe6546b64u;
+  }
+
+  const uint8_t* tail = bytes + nblocks * 4;
+  uint32_t k1 = 0;
+  switch (len & 3) {
+    case 3: k1 ^= static_cast<uint32_t>(tail[2]) << 16; [[fallthrough]];
+    case 2: k1 ^= static_cast<uint32_t>(tail[1]) << 8; [[fallthrough]];
+    case 1:
+      k1 ^= tail[0];
+      k1 *= c1;
+      k1 = rotl32(k1, 15);
+      k1 *= c2;
+      h ^= k1;
+  }
+
+  h ^= static_cast<uint32_t>(len);
+  h ^= h >> 16;
+  h *= 0x85ebca6bu;
+  h ^= h >> 13;
+  h *= 0xc2b2ae35u;
+  h ^= h >> 16;
+  return h;
+}

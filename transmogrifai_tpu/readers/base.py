@@ -27,6 +27,8 @@ def _records_from(data: Any) -> List[Dict[str, Any]]:
 
     if isinstance(data, pd.DataFrame):
         return data.to_dict("records")
+    if isinstance(data, Dataset):
+        return _records_from(data.to_pandas())
     return list(data)
 
 
@@ -95,7 +97,9 @@ class DataReader(Reader):
 
     def _key_of(self, record: Dict[str, Any], i: int) -> str:
         if self.key is None:
-            return str(i)
+            # preserve pre-existing keys (e.g. a Dataset round-tripped through
+            # CustomReader) before falling back to the positional index
+            return str(record.get(KEY_FIELD, i)) if isinstance(record, dict) else str(i)
         if callable(self.key):
             return str(self.key(record))
         return str(record.get(self.key, i))
@@ -105,6 +109,8 @@ class DataReader(Reader):
         import pandas as pd
 
         data = self.read(params)
+        if isinstance(data, Dataset):
+            data = data.to_pandas()  # keeps field extraction on the vectorized path
         df = data if isinstance(data, pd.DataFrame) else None
         records = _records_from(data)
         limit = (params or {}).get("maybeReaderParams", {}).get("limit") or (params or {}).get("limit")
