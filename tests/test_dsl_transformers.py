@@ -1,0 +1,190 @@
+"""DSL, math/munging transformers, scalers/calibrators, detectors, embeddings
+(SURVEY §2.3 'Scalers/misc', 'DSL', 'Text processing' detectors)."""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu  # noqa: F401  (installs DSL)
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+from transmogrifai_tpu.columns import Dataset, NumericColumn, ObjectColumn, VectorColumn
+from transmogrifai_tpu.impl.feature import (
+    DescalerTransformer, IsotonicRegressionCalibrator, OpLDA, OpWord2Vec,
+    PercentileCalibrator, PredictionDeIndexer, ScalerTransformer, ScalingType,
+    SubstringTransformer, detect_mime_type, detect_name, parse_phone,
+)
+
+
+def _feat(name, ftype, is_response=False):
+    fb = FeatureBuilder(name, ftype).from_field()
+    return fb.as_response() if is_response else fb.as_predictor()
+
+
+def _num(vals, mask=None, ftype=T.Real):
+    vals = np.asarray(vals, dtype=np.float64)
+    mask = np.ones(len(vals), bool) if mask is None else np.asarray(mask, bool)
+    return NumericColumn(ftype, vals, mask)
+
+
+# ---------------------------------------------------------------------------
+# DSL arithmetic end-to-end through a workflow
+# ---------------------------------------------------------------------------
+def test_dsl_arithmetic_workflow():
+    a, b = _feat("a", T.Real), _feat("b", T.Real)
+    fam = (a + b + 1).alias("family_size")
+    ds = Dataset({"a": _num([1.0, 2.0]), "b": _num([10.0, 20.0])})
+    model = OpWorkflow().set_input_dataset(ds).set_result_features(fam).train()
+    out = model.score(ds)["family_size"]
+    assert out.values.tolist() == [12.0, 23.0]
+
+
+def test_dsl_arithmetic_null_semantics():
+    a, b = _feat("a", T.Real), _feat("b", T.Real)
+    s = a + b
+    ds = Dataset({"a": _num([1.0, 5.0], [True, True]),
+                  "b": _num([2.0, 0.0], [True, False])})
+    model = OpWorkflow().set_input_dataset(ds).set_result_features(s).train()
+    out = model.score(ds)[s.name]
+    # present + missing -> present side wins (reference MathTransformers)
+    assert out.values.tolist() == [3.0, 5.0]
+    assert out.mask.tolist() == [True, True]
+    d = a / b
+    model2 = OpWorkflow().set_input_dataset(ds).set_result_features(d).train()
+    out2 = model2.score(ds)[d.name]
+    assert out2.mask.tolist() == [True, False]  # division needs both
+
+
+def test_dsl_scalar_ops_and_rops():
+    a = _feat("a", T.Real)
+    expr = (10.0 - a) * 2
+    ds = Dataset({"a": _num([4.0])})
+    model = OpWorkflow().set_input_dataset(ds).set_result_features(expr).train()
+    assert model.score(ds)[expr.name].values.tolist() == [12.0]
+
+
+def test_dsl_text_chain():
+    txt = _feat("t", T.Text)
+    counted = txt.tokenize().count_vectorize(vocab_size=10, min_df=1)
+    ds = Dataset({"t": ObjectColumn(T.Text, ["the cat sat", "cat cat dog", None])})
+    model = OpWorkflow().set_input_dataset(ds).set_result_features(counted).train()
+    out = model.score(ds)[counted.name]
+    assert out.values.shape[0] == 3
+    assert out.values[2].sum() == 0.0  # null row -> empty counts
+
+
+def test_dsl_exists_occurs_replace():
+    t = _feat("t", T.Text)
+    ds = Dataset({"t": ObjectColumn(T.Text, ["x", None, "y"])})
+    e = t.exists()
+    model = OpWorkflow().set_input_dataset(ds).set_result_features(e).train()
+    assert model.score(ds)[e.name].values.tolist() == [1.0, 0.0, 1.0]
+    r = t.replace_with("x", "z")
+    model2 = OpWorkflow().set_input_dataset(ds).set_result_features(r).train()
+    assert model2.score(ds)[r.name].values[0] == "z"
+
+
+# ---------------------------------------------------------------------------
+# scalers / calibrators
+# ---------------------------------------------------------------------------
+def test_scaler_descaler_roundtrip():
+    x = _feat("x", T.Real)
+    scaled = ScalerTransformer(ScalingType.Linear, slope=2.0, intercept=3.0) \
+        .set_input(x).get_output()
+    descaled = DescalerTransformer().set_input(scaled, scaled).get_output()
+    ds = Dataset({"x": _num([1.0, 5.0])})
+    model = OpWorkflow().set_input_dataset(ds).set_result_features(descaled).train()
+    out = model.score(ds)[descaled.name]
+    assert out.values.tolist() == [1.0, 5.0]
+
+
+def test_percentile_calibrator():
+    s = _feat("s", T.RealNN)
+    cal = PercentileCalibrator(buckets=4).set_input(s).get_output()
+    vals = np.arange(100, dtype=np.float64)
+    ds = Dataset({"s": _num(vals, ftype=T.RealNN)})
+    model = OpWorkflow().set_input_dataset(ds).set_result_features(cal).train()
+    out = model.score(ds)[cal.name]
+    assert set(out.values.tolist()) == {0.0, 1.0, 2.0, 3.0}
+    assert out.values[0] == 0.0 and out.values[99] == 3.0
+
+
+def test_isotonic_calibrator_monotone():
+    rng = np.random.default_rng(0)
+    scores = rng.uniform(0, 1, 300)
+    labels = (rng.uniform(0, 1, 300) < scores).astype(float)  # calibrated-ish
+    label_f, score_f = _feat("y", T.RealNN, True), _feat("s", T.RealNN)
+    cal = IsotonicRegressionCalibrator().set_input(label_f, score_f).get_output()
+    ds = Dataset({"y": _num(labels, ftype=T.RealNN), "s": _num(scores, ftype=T.RealNN)})
+    model = OpWorkflow().set_input_dataset(ds).set_result_features(cal).train()
+    out = model.score(ds)[cal.name].values
+    order = np.argsort(scores)
+    diffs = np.diff(out[order])
+    assert np.all(diffs >= -1e-9)  # monotone in score
+
+
+def test_substring_and_deindexer():
+    st = SubstringTransformer()
+    st.set_input(_feat("a", T.Text), _feat("b", T.Text))
+    assert st.transform_fn(T.Text("Hello World"), T.Text("world")).value is True
+    de = PredictionDeIndexer(labels=["no", "yes"])
+    de.set_input(_feat("p", T.Prediction))
+    assert de.transform_row({"p": T.Prediction(prediction=1.0)}).value == "yes"
+
+
+# ---------------------------------------------------------------------------
+# detectors
+# ---------------------------------------------------------------------------
+def test_phone_email_mime_name():
+    assert parse_phone("(415) 555-1234") == (True, "+14155551234")
+    assert parse_phone("+33612345678", "FR")[0] is True
+    assert parse_phone("123")[0] is False
+    assert detect_name("Mr. John Smith")["isName"] == "true"
+    assert detect_name("purchase order 1234")["isName"] == "false"
+    assert detect_mime_type(b"%PDF-1.4 blah") == "application/pdf"
+    assert detect_mime_type(b"\x89PNG\r\n\x1a\nxxxx") == "image/png"
+    assert detect_mime_type(b"plain old text") == "text/plain"
+
+
+def test_dsl_detector_methods():
+    e = _feat("e", T.Email)
+    dom = e.to_email_domain()
+    ds = Dataset({"e": ObjectColumn(T.Email, ["a@b.com", "bad", None])})
+    model = OpWorkflow().set_input_dataset(ds).set_result_features(dom).train()
+    out = model.score(ds)[dom.name]
+    assert out.values[0] == "b.com" and out.values[1] is None
+
+
+# ---------------------------------------------------------------------------
+# embeddings
+# ---------------------------------------------------------------------------
+def test_word2vec_learns_cooccurrence():
+    docs = ([["king", "queen", "royal"], ["king", "crown"], ["queen", "crown"],
+             ["apple", "fruit"], ["banana", "fruit"], ["apple", "banana"]] * 10)
+    ds = Dataset({"toks": ObjectColumn(T.TextList, docs)})
+    est = OpWord2Vec(vector_size=16, min_count=1, epochs=60, learning_rate=0.5)
+    est.set_input(_feat("toks", T.TextList))
+    model = est.fit(ds)
+    vecs = {t: model.vectors[i] for i, t in enumerate(model.vocabulary)}
+
+    def cos(a, b):
+        return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-9))
+
+    assert cos(vecs["king"], vecs["queen"]) > cos(vecs["king"], vecs["fruit"])
+    out = model.transform_dataset(ds)
+    assert out.values.shape == (len(docs), 16)
+
+
+def test_lda_topic_distributions():
+    rng = np.random.default_rng(1)
+    # two disjoint topic blocks over 20 terms
+    X1 = np.concatenate([rng.poisson(3.0, (15, 10)), rng.poisson(0.05, (15, 10))], axis=1)
+    X2 = np.concatenate([rng.poisson(0.05, (15, 10)), rng.poisson(3.0, (15, 10))], axis=1)
+    X = np.concatenate([X1, X2]).astype(np.float32)
+    ds = Dataset({"v": VectorColumn(T.OPVector, X)})
+    est = OpLDA(k=2, max_iter=15)
+    est.set_input(_feat("v", T.OPVector))
+    theta = est.fit(ds).transform_dataset(ds).values
+    assert np.allclose(theta.sum(axis=1), 1.0, atol=1e-4)
+    # docs from the same block agree on dominant topic; blocks differ
+    t1 = np.argmax(theta[:15].mean(axis=0))
+    t2 = np.argmax(theta[15:].mean(axis=0))
+    assert t1 != t2

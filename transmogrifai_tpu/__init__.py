@@ -29,6 +29,8 @@ from .stages.base import (
 from .workflow.params import OpParams
 from .workflow.workflow import OpWorkflow
 from .workflow.model import OpWorkflowModel, load_model
+from . import dsl  # installs the rich-feature methods on Feature
+from .impl.feature.transmogrifier import transmogrify
 
 __version__ = "0.1.0"
 __all__ = [n for n in dir() if not n.startswith("_")]

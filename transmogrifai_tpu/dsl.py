@@ -1,0 +1,247 @@
+"""DSL — rich methods installed on ``Feature`` (the syntax layer).
+
+Reference parity: core/src/main/scala/com/salesforce/op/dsl/ — the implicit
+classes ``RichNumericFeature`` (arithmetic, vectorize, autoBucketize,
+zNormalize), ``RichTextFeature`` (tokenize, pivot, smartVectorize),
+``RichFeature`` (alias, map, filter, replaceWith, exists, toOccur),
+``RichVectorFeature`` (sanityCheck, combine), ``RichDateFeature``,
+``RichFeaturesCollection`` (transmogrify).
+
+Python has no implicits; instead the methods are installed directly on the
+``Feature`` class when this module imports (the package ``__init__`` imports
+it, so ``from transmogrifai_tpu import *`` gives the full DSL).  Operator
+overloads make ``(sib_sp + par_ch + 1).alias("family_size")`` work exactly
+like the reference's Titanic example (OpTitanicSimple.scala:77-130).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Type
+
+from . import types as T
+from .features.feature import Feature
+from .impl.feature.bucketizers import DecisionTreeNumericBucketizer, NumericBucketizer
+from .impl.feature.dates import DateListVectorizer, DateToUnitCircleTransformer, TimePeriod
+from .impl.feature.detectors import (EmailToPickList, HumanNameDetector,
+                                     MimeTypeDetector, NameEntityRecognizer,
+                                     PhoneNumberParser, UrlToPickList,
+                                     ValidEmailTransformer)
+from .impl.feature.scalers import OpScalarStandardScaler
+from .impl.feature.smart_text import SmartTextVectorizer
+from .impl.feature.text import (LangDetector, OpCountVectorizer, OpNGram,
+                                OpStopWordsRemover, TextLenTransformer, TextTokenizer)
+from .impl.feature.transformers import (AddTransformer, AliasTransformer,
+                                        DivideTransformer, ExistsTransformer,
+                                        FillMissingWithMean, FilterTransformer,
+                                        LambdaTransformer, MultiplyTransformer,
+                                        ReplaceTransformer, ScalarMathTransformer,
+                                        SubtractTransformer, ToOccurTransformer)
+from .impl.feature.transmogrifier import transmogrify
+from .impl.feature.vectorizers import OneHotVectorizer, VectorsCombiner
+
+
+def _unary(stage, feature: Feature) -> Feature:
+    return stage.set_input(feature).get_output()
+
+
+def _binary_math(stage_cls, scalar_op: str):
+    def method(self: Feature, other):
+        if isinstance(other, Feature):
+            return stage_cls().set_input(self, other).get_output()
+        if not isinstance(other, (int, float)):
+            return NotImplemented
+        return _unary(ScalarMathTransformer(scalar_op, float(other)), self)
+    return method
+
+
+def _r_scalar(op: str):
+    def method(self: Feature, other):
+        if not isinstance(other, (int, float)):
+            return NotImplemented
+        return _unary(ScalarMathTransformer(op, float(other)), self)
+    return method
+
+
+# ---------------------------------------------------------------------------
+# generic (RichFeature)
+# ---------------------------------------------------------------------------
+def alias(self: Feature, name: str) -> Feature:
+    return _unary(AliasTransformer(name), self)
+
+
+def map_fn(self: Feature, fn: Callable, output_type: Type[T.FeatureType]) -> Feature:
+    return _unary(LambdaTransformer(fn, self.ftype, output_type), self)
+
+
+def filter_by(self: Feature, predicate: Callable[[Any], bool]) -> Feature:
+    return _unary(FilterTransformer(predicate, self.ftype), self)
+
+
+def replace_with(self: Feature, match_value: Any, replace_value: Any) -> Feature:
+    return _unary(ReplaceTransformer(match_value, replace_value, self.ftype), self)
+
+
+def exists(self: Feature) -> Feature:
+    return _unary(ExistsTransformer(self.ftype), self)
+
+
+def occurs(self: Feature) -> Feature:
+    return _unary(ToOccurTransformer(self.ftype), self)
+
+
+# ---------------------------------------------------------------------------
+# numeric (RichNumericFeature)
+# ---------------------------------------------------------------------------
+def vectorize(self: Feature, *others: Feature, label: Optional[Feature] = None,
+              **kw) -> Feature:
+    """Type-default vectorization of this + optionally more features
+    (RichFeature.vectorize / transmogrify on one group)."""
+    return transmogrify([self, *others], label=label, **kw)
+
+
+def auto_bucketize(self: Feature, label: Feature, **kw) -> Feature:
+    """Label-aware bucketing (RichNumericFeature.autoBucketize)."""
+    return DecisionTreeNumericBucketizer(**kw).set_input(label, self).get_output()
+
+
+def bucketize(self: Feature, splits: Sequence[float], **kw) -> Feature:
+    return _unary(NumericBucketizer(splits=splits, **kw), self)
+
+
+def z_normalize(self: Feature) -> Feature:
+    """RichNumericFeature.zNormalize."""
+    return _unary(OpScalarStandardScaler(), self)
+
+
+def fill_missing_with_mean(self: Feature, default: float = 0.0) -> Feature:
+    return _unary(FillMissingWithMean(default=default), self)
+
+
+# ---------------------------------------------------------------------------
+# text (RichTextFeature)
+# ---------------------------------------------------------------------------
+def tokenize(self: Feature, **kw) -> Feature:
+    return _unary(TextTokenizer(**kw), self)
+
+
+def smart_vectorize(self: Feature, *others: Feature, **kw) -> Feature:
+    return SmartTextVectorizer(**kw).set_input(self, *others).get_output()
+
+
+def pivot(self: Feature, *others: Feature, top_k: int = 20, min_support: int = 10,
+          **kw) -> Feature:
+    """Categorical one-hot pivot (RichTextFeature.pivot)."""
+    return OneHotVectorizer(top_k=top_k, min_support=min_support, **kw) \
+        .set_input(self, *others).get_output()
+
+
+def detect_languages(self: Feature) -> Feature:
+    return _unary(LangDetector(), self)
+
+
+def text_len(self: Feature) -> Feature:
+    return _unary(TextLenTransformer(), self)
+
+
+def remove_stop_words(self: Feature, **kw) -> Feature:
+    return _unary(OpStopWordsRemover(**kw), self)
+
+
+def ngram(self: Feature, n: int = 2) -> Feature:
+    return _unary(OpNGram(n=n), self)
+
+
+def count_vectorize(self: Feature, **kw) -> Feature:
+    return _unary(OpCountVectorizer(**kw), self)
+
+
+def is_valid_email(self: Feature) -> Feature:
+    return _unary(ValidEmailTransformer(), self)
+
+
+def to_email_domain(self: Feature) -> Feature:
+    return _unary(EmailToPickList(), self)
+
+
+def to_url_host(self: Feature) -> Feature:
+    return _unary(UrlToPickList(), self)
+
+
+def is_valid_phone(self: Feature, region: str = "US") -> Feature:
+    return _unary(PhoneNumberParser(region=region), self)
+
+
+def detect_mime_types(self: Feature) -> Feature:
+    return _unary(MimeTypeDetector(), self)
+
+
+def detect_names(self: Feature) -> Feature:
+    return _unary(HumanNameDetector(), self)
+
+
+def recognize_entities(self: Feature) -> Feature:
+    return _unary(NameEntityRecognizer(), self)
+
+
+# ---------------------------------------------------------------------------
+# dates (RichDateFeature)
+# ---------------------------------------------------------------------------
+def to_unit_circle(self: Feature, time_period: TimePeriod = TimePeriod.HourOfDay) -> Feature:
+    return _unary(DateToUnitCircleTransformer(time_period=time_period), self)
+
+
+def vectorize_date_list(self: Feature, **kw) -> Feature:
+    return _unary(DateListVectorizer(**kw), self)
+
+
+# ---------------------------------------------------------------------------
+# vector (RichVectorFeature)
+# ---------------------------------------------------------------------------
+def sanity_check(self: Feature, label: Feature, **kw) -> Feature:
+    """RichVectorFeature.sanityCheck — label-aware feature QA."""
+    from .impl.preparators.sanity_checker import SanityChecker
+
+    return SanityChecker(**kw).set_input(label, self).get_output()
+
+
+def combine(self: Feature, *others: Feature) -> Feature:
+    return VectorsCombiner().set_input(self, *others).get_output()
+
+
+_METHODS = {
+    # generic
+    "alias": alias, "map": map_fn, "filter": filter_by, "replace_with": replace_with,
+    "exists": exists, "occurs": occurs,
+    # numeric
+    "vectorize": vectorize, "auto_bucketize": auto_bucketize, "bucketize": bucketize,
+    "z_normalize": z_normalize, "fill_missing_with_mean": fill_missing_with_mean,
+    # text
+    "tokenize": tokenize, "smart_vectorize": smart_vectorize, "pivot": pivot,
+    "detect_languages": detect_languages, "text_len": text_len,
+    "remove_stop_words": remove_stop_words, "ngram": ngram,
+    "count_vectorize": count_vectorize, "is_valid_email": is_valid_email,
+    "to_email_domain": to_email_domain, "to_url_host": to_url_host,
+    "is_valid_phone": is_valid_phone, "detect_mime_types": detect_mime_types,
+    "detect_names": detect_names, "recognize_entities": recognize_entities,
+    # dates
+    "to_unit_circle": to_unit_circle, "vectorize_date_list": vectorize_date_list,
+    # vector
+    "sanity_check": sanity_check, "combine": combine,
+    # operators
+    "__add__": _binary_math(AddTransformer, "plus"),
+    "__sub__": _binary_math(SubtractTransformer, "minus"),
+    "__mul__": _binary_math(MultiplyTransformer, "multiply"),
+    "__truediv__": _binary_math(DivideTransformer, "divide"),
+    "__radd__": _r_scalar("plus"),
+    "__rsub__": _r_scalar("rminus"),
+    "__rmul__": _r_scalar("multiply"),
+    "__rtruediv__": _r_scalar("rdivide"),
+}
+
+
+def install() -> None:
+    """Install the DSL methods on Feature (idempotent)."""
+    for name, fn in _METHODS.items():
+        setattr(Feature, name, fn)
+
+
+install()

@@ -1,4 +1,22 @@
 """Feature-engineering stages (core/.../stages/impl/feature analog)."""
+from .detectors import (EmailToPickList, HumanNameDetector, MimeTypeDetector,
+                        NameEntityRecognizer, NormalizePhoneNumber,
+                        PhoneNumberParser, UrlToPickList, ValidEmailTransformer,
+                        detect_mime_type, detect_name, is_valid_email, parse_phone,
+                        tag_entities)
+from .embeddings import OpLDA, OpLDAModel, OpWord2Vec, OpWord2VecModel
+from .scalers import (DescalerTransformer, IsotonicRegressionCalibrator,
+                      IsotonicRegressionCalibratorModel, OpScalarStandardScaler,
+                      OpScalarStandardScalerModel, PercentileCalibrator,
+                      PercentileCalibratorModel, ScalerTransformer, ScalingType)
+from .transformers import (AddTransformer, AliasTransformer, DivideTransformer,
+                           DropIndicesByTransformer, ExistsTransformer,
+                           FillMissingWithMean, FillMissingWithMeanModel,
+                           FilterTransformer, LambdaTransformer,
+                           MultiplyTransformer, PredictionDeIndexer,
+                           ReplaceTransformer, ScalarMathTransformer,
+                           SubstringTransformer, SubtractTransformer,
+                           ToOccurTransformer)
 from .bucketizers import (DecisionTreeNumericBucketizer,
                           DecisionTreeNumericBucketizerModel, NumericBucketizer,
                           find_tree_splits)

@@ -1,0 +1,324 @@
+"""Detection / parsing transformers — phone, email, MIME, human names, NER.
+
+Reference parity (core/.../impl/feature/ + core/.../utils/text/):
+- ``PhoneNumberParser`` (PhoneNumberParser.scala, libphonenumber-backed):
+  validity check + E.164-ish normalization with per-region rules,
+- ``ValidEmailTransformer`` / ``EmailToPickListMap`` (RichEmailFeature DSL):
+  RFC-lite validation, domain extraction,
+- ``MimeTypeDetector`` (MimeTypeDetector.scala:49, Tika-backed): magic-byte
+  sniffing of Base64 payloads,
+- ``HumanNameDetector`` (HumanNameDetector.scala:56 + NameDetectUtils):
+  dictionary+shape heuristic name detection emitting ``NameStats``,
+- ``NameEntityRecognizer`` (NameEntityRecognizer.scala:56, OpenNLP-backed):
+  token-level entity tagging via capitalization/shape/gazetteer rules.
+
+The reference's heavy lifting lives in JVM dependencies (libphonenumber,
+Tika, OpenNLP binaries in models/); here each is a self-contained
+rule/dictionary implementation — same API shape, swap-in point for larger
+models.
+"""
+from __future__ import annotations
+
+import base64
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ... import types as T
+from ...stages.base import UnaryTransformer
+
+# ---------------------------------------------------------------------------
+# Phone numbers
+# ---------------------------------------------------------------------------
+# country code -> (dial prefix, national number lengths)
+_PHONE_REGIONS: Dict[str, Tuple[str, Set[int]]] = {
+    "US": ("1", {10}), "CA": ("1", {10}), "GB": ("44", {10}),
+    "FR": ("33", {9}), "DE": ("49", {10, 11}), "IN": ("91", {10}),
+    "AU": ("61", {9}), "JP": ("81", {9, 10}), "BR": ("55", {10, 11}),
+    "MX": ("52", {10}),
+}
+DEFAULT_REGION = "US"
+
+
+def parse_phone(raw: Optional[str], region: str = DEFAULT_REGION
+                ) -> Tuple[bool, Optional[str]]:
+    """(is_valid, normalized E.164) under simple region rules."""
+    if not raw:
+        return False, None
+    digits = re.sub(r"[^\d+]", "", raw)
+    prefix, lengths = _PHONE_REGIONS.get(region.upper(), _PHONE_REGIONS[DEFAULT_REGION])
+    if digits.startswith("+"):
+        body = digits[1:]
+        if body.startswith(prefix) and (len(body) - len(prefix)) in lengths:
+            return True, f"+{body}"
+        # any known region prefix
+        for p, ls in _PHONE_REGIONS.values():
+            if body.startswith(p) and (len(body) - len(p)) in ls:
+                return True, f"+{body}"
+        return False, None
+    # national format: regions outside NANP write a trunk '0' before the
+    # significant digits (e.g. GB 020..., FR 06...) — strip it first
+    if prefix != "1" and digits.startswith("0"):
+        digits = digits[1:]
+    if len(digits) in lengths:
+        return True, f"+{prefix}{digits}"
+    if digits.startswith(prefix) and (len(digits) - len(prefix)) in lengths:
+        return True, f"+{digits}"
+    return False, None
+
+
+class PhoneNumberParser(UnaryTransformer):
+    """Phone -> Binary validity (PhoneNumberParser.scala isValidPhoneNumber)."""
+
+    def __init__(self, region: str = DEFAULT_REGION, uid: Optional[str] = None):
+        super().__init__(operation_name="validPhone", input_type=T.Phone,
+                         output_type=T.Binary, uid=uid, region=region)
+
+    def transform_fn(self, value: T.FeatureType) -> T.FeatureType:
+        if value.is_empty:
+            return T.Binary(None)
+        ok, _ = parse_phone(value.value, self.get_param("region", DEFAULT_REGION))
+        return T.Binary(ok)
+
+
+class NormalizePhoneNumber(UnaryTransformer):
+    """Phone -> Phone normalized to +<country><national> or empty."""
+
+    def __init__(self, region: str = DEFAULT_REGION, uid: Optional[str] = None):
+        super().__init__(operation_name="normPhone", input_type=T.Phone,
+                         output_type=T.Phone, uid=uid, region=region)
+
+    def transform_fn(self, value: T.FeatureType) -> T.FeatureType:
+        if value.is_empty:
+            return T.Phone(None)
+        _, norm = parse_phone(value.value, self.get_param("region", DEFAULT_REGION))
+        return T.Phone(norm)
+
+
+# ---------------------------------------------------------------------------
+# Email
+# ---------------------------------------------------------------------------
+_EMAIL_RE = re.compile(
+    r"^[A-Za-z0-9.!#$%&'*+/=?^_`{|}~-]+@[A-Za-z0-9](?:[A-Za-z0-9-]{0,61}[A-Za-z0-9])?"
+    r"(?:\.[A-Za-z0-9](?:[A-Za-z0-9-]{0,61}[A-Za-z0-9])?)+$")
+
+
+def is_valid_email(raw: Optional[str]) -> bool:
+    return bool(raw) and bool(_EMAIL_RE.match(raw))
+
+
+class ValidEmailTransformer(UnaryTransformer):
+    """Email -> Binary validity (ValidEmailTransformer.scala)."""
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(operation_name="validEmail", input_type=T.Email,
+                         output_type=T.Binary, uid=uid)
+
+    def transform_fn(self, value: T.FeatureType) -> T.FeatureType:
+        if value.is_empty:
+            return T.Binary(None)
+        return T.Binary(is_valid_email(value.value))
+
+
+class EmailToPickList(UnaryTransformer):
+    """Email -> PickList of the domain (RichEmailFeature.toEmailDomain)."""
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(operation_name="emailDomain", input_type=T.Email,
+                         output_type=T.PickList, uid=uid)
+
+    def transform_fn(self, value: T.FeatureType) -> T.FeatureType:
+        v = value.value
+        if not v or not is_valid_email(v):
+            return T.PickList(None)
+        return T.PickList(v.rsplit("@", 1)[1].lower())
+
+
+class UrlToPickList(UnaryTransformer):
+    """URL -> PickList of the hostname (RichMapFeature UrlMapToPickListMap
+    analog for scalar URLs); invalid URLs -> empty."""
+
+    _URL_RE = re.compile(r"^(?:(?P<scheme>[a-z][a-z0-9+.-]*)://)?(?P<host>[^/:?#]+)",
+                         re.IGNORECASE)
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(operation_name="urlHost", input_type=T.URL,
+                         output_type=T.PickList, uid=uid)
+
+    def transform_fn(self, value: T.FeatureType) -> T.FeatureType:
+        v = value.value
+        if not v:
+            return T.PickList(None)
+        m = self._URL_RE.match(v.strip())
+        if not m or "." not in m.group("host"):
+            return T.PickList(None)
+        return T.PickList(m.group("host").lower())
+
+
+# ---------------------------------------------------------------------------
+# MIME sniffing (Tika analog — magic bytes)
+# ---------------------------------------------------------------------------
+_MAGIC: List[Tuple[bytes, str]] = [
+    (b"%PDF", "application/pdf"),
+    (b"\x89PNG\r\n\x1a\n", "image/png"),
+    (b"\xff\xd8\xff", "image/jpeg"),
+    (b"GIF87a", "image/gif"),
+    (b"GIF89a", "image/gif"),
+    (b"PK\x03\x04", "application/zip"),
+    (b"\x1f\x8b", "application/gzip"),
+    (b"BM", "image/bmp"),
+    (b"\x25\x21PS", "application/postscript"),
+    (b"{\\rtf", "application/rtf"),
+    (b"\xd0\xcf\x11\xe0", "application/x-ole-storage"),
+    (b"OggS", "audio/ogg"),
+    (b"ID3", "audio/mpeg"),
+    (b"RIFF", "audio/x-wav"),
+    (b"<?xml", "application/xml"),
+    (b"<html", "text/html"),
+    (b"<!DOCTYPE html", "text/html"),
+]
+
+
+def detect_mime_type(data: bytes) -> str:
+    for magic, mime in _MAGIC:
+        if data.startswith(magic):
+            return mime
+    try:
+        data.decode("utf-8")
+        return "text/plain"
+    except (UnicodeDecodeError, AttributeError):
+        return "application/octet-stream"
+
+
+class MimeTypeDetector(UnaryTransformer):
+    """Base64 -> Text MIME type via magic bytes (MimeTypeDetector.scala:49)."""
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(operation_name="mimeDetect", input_type=T.Base64,
+                         output_type=T.Text, uid=uid)
+
+    def transform_fn(self, value: T.FeatureType) -> T.FeatureType:
+        v = value.value
+        if not v:
+            return T.Text(None)
+        try:
+            data = base64.b64decode(v, validate=False)
+        except Exception:
+            return T.Text(None)
+        if not data:
+            return T.Text(None)
+        return T.Text(detect_mime_type(data))
+
+
+# ---------------------------------------------------------------------------
+# Human names (NameDetectUtils analog)
+# ---------------------------------------------------------------------------
+# high-frequency first names (census heads) — the reference ships large
+# dictionaries in models/; this is the seed gazetteer
+_FIRST_NAMES: Set[str] = {
+    "james", "mary", "john", "patricia", "robert", "jennifer", "michael",
+    "linda", "william", "elizabeth", "david", "barbara", "richard", "susan",
+    "joseph", "jessica", "thomas", "sarah", "charles", "karen", "christopher",
+    "nancy", "daniel", "lisa", "matthew", "margaret", "anthony", "betty",
+    "mark", "sandra", "donald", "ashley", "steven", "dorothy", "paul",
+    "kimberly", "andrew", "emily", "joshua", "donna", "kenneth", "michelle",
+    "kevin", "carol", "brian", "amanda", "george", "melissa", "edward",
+    "deborah", "ana", "maria", "jose", "juan", "luis", "carlos", "ahmed",
+    "mohammed", "fatima", "wei", "ming", "yuki", "hiroshi", "anna", "peter",
+    "hans", "pierre", "marie", "jean", "sophie", "ivan", "olga", "natasha",
+}
+_HONORIFICS = {"mr", "mrs", "ms", "miss", "dr", "prof", "rev", "sir", "madam",
+               "lady", "lord", "master", "mx"}
+_GENDER_HINT = {
+    "mary": "F", "patricia": "F", "jennifer": "F", "linda": "F",
+    "elizabeth": "F", "barbara": "F", "susan": "F", "jessica": "F",
+    "sarah": "F", "karen": "F", "maria": "F", "anna": "F", "marie": "F",
+    "fatima": "F", "olga": "F", "natasha": "F", "sophie": "F", "emily": "F",
+    "michelle": "F", "amanda": "F", "melissa": "F", "deborah": "F",
+    "james": "M", "john": "M", "robert": "M", "michael": "M", "william": "M",
+    "david": "M", "richard": "M", "joseph": "M", "thomas": "M", "charles": "M",
+    "jose": "M", "juan": "M", "luis": "M", "carlos": "M", "ahmed": "M",
+    "mohammed": "M", "pierre": "M", "jean": "M", "ivan": "M", "hans": "M",
+}
+
+
+def detect_name(text: Optional[str]) -> Dict[str, str]:
+    """NameStats-style dict: isName / firstName / gender hints
+    (HumanNameDetector + NameStats, types/Maps.scala:288)."""
+    if not text:
+        return {"isName": "false"}
+    tokens = [t for t in re.split(r"[\s,]+", text.strip()) if t]
+    words = [t.lower().strip(".") for t in tokens]
+    non_honorific = [w for w in words if w not in _HONORIFICS]
+    if not non_honorific or len(non_honorific) > 4:
+        return {"isName": "false"}
+    shape_ok = all(t[:1].isupper() for t in tokens if t.lower().strip(".") not in _HONORIFICS)
+    dict_hit = any(w in _FIRST_NAMES for w in non_honorific)
+    is_name = dict_hit or (shape_ok and len(non_honorific) in (2, 3)
+                           and all(w.isalpha() for w in non_honorific))
+    out = {"isName": "true" if is_name else "false"}
+    if is_name:
+        first = next((w for w in non_honorific if w in _FIRST_NAMES), non_honorific[0])
+        out["firstName"] = first
+        if first in _GENDER_HINT:
+            out["gender"] = _GENDER_HINT[first]
+    return out
+
+
+class HumanNameDetector(UnaryTransformer):
+    """Text -> NameStats map (HumanNameDetector.scala:56)."""
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(operation_name="nameDetect", input_type=T.Text,
+                         output_type=T.NameStats, uid=uid)
+
+    def transform_fn(self, value: T.FeatureType) -> T.FeatureType:
+        return T.NameStats(detect_name(value.value))
+
+
+# ---------------------------------------------------------------------------
+# Named-entity recognition (OpenNLP analog — shape + gazetteer rules)
+# ---------------------------------------------------------------------------
+_ORG_SUFFIXES = {"inc", "corp", "llc", "ltd", "gmbh", "co", "company",
+                 "corporation", "foundation", "institute", "university"}
+_LOCATION_WORDS = {"street", "avenue", "city", "county", "state", "river",
+                   "mountain", "lake", "north", "south", "east", "west",
+                   "paris", "london", "tokyo", "berlin", "madrid", "rome",
+                   "york", "francisco", "angeles", "chicago", "boston"}
+
+
+def tag_entities(tokens: Sequence[str]) -> List[Tuple[str, str]]:
+    """[(token, tag)] with tags PERSON / ORGANIZATION / LOCATION / O."""
+    out: List[Tuple[str, str]] = []
+    for i, tok in enumerate(tokens):
+        low = tok.lower().strip(".,")
+        tag = "O"
+        if low in _FIRST_NAMES:
+            tag = "PERSON"
+        elif low in _LOCATION_WORDS:
+            tag = "LOCATION"
+        elif low in _ORG_SUFFIXES and i > 0 and tokens[i - 1][:1].isupper():
+            tag = "ORGANIZATION"
+        elif tok[:1].isupper() and i > 0 and out and out[-1][1] == "PERSON":
+            tag = "PERSON"  # surname following a first name
+        out.append((tok, tag))
+    return out
+
+
+class NameEntityRecognizer(UnaryTransformer):
+    """Text -> MultiPickListMap of entities by tag
+    (NameEntityRecognizer.scala:56; output map tag -> set of tokens)."""
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(operation_name="ner", input_type=T.Text,
+                         output_type=T.MultiPickListMap, uid=uid)
+
+    def transform_fn(self, value: T.FeatureType) -> T.FeatureType:
+        if value.is_empty:
+            return T.MultiPickListMap({})
+        tokens = [t for t in re.split(r"\s+", value.value.strip()) if t]
+        tagged = tag_entities(tokens)
+        out: Dict[str, Set[str]] = {}
+        for tok, tag in tagged:
+            if tag != "O":
+                out.setdefault(tag, set()).add(tok.strip(".,"))
+        return T.MultiPickListMap(out)

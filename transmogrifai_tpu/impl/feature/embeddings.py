@@ -1,0 +1,233 @@
+"""Text embeddings — Word2Vec and LDA as jit'd JAX computations.
+
+Reference parity:
+- ``OpWord2Vec`` (core/.../impl/feature/OpWord2Vec.scala:41, wraps Spark
+  Word2Vec): TextList -> OPVector by averaging learned word vectors,
+- ``OpLDA`` (OpLDA.scala:41, wraps Spark LDA): OPVector of term counts ->
+  OPVector topic distribution.
+
+TPU-first redesign: both fits are dense-batch gradient/variational updates —
+skip-gram negative sampling trained as a jit'd full-batch update loop
+(`lax.scan` over epochs, MXU matmuls for the score matrix), and LDA as
+online variational Bayes (Hoffman et al. 2010) with fixed-iteration E-steps
+(digamma recurrences vectorized over the doc batch) — no per-token Gibbs
+loops, no dynamic shapes.
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import digamma
+
+from ... import types as T
+from ...columns import Column, Dataset, ObjectColumn, VectorColumn
+from ...features.metadata import VectorColumnMetadata
+from ...stages.base import Model, UnaryEstimator
+from ._util import finalize_vector
+
+
+# ---------------------------------------------------------------------------
+# Word2Vec (skip-gram, negative sampling)
+# ---------------------------------------------------------------------------
+def _sgns_epoch(params, pairs, negs, lr):
+    """One full-batch SGNS update; pairs [P,2] (center, context), negs [P,K]."""
+    W, C = params  # [V,d] input and output embeddings
+
+    def loss_fn(W, C):
+        wc = W[pairs[:, 0]]                        # [P,d]
+        pos = jnp.sum(wc * C[pairs[:, 1]], axis=1)  # [P]
+        neg = jnp.einsum("pd,pkd->pk", wc, C[negs])  # [P,K]
+        pos_loss = jax.nn.softplus(-pos)
+        neg_loss = jax.nn.softplus(neg).sum(axis=1)
+        return jnp.mean(pos_loss + neg_loss)
+
+    loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(W, C)
+    return (W - lr * grads[0], C - lr * grads[1]), loss
+
+
+class OpWord2Vec(UnaryEstimator):
+    """TextList -> OPVector document embedding (mean of word vectors)
+    (OpWord2Vec.scala:41)."""
+
+    def __init__(self, vector_size: int = 64, min_count: int = 2, window: int = 5,
+                 num_negatives: int = 5, epochs: int = 30, learning_rate: float = 0.2,
+                 max_pairs: int = 200_000, seed: int = 42, uid: Optional[str] = None):
+        super().__init__(operation_name="w2v", input_type=T.TextList,
+                         output_type=T.OPVector, uid=uid,
+                         vector_size=vector_size, min_count=min_count, window=window,
+                         num_negatives=num_negatives, epochs=epochs,
+                         learning_rate=learning_rate, max_pairs=max_pairs, seed=seed)
+
+    def fit_columns(self, cols: Sequence[Column], dataset: Dataset) -> "OpWord2VecModel":
+        col = cols[0]
+        assert isinstance(col, ObjectColumn)
+        docs = [list(col.values[i] or []) for i in range(len(col))]
+        counts = Counter(t for d in docs for t in d)
+        vocab = [t for t, c in sorted(counts.items(), key=lambda tc: (-tc[1], tc[0]))
+                 if c >= int(self.get_param("min_count"))]
+        d = int(self.get_param("vector_size"))
+        if not vocab:
+            return OpWord2VecModel(vocabulary=[], vectors=np.zeros((0, d), np.float32),
+                                   operation_name=self.operation_name,
+                                   output_type=self.output_type)
+        index = {t: i for i, t in enumerate(vocab)}
+        window = int(self.get_param("window"))
+        rng = np.random.default_rng(int(self.get_param("seed")))
+        pairs: List[Tuple[int, int]] = []
+        for doc in docs:
+            ids = [index[t] for t in doc if t in index]
+            for i, c in enumerate(ids):
+                for j in range(max(0, i - window), min(len(ids), i + window + 1)):
+                    if j != i:
+                        pairs.append((c, ids[j]))
+        if not pairs:
+            return OpWord2VecModel(vocabulary=vocab,
+                                   vectors=np.zeros((len(vocab), d), np.float32),
+                                   operation_name=self.operation_name,
+                                   output_type=self.output_type)
+        pairs_arr = np.asarray(pairs, dtype=np.int32)
+        max_pairs = int(self.get_param("max_pairs"))
+        if pairs_arr.shape[0] > max_pairs:
+            pairs_arr = pairs_arr[rng.choice(pairs_arr.shape[0], max_pairs, replace=False)]
+        V, K = len(vocab), int(self.get_param("num_negatives"))
+        # unigram^0.75 negative-sampling distribution (word2vec's choice)
+        freq = np.array([counts[t] for t in vocab], dtype=np.float64) ** 0.75
+        freq /= freq.sum()
+        negs = rng.choice(V, size=(pairs_arr.shape[0], K), p=freq).astype(np.int32)
+        W0 = (rng.standard_normal((V, d)) / np.sqrt(d)).astype(np.float32)
+        C0 = np.zeros((V, d), dtype=np.float32)
+        lr = float(self.get_param("learning_rate"))
+        epochs = int(self.get_param("epochs"))
+
+        @jax.jit
+        def train(W, C, pairs, negs):
+            def body(params, _):
+                return _sgns_epoch(params, pairs, negs, lr)
+            (W, C), losses = jax.lax.scan(body, (W, C), None, length=epochs)
+            return W, losses
+
+        W, losses = train(jnp.asarray(W0), jnp.asarray(C0), jnp.asarray(pairs_arr),
+                          jnp.asarray(negs))
+        self.metadata["final_loss"] = float(losses[-1])
+        return OpWord2VecModel(vocabulary=vocab,
+                               vectors=np.asarray(jax.device_get(W), dtype=np.float32),
+                               operation_name=self.operation_name,
+                               output_type=self.output_type)
+
+
+class OpWord2VecModel(Model):
+    def __init__(self, vocabulary: List[str], vectors: np.ndarray,
+                 operation_name: str = "w2v", output_type=T.OPVector,
+                 uid: Optional[str] = None, **kw):
+        super().__init__(operation_name, output_type, uid=uid, **kw)
+        self.vocabulary = list(vocabulary)
+        self.vectors = np.asarray(vectors, dtype=np.float32)
+
+    def transform_columns(self, cols: Sequence[Column]) -> VectorColumn:
+        col = cols[0]
+        assert isinstance(col, ObjectColumn)
+        index = {t: i for i, t in enumerate(self.vocabulary)}
+        n = len(col)
+        d = self.vectors.shape[1] if self.vectors.size else 0
+        out = np.zeros((n, d), dtype=np.float32)
+        for i in range(n):
+            ids = [index[t] for t in (col.values[i] or []) if t in index]
+            if ids:
+                out[i] = self.vectors[ids].mean(axis=0)
+        f = self.inputs[0]
+        meta = [VectorColumnMetadata((f.name,), (f.ftype.__name__,),
+                                     descriptor_value=f"w2v_{j}") for j in range(d)]
+        return finalize_vector(self, [out], meta, n)
+
+
+# ---------------------------------------------------------------------------
+# LDA (online variational Bayes)
+# ---------------------------------------------------------------------------
+def _lda_e_step(lam, X, alpha, n_iter: int = 30):
+    """Vectorized fixed-iteration E-step: doc-topic gamma [n,k] for count
+    matrix X [n,v] given topic-word lambda [k,v]."""
+    e_log_beta = digamma(lam) - digamma(lam.sum(axis=1, keepdims=True))  # [k,v]
+    exp_beta = jnp.exp(e_log_beta)                                      # [k,v]
+    n, v = X.shape
+    k = lam.shape[0]
+    gamma0 = jnp.ones((n, k))
+
+    def body(gamma, _):
+        e_log_theta = digamma(gamma) - digamma(gamma.sum(axis=1, keepdims=True))
+        exp_theta = jnp.exp(e_log_theta)                                # [n,k]
+        phi_norm = exp_theta @ exp_beta + 1e-100                        # [n,v]
+        gamma_new = alpha + exp_theta * ((X / phi_norm) @ exp_beta.T)
+        return gamma_new, None
+
+    gamma, _ = jax.lax.scan(body, gamma0, None, length=n_iter)
+    return gamma, exp_beta
+
+
+# module-level jit so scoring hits the compile cache across calls
+_lda_e_step_jit = jax.jit(_lda_e_step, static_argnums=3)
+
+
+class OpLDA(UnaryEstimator):
+    """OPVector term counts -> OPVector topic distribution (OpLDA.scala:41)."""
+
+    def __init__(self, k: int = 10, alpha: float = 0.1, eta: float = 0.01,
+                 max_iter: int = 20, seed: int = 42, uid: Optional[str] = None):
+        super().__init__(operation_name="lda", input_type=T.OPVector,
+                         output_type=T.OPVector, uid=uid, k=k, alpha=alpha, eta=eta,
+                         max_iter=max_iter, seed=seed)
+
+    def fit_columns(self, cols: Sequence[Column], dataset: Dataset) -> "OpLDAModel":
+        col = cols[0]
+        assert isinstance(col, VectorColumn)
+        X = jnp.asarray(np.maximum(col.values, 0.0), jnp.float32)
+        n, v = X.shape
+        k = int(self.get_param("k"))
+        alpha = float(self.get_param("alpha"))
+        eta = float(self.get_param("eta"))
+        rng = np.random.default_rng(int(self.get_param("seed")))
+        lam0 = jnp.asarray(rng.gamma(100.0, 0.01, size=(k, v)), jnp.float32)
+
+        @jax.jit
+        def em(lam):
+            def step(lam, _):
+                gamma, exp_beta = _lda_e_step(lam, X, alpha)
+                e_log_theta = digamma(gamma) - digamma(gamma.sum(axis=1, keepdims=True))
+                exp_theta = jnp.exp(e_log_theta)
+                phi_norm = exp_theta @ exp_beta + 1e-100
+                lam_new = eta + exp_beta * (exp_theta.T @ (X / phi_norm))
+                return lam_new, None
+            lam, _ = jax.lax.scan(step, lam, None,
+                                  length=int(self.get_param("max_iter")))
+            return lam
+
+        lam = em(lam0)
+        return OpLDAModel(topic_word=np.asarray(jax.device_get(lam), np.float32),
+                          alpha=alpha, operation_name=self.operation_name,
+                          output_type=self.output_type)
+
+
+class OpLDAModel(Model):
+    def __init__(self, topic_word: np.ndarray, alpha: float = 0.1,
+                 operation_name: str = "lda", output_type=T.OPVector,
+                 uid: Optional[str] = None, **kw):
+        super().__init__(operation_name, output_type, uid=uid, **kw)
+        self.topic_word = np.asarray(topic_word, dtype=np.float32)
+        self.alpha = float(alpha)
+
+    def transform_columns(self, cols: Sequence[Column]) -> VectorColumn:
+        col = cols[0]
+        assert isinstance(col, VectorColumn)
+        X = jnp.asarray(np.maximum(col.values, 0.0), jnp.float32)
+        gamma, _ = _lda_e_step_jit(jnp.asarray(self.topic_word), X, self.alpha, 30)
+        gamma = np.asarray(jax.device_get(gamma), dtype=np.float64)
+        theta = (gamma / gamma.sum(axis=1, keepdims=True)).astype(np.float32)
+        f = self.inputs[0]
+        meta = [VectorColumnMetadata((f.name,), (f.ftype.__name__,),
+                                     descriptor_value=f"topic_{j}")
+                for j in range(theta.shape[1])]
+        return finalize_vector(self, [theta], meta, theta.shape[0])

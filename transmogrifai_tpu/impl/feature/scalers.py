@@ -1,0 +1,211 @@
+"""Scalers and calibrators.
+
+Reference parity (core/.../impl/feature/):
+- ``OpScalarStandardScaler`` (OpScalarStandardScaler.scala:49): z-score a
+  single Real feature (the OPVector-wide version is
+  ``StandardScalerVectorizer`` in vectorizers.py),
+- ``ScalerTransformer`` / ``DescalerTransformer`` (ScalerTransformer.scala:56):
+  invertible scaling whose parameters ride in stage metadata so a
+  descaler downstream (e.g. on predictions) can undo the label scaling,
+- ``PercentileCalibrator`` (PercentileCalibrator.scala:48): map scores to
+  [0, buckets) by empirical quantile,
+- ``IsotonicRegressionCalibrator`` (IsotonicRegressionCalibrator.scala):
+  monotone score calibration via pool-adjacent-violators (PAV).
+"""
+from __future__ import annotations
+
+import enum
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ... import types as T
+from ...columns import Column, Dataset, NumericColumn
+from ...stages.base import (AllowLabelAsInput, BinaryEstimator, BinaryTransformer,
+                            Model, UnaryEstimator, UnaryTransformer)
+
+
+class OpScalarStandardScaler(UnaryEstimator):
+    """Real -> RealNN z-score (OpScalarStandardScaler.scala:49)."""
+
+    def __init__(self, with_mean: bool = True, with_std: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="stdScaled", input_type=T.Real,
+                         output_type=T.RealNN, uid=uid,
+                         with_mean=with_mean, with_std=with_std)
+
+    def fit_columns(self, cols: Sequence[Column], dataset: Dataset) -> "OpScalarStandardScalerModel":
+        col = cols[0]
+        assert isinstance(col, NumericColumn)
+        vals = col.values[col.mask]
+        mean = float(vals.mean()) if vals.size else 0.0
+        std = float(vals.std()) if vals.size else 1.0
+        return OpScalarStandardScalerModel(
+            mean=mean if self.get_param("with_mean") else 0.0,
+            std=std if (self.get_param("with_std") and std > 1e-12) else 1.0,
+            operation_name=self.operation_name, output_type=self.output_type)
+
+
+class OpScalarStandardScalerModel(Model):
+    def __init__(self, mean: float, std: float, operation_name: str = "stdScaled",
+                 output_type=T.RealNN, uid: Optional[str] = None, **kw):
+        super().__init__(operation_name, output_type, uid=uid, **kw)
+        self.mean = float(mean)
+        self.std = float(std)
+
+    def transform_columns(self, cols: Sequence[Column]) -> NumericColumn:
+        col = cols[0]
+        assert isinstance(col, NumericColumn)
+        vals = (np.where(col.mask, col.values, self.mean) - self.mean) / self.std
+        return NumericColumn(T.RealNN, vals, np.ones_like(col.mask))
+
+
+class ScalingType(str, enum.Enum):
+    Linear = "linear"
+    Logarithmic = "log"
+
+
+class ScalerTransformer(UnaryTransformer):
+    """Invertible scaling; records (type, args) in metadata for the paired
+    DescalerTransformer (ScalerTransformer.scala:56)."""
+
+    def __init__(self, scaling_type: ScalingType = ScalingType.Linear,
+                 slope: float = 1.0, intercept: float = 0.0,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="scaled", input_type=T.Real,
+                         output_type=T.Real, uid=uid,
+                         scaling_type=str(getattr(scaling_type, "value", scaling_type)),
+                         slope=float(slope), intercept=float(intercept))
+        self.metadata["scaler"] = {"type": self.get_param("scaling_type"),
+                                   "slope": float(slope), "intercept": float(intercept)}
+
+    def transform_columns(self, cols: Sequence[Column]) -> NumericColumn:
+        col = cols[0]
+        assert isinstance(col, NumericColumn)
+        st = ScalingType(self.get_param("scaling_type"))
+        if st is ScalingType.Linear:
+            vals = self.get_param("slope") * col.values + self.get_param("intercept")
+            mask = col.mask
+        else:
+            with np.errstate(divide="ignore", invalid="ignore"):
+                vals = np.log(col.values)
+            mask = col.mask & np.isfinite(vals)
+        return NumericColumn(T.Real, np.where(mask, vals, 0.0), mask)
+
+
+class DescalerTransformer(BinaryTransformer):
+    """(scaled feature, scaler-origin feature) -> unscaled value: reads the
+    scaler args from the second input's origin-stage metadata
+    (DescalerTransformer.scala:56)."""
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(operation_name="descaled", output_type=T.Real, uid=uid)
+
+    def _scaler_args(self):
+        origin = self.inputs[1].origin_stage
+        info = (origin.metadata or {}).get("scaler")
+        if info is None:
+            raise ValueError("Descaler input 2 must descend from a ScalerTransformer")
+        return info
+
+    def transform_columns(self, cols: Sequence[Column]) -> NumericColumn:
+        col = cols[0]
+        assert isinstance(col, NumericColumn)
+        info = self._scaler_args()
+        if info["type"] == ScalingType.Linear.value:
+            vals = (col.values - info["intercept"]) / info["slope"]
+            mask = col.mask
+        else:
+            vals = np.exp(col.values)
+            mask = col.mask
+        return NumericColumn(T.Real, np.where(mask, vals, 0.0), mask)
+
+
+class PercentileCalibrator(UnaryEstimator):
+    """RealNN score -> RealNN percentile bucket [0, buckets)
+    (PercentileCalibrator.scala:48, default 100 buckets)."""
+
+    def __init__(self, buckets: int = 100, uid: Optional[str] = None):
+        super().__init__(operation_name="percCalibrate", input_type=T.RealNN,
+                         output_type=T.RealNN, uid=uid, buckets=int(buckets))
+
+    def fit_columns(self, cols: Sequence[Column], dataset: Dataset) -> "PercentileCalibratorModel":
+        col = cols[0]
+        assert isinstance(col, NumericColumn)
+        b = int(self.get_param("buckets"))
+        qs = np.quantile(col.values[col.mask], np.linspace(0, 1, b + 1)) \
+            if col.mask.any() else np.zeros(b + 1)
+        return PercentileCalibratorModel(splits=np.asarray(qs, dtype=np.float64),
+                                         operation_name=self.operation_name,
+                                         output_type=self.output_type)
+
+
+class PercentileCalibratorModel(Model):
+    def __init__(self, splits: np.ndarray, operation_name: str = "percCalibrate",
+                 output_type=T.RealNN, uid: Optional[str] = None, **kw):
+        super().__init__(operation_name, output_type, uid=uid, **kw)
+        self.splits = np.asarray(splits, dtype=np.float64)
+
+    def transform_columns(self, cols: Sequence[Column]) -> NumericColumn:
+        col = cols[0]
+        assert isinstance(col, NumericColumn)
+        b = len(self.splits) - 1
+        idx = np.clip(np.searchsorted(self.splits[1:-1], col.values, side="right"),
+                      0, b - 1).astype(np.float64)
+        return NumericColumn(T.RealNN, idx, np.ones_like(col.mask))
+
+
+def pav_fit(x: np.ndarray, y: np.ndarray) -> tuple:
+    """Pool-adjacent-violators: returns (thresholds, values) of the step fn."""
+    order = np.argsort(x, kind="stable")
+    xs, ys = x[order], y[order].astype(np.float64)
+    w = np.ones_like(ys)
+    vals: List[float] = []
+    weights: List[float] = []
+    xs_blocks: List[float] = []
+    for xi, yi, wi in zip(xs, ys, w):
+        vals.append(float(yi))
+        weights.append(float(wi))
+        xs_blocks.append(float(xi))
+        while len(vals) > 1 and vals[-2] > vals[-1]:
+            v = (vals[-2] * weights[-2] + vals[-1] * weights[-1]) / (weights[-2] + weights[-1])
+            wsum = weights[-2] + weights[-1]
+            vals.pop(); weights.pop(); xs_blocks.pop()
+            vals[-1], weights[-1] = v, wsum
+    return np.asarray(xs_blocks), np.asarray(vals)
+
+
+class IsotonicRegressionCalibrator(AllowLabelAsInput, BinaryEstimator):
+    """(label RealNN, score RealNN) -> calibrated RealNN via isotonic
+    regression (IsotonicRegressionCalibrator.scala)."""
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(operation_name="isoCalibrate", output_type=T.RealNN, uid=uid)
+
+    def fit_columns(self, cols: Sequence[Column], dataset: Dataset) -> "IsotonicRegressionCalibratorModel":
+        label, score = cols
+        assert isinstance(label, NumericColumn) and isinstance(score, NumericColumn)
+        m = label.mask & score.mask
+        thr, vals = pav_fit(score.values[m], label.values[m])
+        return IsotonicRegressionCalibratorModel(
+            thresholds=thr, values=vals, operation_name=self.operation_name,
+            output_type=self.output_type)
+
+
+class IsotonicRegressionCalibratorModel(Model):
+    def __init__(self, thresholds: np.ndarray, values: np.ndarray,
+                 operation_name: str = "isoCalibrate", output_type=T.RealNN,
+                 uid: Optional[str] = None, **kw):
+        super().__init__(operation_name, output_type, uid=uid, **kw)
+        self.thresholds = np.asarray(thresholds, dtype=np.float64)
+        self.values = np.asarray(values, dtype=np.float64)
+
+    def transform_columns(self, cols: Sequence[Column]) -> NumericColumn:
+        _, score = cols
+        assert isinstance(score, NumericColumn)
+        if self.thresholds.size == 0:
+            return NumericColumn(T.RealNN, np.zeros(len(score)),
+                                 np.ones(len(score), bool))
+        # linear interpolation between block means (Spark IsotonicRegression)
+        vals = np.interp(score.values, self.thresholds, self.values)
+        return NumericColumn(T.RealNN, vals, np.ones(len(score), bool))

@@ -1,0 +1,313 @@
+"""General-purpose transformers — feature arithmetic and value munging.
+
+Reference parity (core/.../impl/feature/):
+- ``MathTransformers`` (393 LoC: +, -, *, / on features — the
+  ``sibSp + parCh + 1`` DSL; null propagates unless both sides present),
+- ``AliasTransformer`` (AliasTransformer.scala:51) — rename without copy,
+- ``FilterTransformer`` / ``ReplaceTransformer`` / ``SubstringTransformer`` /
+  ``ExistsTransformer`` / ``ToOccurTransformer`` (ToOccurTransformer maps
+  non-empty/truthy -> 1.0),
+- ``FillMissingWithMean`` (FillMissingWithMean.scala),
+- ``DropIndicesByTransformer`` (DropIndicesByTransformer.scala) — strip
+  vector slots by metadata predicate,
+- ``PredictionDeIndexer`` (impl/preparators/PredictionDeIndexer.scala) —
+  prediction index -> original string label.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Type
+
+import numpy as np
+
+from ... import types as T
+from ...columns import (Column, Dataset, NumericColumn, ObjectColumn,
+                        PredictionColumn, VectorColumn)
+from ...features.generator import FnExtractor
+from ...stages.base import (BinaryTransformer, Model, UnaryEstimator,
+                            UnaryTransformer)
+from ._util import finalize_vector
+
+
+# ---------------------------------------------------------------------------
+# Math transformers (vectorized on (values, mask) columns)
+# ---------------------------------------------------------------------------
+class _NumericBinaryOp(BinaryTransformer):
+    """Elementwise arithmetic on two numeric features; missing operands
+    follow the reference's semantics: the present side wins for +/- (missing
+    treated as absent, not zero-poisoning), both required for * and /."""
+
+    op: str = "?"
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(operation_name=self.op, output_type=T.Real, uid=uid)
+
+    def _apply(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def transform_columns(self, cols: Sequence[Column]) -> NumericColumn:
+        a, b = cols
+        assert isinstance(a, NumericColumn) and isinstance(b, NumericColumn)
+        both = a.mask & b.mask
+        with np.errstate(divide="ignore", invalid="ignore"):
+            vals = self._apply(a.values, b.values)
+        if self.op in ("plus", "minus"):
+            only_a = a.mask & ~b.mask
+            only_b = b.mask & ~a.mask
+            vals = np.where(only_a, a.values, vals)
+            vals = np.where(only_b, b.values if self.op == "plus" else -b.values, vals)
+            mask = a.mask | b.mask
+        else:
+            mask = both & np.isfinite(vals)
+        vals = np.where(mask, vals, 0.0)
+        return NumericColumn(T.Real, vals, mask)
+
+
+class AddTransformer(_NumericBinaryOp):
+    op = "plus"
+
+    def _apply(self, a, b):
+        return a + b
+
+
+class SubtractTransformer(_NumericBinaryOp):
+    op = "minus"
+
+    def _apply(self, a, b):
+        return a - b
+
+
+class MultiplyTransformer(_NumericBinaryOp):
+    op = "multiply"
+
+    def _apply(self, a, b):
+        return a * b
+
+
+class DivideTransformer(_NumericBinaryOp):
+    op = "divide"
+
+    def _apply(self, a, b):
+        return a / b
+
+
+class ScalarMathTransformer(UnaryTransformer):
+    """feature <op> scalar (MathTransformers' scalar variants)."""
+
+    def __init__(self, op: str, scalar: float, uid: Optional[str] = None):
+        assert op in ("plus", "minus", "multiply", "divide", "power", "abs",
+                      "log", "exp", "sqrt", "rminus", "rdivide")
+        super().__init__(operation_name=f"{op}Scalar", input_type=T.Real,
+                         output_type=T.Real, uid=uid, op=op, scalar=float(scalar))
+
+    def transform_columns(self, cols: Sequence[Column]) -> NumericColumn:
+        col = cols[0]
+        assert isinstance(col, NumericColumn)
+        op, s = self.get_param("op"), float(self.get_param("scalar"))
+        v = col.values
+        with np.errstate(divide="ignore", invalid="ignore"):
+            vals = {
+                "plus": lambda: v + s, "minus": lambda: v - s,
+                "multiply": lambda: v * s, "divide": lambda: v / s,
+                "power": lambda: v ** s, "abs": lambda: np.abs(v),
+                "log": lambda: np.log(v), "exp": lambda: np.exp(v),
+                "sqrt": lambda: np.sqrt(v),
+                "rminus": lambda: s - v, "rdivide": lambda: s / v,
+            }[op]()
+        mask = col.mask & np.isfinite(vals)
+        return NumericColumn(T.Real, np.where(mask, vals, 0.0), mask)
+
+
+# ---------------------------------------------------------------------------
+# Value munging
+# ---------------------------------------------------------------------------
+class AliasTransformer(UnaryTransformer):
+    """Rename a feature (AliasTransformer.scala:51): identity on values."""
+
+    def __init__(self, name: str, uid: Optional[str] = None):
+        super().__init__(operation_name="alias", input_type=T.FeatureType,
+                         output_type=T.FeatureType, uid=uid, alias=name)
+
+    def output_types(self) -> List[Type[T.FeatureType]]:
+        return [self.inputs[0].ftype if self.inputs else self.output_type]
+
+    def output_name(self, index: int = 0) -> str:
+        return str(self.get_param("alias"))
+
+    def transform_columns(self, cols: Sequence[Column]) -> Column:
+        return cols[0]
+
+
+class LambdaTransformer(UnaryTransformer):
+    """User map function over scalars (RichFeature.map analog).  The callable
+    is held as an FnExtractor so save/load round-trips via source capture
+    (the stage writer's __extractor__ path)."""
+
+    def __init__(self, fn: Callable[[T.FeatureType], T.FeatureType],
+                 input_type: Type[T.FeatureType], output_type: Type[T.FeatureType],
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="mapFn", input_type=input_type,
+                         output_type=output_type, uid=uid)
+        self.fn = FnExtractor(fn, output_type)
+
+    def transform_fn(self, value: T.FeatureType) -> T.FeatureType:
+        out = self.fn.fn(value)
+        return out if isinstance(out, T.FeatureType) else self.output_type(out)
+
+
+class FilterTransformer(UnaryTransformer):
+    """Keep values matching a predicate, else empty (FilterTransformer)."""
+
+    def __init__(self, predicate: Callable[[Any], bool],
+                 input_type: Type[T.FeatureType] = T.Text, uid: Optional[str] = None):
+        super().__init__(operation_name="filter", input_type=input_type,
+                         output_type=input_type, uid=uid)
+        self.predicate = FnExtractor(predicate, T.Binary)
+
+    def output_types(self) -> List[Type[T.FeatureType]]:
+        return [self.inputs[0].ftype if self.inputs else self.output_type]
+
+    def transform_fn(self, value: T.FeatureType) -> T.FeatureType:
+        ftype = self.inputs[0].ftype
+        if value.is_empty or self.predicate.fn(value.value):
+            return value if isinstance(value, ftype) else ftype(value.value)
+        return T.default_of(ftype)
+
+
+class ReplaceTransformer(UnaryTransformer):
+    """Replace matching values (ReplaceTransformer / RichFeature.replaceWith)."""
+
+    def __init__(self, match_value: Any, replace_with: Any,
+                 input_type: Type[T.FeatureType] = T.Text, uid: Optional[str] = None):
+        super().__init__(operation_name="replace", input_type=input_type,
+                         output_type=input_type, uid=uid,
+                         match_value=match_value, replace_with=replace_with)
+
+    def output_types(self) -> List[Type[T.FeatureType]]:
+        return [self.inputs[0].ftype if self.inputs else self.output_type]
+
+    def transform_fn(self, value: T.FeatureType) -> T.FeatureType:
+        ftype = self.inputs[0].ftype
+        if not value.is_empty and value.value == self.get_param("match_value"):
+            return ftype(self.get_param("replace_with"))
+        return value if isinstance(value, ftype) else ftype(value.value)
+
+
+class SubstringTransformer(BinaryTransformer):
+    """(Text, Text) -> Binary: is the second a substring of the first
+    (SubstringTransformer)."""
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(operation_name="substring", output_type=T.Binary, uid=uid)
+
+    def transform_fn(self, a: T.FeatureType, b: T.FeatureType) -> T.FeatureType:
+        if a.is_empty or b.is_empty:
+            return T.Binary(None)
+        return T.Binary(str(b.value).lower() in str(a.value).lower())
+
+
+class ExistsTransformer(UnaryTransformer):
+    """Any -> Binary presence flag (ExistsTransformer)."""
+
+    def __init__(self, input_type: Type[T.FeatureType] = T.FeatureType,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="exists", input_type=input_type,
+                         output_type=T.Binary, uid=uid)
+
+    def transform_fn(self, value: T.FeatureType) -> T.FeatureType:
+        return T.Binary(not value.is_empty)
+
+
+class ToOccurTransformer(UnaryTransformer):
+    """Any -> RealNN 1.0/0.0 occurrence (ToOccurTransformer.scala: default
+    ``matchFn`` is non-empty-and-truthy)."""
+
+    def __init__(self, input_type: Type[T.FeatureType] = T.FeatureType,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="toOccur", input_type=input_type,
+                         output_type=T.RealNN, uid=uid)
+
+    def transform_fn(self, value: T.FeatureType) -> T.FeatureType:
+        if value.is_empty:
+            return T.RealNN(0.0)
+        v = value.value
+        if isinstance(v, (bool, int, float)):
+            return T.RealNN(1.0 if v else 0.0)
+        return T.RealNN(1.0)
+
+
+class FillMissingWithMean(UnaryEstimator):
+    """Real -> RealNN with train-mean fill (FillMissingWithMean.scala)."""
+
+    def __init__(self, default: float = 0.0, uid: Optional[str] = None):
+        super().__init__(operation_name="fillWithMean", input_type=T.Real,
+                         output_type=T.RealNN, uid=uid, default=default)
+
+    def fit_columns(self, cols: Sequence[Column], dataset: Dataset) -> "FillMissingWithMeanModel":
+        col = cols[0]
+        assert isinstance(col, NumericColumn)
+        mean = float(col.values[col.mask].mean()) if col.mask.any() \
+            else float(self.get_param("default"))
+        return FillMissingWithMeanModel(mean=mean, operation_name=self.operation_name,
+                                        output_type=self.output_type)
+
+
+class FillMissingWithMeanModel(Model):
+    def __init__(self, mean: float, operation_name: str = "fillWithMean",
+                 output_type=T.RealNN, uid: Optional[str] = None, **kw):
+        super().__init__(operation_name, output_type, uid=uid, **kw)
+        self.mean = float(mean)
+
+    def transform_columns(self, cols: Sequence[Column]) -> NumericColumn:
+        col = cols[0]
+        assert isinstance(col, NumericColumn)
+        vals = np.where(col.mask, col.values, self.mean)
+        return NumericColumn(T.RealNN, vals, np.ones_like(col.mask))
+
+
+class DropIndicesByTransformer(UnaryTransformer):
+    """OPVector -> OPVector dropping columns whose metadata matches a
+    predicate (DropIndicesByTransformer.scala)."""
+
+    def __init__(self, predicate: Callable[[Any], bool], uid: Optional[str] = None):
+        super().__init__(operation_name="dropIndicesBy", input_type=T.OPVector,
+                         output_type=T.OPVector, uid=uid)
+        self.predicate = FnExtractor(predicate, T.Binary)
+
+    def transform_columns(self, cols: Sequence[Column]) -> VectorColumn:
+        col = cols[0]
+        assert isinstance(col, VectorColumn)
+        if col.metadata is None:
+            return col
+        keep = [i for i, c in enumerate(col.metadata.columns)
+                if not self.predicate.fn(c)]
+        vm = col.metadata.select(keep)
+        out = col.values[:, keep]
+        vm = type(vm)(self.get_outputs()[0].name, vm.columns)
+        self.metadata["vector_metadata"] = vm
+        return VectorColumn(T.OPVector, out, vm)
+
+
+class PredictionDeIndexer(UnaryTransformer):
+    """Prediction -> Text original label via the indexer's labels
+    (impl/preparators/PredictionDeIndexer.scala)."""
+
+    def __init__(self, labels: Sequence[str], uid: Optional[str] = None):
+        super().__init__(operation_name="deindexPred", input_type=T.Prediction,
+                         output_type=T.Text, uid=uid, labels=list(labels))
+
+    def transform_columns(self, cols: Sequence[Column]) -> ObjectColumn:
+        col = cols[0]
+        assert isinstance(col, PredictionColumn)
+        labels = self.get_param("labels")
+        n = len(col)
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            j = int(col.prediction[i])
+            out[i] = labels[j] if 0 <= j < len(labels) else None
+        return ObjectColumn(T.Text, out)
+
+    def transform_row(self, row):
+        v = row[self.inputs[0].name]
+        labels = self.get_param("labels")
+        j = int(v.prediction)
+        return T.Text(labels[j] if 0 <= j < len(labels) else None)

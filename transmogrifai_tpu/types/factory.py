@@ -28,6 +28,9 @@ FEATURE_TYPES: Dict[str, Type[FeatureType]] = {t.__name__: t for t in _all_concr
 
 
 def feature_type_by_name(name: str) -> Type[FeatureType]:
+    if name == "FeatureType":
+        # type-polymorphic stages (alias/filter/replace) declare the base
+        return FeatureType
     try:
         return FEATURE_TYPES[name]
     except KeyError:

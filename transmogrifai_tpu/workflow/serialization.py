@@ -213,7 +213,7 @@ def _compile_extract_source(src: str):
             fn = eval(compile(expr[:end], "<extract>", "eval"), {"T": T, "np": np})  # noqa: S307
             if callable(fn):
                 return fn
-        except SyntaxError:
+        except Exception:  # truncated prefixes can fail in arbitrary ways
             continue
     raise ValueError(f"Cannot recover extract function from source: {src!r}")
 
