@@ -1,0 +1,65 @@
+"""OpBostonSimple — regression on Boston-housing-style data.
+
+Reference parity: helloworld/src/main/scala/com/salesforce/hw/OpBostonSimple.scala
+(RegressionModelSelector over numeric + categorical features).
+
+Run:
+    python helloworld/boston.py --run-type train --model-location /tmp/boston_model
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import pandas as pd
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu import (FeatureBuilder, OpAppWithRunner, OpWorkflow,
+                               OpWorkflowRunner)
+from transmogrifai_tpu.evaluators import OpRegressionEvaluator
+from transmogrifai_tpu.impl.selector.factories import RegressionModelSelector
+from transmogrifai_tpu.readers import DataReaders
+
+
+def boston_data(n: int = 506):
+    """Synthetic housing data with the reference dataset's feature names."""
+    rng = np.random.default_rng(13)
+    crim = rng.exponential(3.0, n)
+    rm = rng.normal(6.3, 0.7, n)          # rooms
+    age = rng.uniform(2, 100, n)
+    dis = rng.exponential(3.8, n)
+    tax = rng.uniform(187, 711, n)
+    lstat = rng.uniform(1.7, 38, n)
+    chas = rng.choice([0, 1], n, p=[0.93, 0.07])
+    medv = (9.1 * rm - 0.65 * lstat - 0.21 * crim - 0.02 * age
+            + 2.7 * chas + rng.normal(0, 2.5, n) - 22.0)
+    return pd.DataFrame({"id": np.arange(n), "crim": crim, "rm": rm, "age": age,
+                         "dis": dis, "tax": tax, "lstat": lstat, "chas": chas,
+                         "medv": medv})
+
+
+def build_workflow():
+    medv = FeatureBuilder("medv", T.RealNN).extract(field="medv").as_response()
+    nums = [FeatureBuilder(n, T.Real).extract(field=n).as_predictor()
+            for n in ("crim", "rm", "age", "dis", "tax", "lstat")]
+    chas = FeatureBuilder("chas", T.PickList).extract(field="chas").as_predictor()
+    features = nums[0].vectorize(*nums[1:]).combine(chas.pivot(min_support=1))
+    pred = RegressionModelSelector.with_cross_validation(
+        num_folds=3, seed=42).set_input(medv, features).get_output()
+    return OpWorkflow().set_result_features(pred), pred
+
+
+class OpBostonSimple(OpAppWithRunner):
+    app_name = "OpBostonSimple"
+
+    def build_runner(self):
+        wf, pred = build_workflow()
+        reader = DataReaders.Simple.custom(boston_data(), key="id")
+        return OpWorkflowRunner(
+            wf, train_reader=reader, scoring_reader=reader,
+            evaluator=OpRegressionEvaluator(label_col="medv"))
+
+
+if __name__ == "__main__":
+    OpBostonSimple().main()

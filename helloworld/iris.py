@@ -1,0 +1,68 @@
+"""OpIrisSimple — multiclass classification on the Iris dataset.
+
+Reference parity: helloworld/src/main/scala/com/salesforce/hw/OpIrisSimple.scala
+(MultiClassificationModelSelector over the 4 numeric features + indexed label).
+
+Run:
+    python helloworld/iris.py --run-type train --model-location /tmp/iris_model
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import pandas as pd
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu import (FeatureBuilder, OpAppWithRunner, OpWorkflow,
+                               OpWorkflowRunner)
+from transmogrifai_tpu.evaluators import OpMultiClassificationEvaluator
+from transmogrifai_tpu.impl.selector.factories import MultiClassificationModelSelector
+from transmogrifai_tpu.readers import DataReaders
+
+
+def iris_data():
+    """Deterministic synthetic iris: 3 Gaussian species clusters in 4-D."""
+    rng = np.random.default_rng(7)
+    centers = {"setosa": [5.0, 3.4, 1.5, 0.2],
+               "versicolor": [5.9, 2.8, 4.3, 1.3],
+               "virginica": [6.6, 3.0, 5.6, 2.0]}
+    rows = []
+    for label, c in centers.items():
+        pts = rng.normal(c, [0.35, 0.3, 0.3, 0.15], size=(50, 4))
+        for p in pts:
+            rows.append({"sepal_length": p[0], "sepal_width": p[1],
+                         "petal_length": p[2], "petal_width": p[3],
+                         "species": label})
+    df = pd.DataFrame(rows)
+    df["id"] = np.arange(len(df))
+    # label index (the reference indexes the species string)
+    df["label"] = df["species"].map(
+        {"setosa": 0.0, "versicolor": 1.0, "virginica": 2.0})
+    return df
+
+
+def build_workflow():
+    label = FeatureBuilder("label", T.RealNN).extract(field="label").as_response()
+    feats = [FeatureBuilder(n, T.Real).extract(field=n).as_predictor()
+             for n in ("sepal_length", "sepal_width", "petal_length", "petal_width")]
+    features = feats[0].vectorize(*feats[1:])
+    pred = MultiClassificationModelSelector.with_cross_validation(
+        num_folds=3, seed=42).set_input(label, features).get_output()
+    return OpWorkflow().set_result_features(pred), pred
+
+
+class OpIrisSimple(OpAppWithRunner):
+    app_name = "OpIrisSimple"
+
+    def build_runner(self):
+        wf, pred = build_workflow()
+        reader = DataReaders.Simple.custom(iris_data(), key="id")
+        return OpWorkflowRunner(
+            wf, train_reader=reader, scoring_reader=reader,
+            evaluator=OpMultiClassificationEvaluator(label_col="label"))
+
+
+if __name__ == "__main__":
+    OpIrisSimple().main()

@@ -1,0 +1,87 @@
+"""OpTitanicSimple — binary classification on Titanic survival.
+
+Reference parity: helloworld/src/main/scala/com/salesforce/hw/
+OpTitanicSimple.scala:77-130 — the canonical example: typed features, the
+``sibSp + parCh + 1`` DSL, transmogrify, sanity check, a
+BinaryClassificationModelSelector CV sweep, and a train/score/evaluate app.
+
+Run:
+    python helloworld/titanic.py --run-type train --model-location /tmp/titanic_model
+    python helloworld/titanic.py --run-type score --model-location /tmp/titanic_model \
+        --write-location /tmp/titanic_scores
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import pandas as pd
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu import (FeatureBuilder, OpAppWithRunner, OpWorkflow,
+                               OpWorkflowRunner)
+from transmogrifai_tpu.evaluators import OpBinaryClassificationEvaluator
+from transmogrifai_tpu.impl.selector.factories import BinaryClassificationModelSelector
+from transmogrifai_tpu.readers import DataReaders
+
+TITANIC_CSV = "/root/reference/test-data/PassengerDataAllWithHeader.csv"
+
+
+def titanic_data():
+    if os.path.exists(TITANIC_CSV):
+        return pd.read_csv(TITANIC_CSV)
+    # synthetic fallback with the same schema
+    rng = np.random.default_rng(0)
+    n = 891
+    sex = rng.choice(["male", "female"], n)
+    pclass = rng.choice([1, 2, 3], n)
+    age = rng.uniform(1, 80, n)
+    y = ((sex == "female") | (rng.random(n) < 0.2)).astype(int)
+    return pd.DataFrame({
+        "PassengerId": np.arange(1, n + 1), "Survived": y, "Pclass": pclass,
+        "Name": ["p"] * n, "Sex": sex, "Age": age,
+        "SibSp": rng.integers(0, 4, n), "Parch": rng.integers(0, 3, n),
+        "Ticket": ["t"] * n, "Fare": rng.uniform(5, 100, n),
+        "Cabin": [None] * n, "Embarked": rng.choice(["S", "C", "Q"], n)})
+
+
+def build_workflow():
+    survived = FeatureBuilder("Survived", T.RealNN).extract(field="Survived").as_response()
+    pclass = FeatureBuilder("Pclass", T.PickList).extract(field="Pclass").as_predictor()
+    name = FeatureBuilder("Name", T.Text).extract(field="Name").as_predictor()
+    sex = FeatureBuilder("Sex", T.PickList).extract(field="Sex").as_predictor()
+    age = FeatureBuilder("Age", T.Real).extract(field="Age").as_predictor()
+    sib_sp = FeatureBuilder("SibSp", T.Integral).extract(field="SibSp").as_predictor()
+    par_ch = FeatureBuilder("Parch", T.Integral).extract(field="Parch").as_predictor()
+    fare = FeatureBuilder("Fare", T.Real).extract(field="Fare").as_predictor()
+    embarked = FeatureBuilder("Embarked", T.PickList).extract(field="Embarked").as_predictor()
+
+    # the reference's derived feature (OpTitanicSimple.scala:93)
+    family_size = (sib_sp + par_ch + 1).alias("family_size")
+    features = family_size.vectorize(
+        age, fare, label=survived).combine(
+        sex.pivot(pclass, embarked, top_k=10, min_support=1),
+        name.smart_vectorize(max_cardinality=10, num_hashes=64, min_support=1))
+    checked = features.sanity_check(survived)
+
+    pred = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3, seed=42).set_input(survived, checked).get_output()
+    return OpWorkflow().set_result_features(pred), pred
+
+
+class OpTitanicSimple(OpAppWithRunner):
+    app_name = "OpTitanicSimple"
+
+    def build_runner(self):
+        wf, pred = build_workflow()
+        reader = DataReaders.Simple.custom(titanic_data(), key="PassengerId")
+        # prediction_col is left unset: a loaded model resolves its own
+        # result-feature name (generated uids differ across processes)
+        return OpWorkflowRunner(
+            wf, train_reader=reader, scoring_reader=reader,
+            evaluator=OpBinaryClassificationEvaluator(label_col="Survived"))
+
+
+if __name__ == "__main__":
+    OpTitanicSimple().main()

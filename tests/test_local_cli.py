@@ -1,0 +1,118 @@
+"""local/ per-record scoring, cli/ project generator, helloworld smoke
+(SURVEY §2.5 local/, cli/, helloworld/)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+from transmogrifai_tpu.impl.classification.logistic import OpLogisticRegression
+from transmogrifai_tpu.impl.feature.vectorizers import (OneHotVectorizer,
+                                                        RealVectorizer,
+                                                        VectorsCombiner)
+from transmogrifai_tpu.local import load_model_local, score_function
+from transmogrifai_tpu.testkit import TestFeatureBuilder
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _trained_model():
+    ds, (x, cat, y) = TestFeatureBuilder.of(
+        ("x", T.Real, list(np.linspace(-2, 2, 80))),
+        ("cat", T.PickList, ["a", "b"] * 40),
+        ("y", T.RealNN, [float(i % 2) for i in range(80)]), response="y")
+    feats = VectorsCombiner().set_input(
+        RealVectorizer().set_input(x).get_output(),
+        OneHotVectorizer(top_k=3, min_support=1).set_input(cat).get_output(),
+    ).get_output()
+    pred = OpLogisticRegression(reg_param=0.1).set_input(y, feats).get_output()
+    model = OpWorkflow().set_input_dataset(ds).set_result_features(pred).train()
+    return model, ds, pred
+
+
+def test_local_score_function_matches_batch():
+    model, ds, pred = _trained_model()
+    fn = score_function(model)
+    batch = model.score(ds)[pred.name]
+    for i in [0, 7, 41]:
+        rec = {"x": float(ds["x"].values[i]), "cat": ds["cat"].values[i],
+               "y": float(ds["y"].values[i])}
+        out = fn(rec)
+        assert out[pred.name]["prediction"] == pytest.approx(
+            float(batch.prediction[i]))
+
+
+def test_local_scoring_from_saved_model(tmp_path):
+    model, ds, pred = _trained_model()
+    model.save(str(tmp_path / "m"))
+    fn = load_model_local(str(tmp_path / "m"))
+    out = fn({"x": 1.5, "cat": "a", "y": 0.0})
+    assert set(out[pred.name]) >= {"prediction", "probability_0", "probability_1"}
+    # missing fields behave as nulls, not crashes (nullable-everywhere)
+    out2 = fn({"x": None, "cat": None})
+    assert "prediction" in out2[pred.name]
+
+
+def test_cli_schema_inference(tmp_path):
+    import pandas as pd
+
+    from transmogrifai_tpu.cli import ProblemKind, infer_schema
+
+    df = pd.DataFrame({
+        "id": range(100),
+        "y": [i % 2 for i in range(100)],
+        "amount": np.linspace(0, 1, 100),
+        "color": ["red", "blue"] * 50,
+        "note": [f"free text row number {i} padding words" for i in range(100)],
+    })
+    p = tmp_path / "data.csv"
+    df.to_csv(p, index=False)
+    kind, fields = infer_schema(str(p), response="y", id_field="id")
+    assert kind is ProblemKind.BinaryClassification
+    by_name = {f.name: f for f in fields}
+    assert by_name["y"].is_response and by_name["id"].is_id
+    assert by_name["amount"].feature_type == "Real"
+    assert by_name["color"].feature_type == "PickList"
+    assert by_name["note"].feature_type == "Text"
+
+
+def test_cli_generate_project(tmp_path):
+    import pandas as pd
+
+    df = pd.DataFrame({"id": range(60), "y": [i % 2 for i in range(60)],
+                       "x": np.linspace(0, 1, 60), "c": ["u", "v"] * 30})
+    csv = tmp_path / "train.csv"
+    df.to_csv(csv, index=False)
+    out = subprocess.run(
+        [sys.executable, "-m", "transmogrifai_tpu.cli", "gen", "MyProj",
+         "--input", str(csv), "--response", "y", "--id", "id",
+         "--output", str(tmp_path / "proj")],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stderr
+    app = (tmp_path / "proj" / "app.py").read_text()
+    assert "BinaryClassificationModelSelector" in app
+    assert (tmp_path / "proj" / "README.md").exists()
+    # generated app must at least be valid python
+    compile(app, "app.py", "exec")
+
+
+def test_helloworld_workflows_build():
+    """The example apps' workflows construct + wire without training."""
+    sys.path.insert(0, os.path.join(REPO, "helloworld"))
+    try:
+        import boston
+        import iris
+        import titanic
+
+        for mod in (titanic, iris, boston):
+            wf, pred = mod.build_workflow()
+            assert wf.stages, mod.__name__
+            assert pred.ftype is T.Prediction
+            df = (mod.titanic_data() if mod is titanic else
+                  mod.iris_data() if mod is iris else mod.boston_data())
+            assert len(df) > 100
+    finally:
+        sys.path.pop(0)

@@ -1,0 +1,168 @@
+"""OpWorkflowRunner / OpApp harness + metrics listener
+(SURVEY §2.3 'OpWorkflowRunner / OpApp', §5.1 tracing)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu import (FeatureBuilder, OpApp, OpAppWithRunner, OpListener,
+                               OpParams, OpStep, OpWorkflow, OpWorkflowRunner,
+                               OpWorkflowRunType)
+from transmogrifai_tpu.columns import Dataset, NumericColumn, ObjectColumn
+from transmogrifai_tpu.evaluators import OpBinaryClassificationEvaluator
+from transmogrifai_tpu.impl.classification.logistic import OpLogisticRegression
+from transmogrifai_tpu.impl.feature.vectorizers import (OneHotVectorizer,
+                                                        RealVectorizer,
+                                                        VectorsCombiner)
+from transmogrifai_tpu.readers.base import CustomReader
+from transmogrifai_tpu.readers.joined import StreamingReader
+
+
+def _make_df(n=120, seed=0):
+    import pandas as pd
+
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 1, n)
+    cat = rng.choice(["a", "b"], n)
+    y = ((x + (cat == "a") * 1.5 + rng.normal(0, 0.5, n)) > 0.5).astype(float)
+    return pd.DataFrame({"id": np.arange(n), "x": x, "cat": cat, "y": y})
+
+
+def _workflow():
+    y = FeatureBuilder("y", T.RealNN).extract(field="y").as_response()
+    x = FeatureBuilder("x", T.Real).extract(field="x").as_predictor()
+    cat = FeatureBuilder("cat", T.PickList).extract(field="cat").as_predictor()
+    feats = VectorsCombiner().set_input(
+        RealVectorizer().set_input(x).get_output(),
+        OneHotVectorizer(top_k=5, min_support=1).set_input(cat).get_output(),
+    ).get_output()
+    pred = OpLogisticRegression(reg_param=0.01).set_input(y, feats).get_output()
+    return OpWorkflow().set_result_features(pred), pred
+
+
+def test_runner_train_then_score_then_evaluate(tmp_path):
+    df = _make_df()
+    wf, pred = _workflow()
+    runner = OpWorkflowRunner(
+        wf, train_reader=CustomReader(df, key="id"),
+        scoring_reader=CustomReader(df, key="id"),
+        evaluator=OpBinaryClassificationEvaluator(label_col="y",
+                                                  prediction_col=pred.name))
+    params = OpParams(model_location=str(tmp_path / "model"),
+                      write_location=str(tmp_path / "out"),
+                      metrics_location=str(tmp_path / "metrics"),
+                      collect_stage_metrics=True)
+    r1 = runner.run(OpWorkflowRunType.Train, params)
+    assert r1.model_location and os.path.isdir(r1.model_location)
+    assert (tmp_path / "metrics" / "app_metrics.json").exists()
+    app = json.loads((tmp_path / "metrics" / "app_metrics.json").read_text())
+    assert app["runType"] == "train" and app["appDuration"] >= 0
+    steps = {m["step"] for m in app["stageMetrics"]}
+    assert "FeatureEngineering" in steps
+    phases = {m["phase"] for m in app["stageMetrics"]}
+    assert phases >= {"fit", "transform"}
+
+    r2 = runner.run(OpWorkflowRunType.Score, params)
+    assert r2.n_scored == len(df)
+    scores = json.loads(open(r2.score_location).read())
+    assert len(scores) == len(df)
+    assert scores[0]["key"] == "0"
+    assert "prediction" in scores[0][pred.name]
+    assert r2.metrics and r2.metrics["AuROC"] > 0.7
+
+    r3 = runner.run(OpWorkflowRunType.Evaluate, params)
+    assert r3.metrics["AuROC"] == pytest.approx(r2.metrics["AuROC"])
+
+
+def test_runner_streaming_score(tmp_path):
+    df = _make_df()
+    wf, pred = _workflow()
+    runner = OpWorkflowRunner(wf, train_reader=CustomReader(df, key="id"))
+    params = OpParams(model_location=str(tmp_path / "model"))
+    runner.run(OpWorkflowRunType.Train, params)
+
+    batches = [df.iloc[:40], df.iloc[40:80], df.iloc[80:]]
+    srunner = OpWorkflowRunner(
+        wf, streaming_reader=StreamingReader(batches, key="id"))
+    params.write_location = str(tmp_path / "stream_out")
+    r = srunner.run(OpWorkflowRunType.StreamingScore, params)
+    assert r.n_scored == len(df)
+    assert r.metrics["batches"] == 3
+    assert (tmp_path / "stream_out" / "batch_00000" / "scores.json").exists()
+
+
+def test_runner_features_run_type(tmp_path):
+    df = _make_df()
+    wf, pred = _workflow()
+    runner = OpWorkflowRunner(wf, train_reader=CustomReader(df, key="id"))
+    params = OpParams(write_location=str(tmp_path / "feat_out"))
+    r = runner.run(OpWorkflowRunType.Features, params)
+    assert r.n_scored == len(df)
+    assert os.path.exists(r.score_location)
+
+
+def test_op_app_cli(tmp_path):
+    df = _make_df()
+
+    class MyApp(OpAppWithRunner):
+        app_name = "TestApp"
+
+        def build_runner(self):
+            wf, pred = _workflow()
+            return OpWorkflowRunner(wf, train_reader=CustomReader(df, key="id"))
+
+    result = MyApp().main(["--run-type", "train",
+                           "--model-location", str(tmp_path / "m"),
+                           "--collect-stage-metrics"])
+    assert result.run_type == OpWorkflowRunType.Train
+    assert os.path.isdir(str(tmp_path / "m"))
+    assert result.app_metrics.stage_metrics  # collected
+
+
+def test_listener_step_nesting_and_handlers():
+    listener = OpListener(run_type="test")
+    seen = []
+    listener.add_application_end_handler(lambda m: seen.append(m.app_duration_ms))
+    with listener.install():
+        with listener.step(OpStep.CrossValidation):
+            assert listener.current_step is OpStep.CrossValidation
+            with listener.time_stage(type("S", (), {"operation_name": "x", "uid": "u"})(),
+                                     "fit", 10):
+                pass
+        assert listener.current_step is OpStep.Other
+    assert seen and listener.metrics.stage_metrics[0].step == "CrossValidation"
+
+
+def test_runner_error_paths(tmp_path):
+    wf, _ = _workflow()
+    runner = OpWorkflowRunner(wf)
+    with pytest.raises(ValueError, match="model_location"):
+        runner.run(OpWorkflowRunType.Score, OpParams())
+    with pytest.raises(ValueError, match="evaluator"):
+        runner.run(OpWorkflowRunType.Evaluate,
+                   OpParams(model_location=str(tmp_path / "nope")))
+
+
+def test_runner_score_respects_read_location(tmp_path):
+    """--read-location must override the training-time reader path."""
+    import pandas as pd
+
+    from transmogrifai_tpu.readers import DataReaders
+
+    df = _make_df(n=60)
+    train_csv = tmp_path / "train.csv"
+    df.to_csv(train_csv, index=False)
+    small_csv = tmp_path / "small.csv"
+    df.iloc[:7].to_csv(small_csv, index=False)
+
+    wf, pred = _workflow()
+    reader = DataReaders.Simple.csv_auto(str(train_csv), key="id")
+    runner = OpWorkflowRunner(wf, train_reader=reader, scoring_reader=reader)
+    params = OpParams(model_location=str(tmp_path / "model"),
+                      write_location=str(tmp_path / "out"))
+    runner.run(OpWorkflowRunType.Train, params)
+    params.reader_params["path"] = str(small_csv)
+    r = runner.run(OpWorkflowRunType.Score, params)
+    assert r.n_scored == 7

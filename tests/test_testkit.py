@@ -1,0 +1,156 @@
+"""testkit generators + TestFeatureBuilder + contract specs, and the contract
+specs applied across the stage library (SURVEY §2.5 testkit/, §4)."""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.testkit import (
+    RandomBinary, RandomDate, RandomDateList, RandomGeolocation, RandomIntegral,
+    RandomList, RandomMap, RandomMultiPickList, RandomReal, RandomText,
+    RandomVector, TestFeatureBuilder, assert_estimator_contract, assert_feature,
+    assert_transformer_contract)
+from transmogrifai_tpu.impl.feature import (
+    BinaryVectorizer, DateToUnitCircleTransformer, NumericBucketizer,
+    OneHotVectorizer, OpCountVectorizer, OpNGram, OpStringIndexer,
+    OpStopWordsRemover, RealVectorizer, SmartTextVectorizer, TextLenTransformer,
+    TextTokenizer, OPMapVectorizer)
+
+
+def test_random_generators_determinism_and_nulls():
+    r = RandomReal.normal(mean=5.0, sigma=1.0, prob_null=0.3, seed=7)
+    a, b = r.take(100), r.take(100)
+    assert [x.value for x in a] == [x.value for x in b]  # deterministic
+    nulls = sum(1 for x in a if x.is_empty)
+    assert 10 < nulls < 60
+    vals = [x.value for x in a if not x.is_empty]
+    assert 3.5 < np.mean(vals) < 6.5
+
+    texts = RandomText.of(["a", "b", "c"], prob_null=0.1).take(50)
+    assert {t.value for t in texts if not t.is_empty} <= {"a", "b", "c"}
+    emails = RandomText.emails().take(5)
+    assert all("@example.com" in e.value for e in emails)
+
+    for gen, ft in [(RandomBinary(), T.Binary), (RandomIntegral(), T.Integral),
+                    (RandomDate(), T.Date), (RandomGeolocation(), T.Geolocation),
+                    (RandomMultiPickList(["x", "y", "z"]), T.MultiPickList),
+                    (RandomDateList(), T.DateList), (RandomVector(4), T.OPVector),
+                    (RandomList(RandomText(n_words=1)), T.TextList),
+                    (RandomMap(RandomReal(), ["k1", "k2"], ftype=T.RealMap), T.RealMap)]:
+        out = gen.take(10)
+        assert len(out) == 10 and all(isinstance(v, ft) for v in out)
+
+
+def test_test_feature_builder():
+    ds, (x, label) = TestFeatureBuilder.of(
+        ("x", T.Real, [1.0, None, 3.0]),
+        ("label", T.RealNN, [0.0, 1.0, 0.0]), response="label")
+    assert len(ds) == 3
+    assert ds["x"].mask.tolist() == [True, False, True]
+    assert_feature(x, name="x", ftype=T.Real, is_response=False)
+    assert_feature(label, name="label", ftype=T.RealNN, is_response=True)
+
+    ds2, feats = TestFeatureBuilder.random(
+        20, ("r", RandomReal.uniform()), ("t", RandomText.of(["u", "v"])))
+    assert len(ds2) == 20 and len(feats) == 2
+
+
+# ---------------------------------------------------------------------------
+# contract specs across the stage library — the OpTransformerSpec sweep
+# ---------------------------------------------------------------------------
+def _ds_feats(*cols, response=None):
+    return TestFeatureBuilder.of(*cols, response=response)
+
+
+def test_contract_text_transformers():
+    ds, (t,) = _ds_feats(("t", T.Text, ["Hello the World", None, "b c the d"]))
+    tok = TextTokenizer()
+    tok.set_input(t)
+    out = assert_transformer_contract(tok, ds, expected=[["hello", "world"], [],
+                                                         ["b", "c", "d"]])
+    toks_ds, (tl,) = _ds_feats(("tl", T.TextList, [["foo", "the", "bar"], [], ["x"]]))
+    sw = OpStopWordsRemover()
+    sw.set_input(tl)
+    assert_transformer_contract(sw, toks_ds, expected=[["foo", "bar"], [], ["x"]])
+    ng = OpNGram(n=2)
+    ng.set_input(tl)
+    assert_transformer_contract(ng, toks_ds)
+    ln = TextLenTransformer()
+    ln.set_input(t)
+    assert_transformer_contract(ln, ds, expected=[15, 0, 9])
+
+
+def test_contract_vectorizers():
+    ds, (x, b) = _ds_feats(("x", T.Real, [1.0, None, 5.0]),
+                           ("b", T.Binary, [True, False, None]))
+    rv = RealVectorizer()
+    rv.set_input(x)
+    assert_estimator_contract(rv, ds)
+    bv = BinaryVectorizer()
+    bv.set_input(b)
+    assert_transformer_contract(bv, ds)
+
+    ds2, (p,) = _ds_feats(("p", T.PickList, ["a", "b", "a", None] * 5))
+    oh = OneHotVectorizer(top_k=3, min_support=1)
+    oh.set_input(p)
+    assert_estimator_contract(oh, ds2)
+
+    st = SmartTextVectorizer(max_cardinality=5, top_k=3, min_support=1, num_hashes=8)
+    st.set_input(p)
+    assert_estimator_contract(st, ds2)
+
+
+def test_contract_estimators_with_maps_and_dates():
+    ds, (m,) = _ds_feats(("m", T.RealMap, [{"a": 1.0}, {"a": 2.0, "b": 3.0}, {}]))
+    mv = OPMapVectorizer()
+    mv.set_input(m)
+    assert_estimator_contract(mv, ds)
+
+    ds2, (d,) = _ds_feats(("d", T.Date, [0, 3_600_000, None]))
+    uc = DateToUnitCircleTransformer()
+    uc.set_input(d)
+    assert_transformer_contract(uc, ds2)
+
+    ds3, (t,) = _ds_feats(("t", T.Text, ["x", "y", "x", None]))
+    si = OpStringIndexer()
+    si.set_input(t)
+    assert_estimator_contract(si, ds3)
+
+    ds4, (tl,) = _ds_feats(("tl", T.TextList, [["a", "b"], ["b"], []]))
+    cv = OpCountVectorizer(vocab_size=4, min_df=1)
+    cv.set_input(tl)
+    assert_estimator_contract(cv, ds4)
+
+
+def test_contract_bucketizer():
+    ds, (x,) = _ds_feats(("x", T.Real, [0.5, 1.5, None, 2.5]))
+    nb = NumericBucketizer(splits=[0.0, 1.0, 2.0, 3.0])
+    nb.set_input(x)
+    assert_transformer_contract(nb, ds)
+
+
+def test_contract_catches_violation():
+    """The spec must actually fail for a broken stage."""
+    from transmogrifai_tpu.stages.base import UnaryTransformer
+    from transmogrifai_tpu.columns import NumericColumn
+
+    class Broken(UnaryTransformer):
+        """Row path explicitly disagrees with the batch path.  (By default
+        transform_row derives FROM transform_columns, so parity holds by
+        construction — a stage must override both to break it.)"""
+
+        def __init__(self):
+            super().__init__("broken", T.Real, T.Real)
+
+        def transform_row(self, row):
+            return T.Real(1.0)
+
+        def transform_columns(self, cols):
+            c = cols[0]
+            return NumericColumn(T.Real, np.full(len(c), 2.0), np.ones(len(c), bool))
+
+    ds, (x,) = _ds_feats(("x", T.Real, [1.0, 2.0]))
+    st = Broken()
+    st.set_input(x)
+    with pytest.raises(AssertionError, match="batch"):
+        from transmogrifai_tpu.testkit import asserts
+        asserts.assert_batch_row_parity(st, ds)

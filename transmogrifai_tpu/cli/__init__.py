@@ -1,1 +1,11 @@
-"""Package."""
+"""cli — project generator (reference cli/ module, `transmogrifai gen`).
+
+Usage:
+    python -m transmogrifai_tpu.cli gen --input data.csv --response y \
+        --id id_col MyProject
+"""
+from .gen import (FieldSchema, ProblemKind, generate_project, infer_field,
+                  infer_problem_kind, infer_schema)
+
+__all__ = ["FieldSchema", "ProblemKind", "generate_project", "infer_field",
+           "infer_problem_kind", "infer_schema"]

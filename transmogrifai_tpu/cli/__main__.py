@@ -1,0 +1,27 @@
+"""CLI entry: python -m transmogrifai_tpu.cli gen ... (cli/.../CLI.scala)."""
+import argparse
+import sys
+
+from .gen import generate_project
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="transmogrifai_tpu.cli")
+    sub = p.add_subparsers(dest="command", required=True)
+    gen = sub.add_parser("gen", help="generate a runnable project from a CSV")
+    gen.add_argument("project", help="project name / output directory")
+    gen.add_argument("--input", required=True, help="training CSV path")
+    gen.add_argument("--response", required=True, help="response column")
+    gen.add_argument("--id", dest="id_field", help="row-id column")
+    gen.add_argument("--output", help="output directory (default: project name)")
+    args = p.parse_args(argv)
+    if args.command == "gen":
+        out = generate_project(args.project, args.input, args.response,
+                               id_field=args.id_field, out_dir=args.output)
+        print(f"Generated project at {out}")
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,7 +21,9 @@ import numpy as np
 
 from ...ops import trees as Tr
 from ..selector.predictor import PredictorEstimator
-from ..trees_common import TreeParamsMixin, gbt_boost_params, xgb_boost_params
+from ..trees_common import (TreeParamsMixin, boosted_grid_folds as _boosted_grid_folds,
+                            forest_grid_folds as _forest_grid_folds,
+                            gbt_boost_params, xgb_boost_params)
 
 
 def _as_f32(x):
@@ -78,6 +80,14 @@ class OpRandomForestClassifier(_TreeClassifierBase):
                 "edges": edges, "max_depth": depth, "num_classes": k,
                 "num_trees": n_trees}
 
+    @staticmethod
+    def _dist_to_preds(dist: np.ndarray, num_trees: int
+                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        dist = np.clip(dist, 0.0, None)
+        prob = dist / np.maximum(dist.sum(axis=1, keepdims=True), 1e-12)
+        raw = dist * num_trees  # Spark rawPrediction = vote mass
+        return prob.argmax(axis=1).astype(np.float64), raw, prob
+
     @classmethod
     def predict_arrays(cls, params: Dict[str, Any], X: np.ndarray
                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -86,10 +96,16 @@ class OpRandomForestClassifier(_TreeClassifierBase):
                          jnp.asarray(params["split_bin"]),
                          jnp.asarray(params["leaf_val"]))
         dist = np.asarray(Tr.predict_forest(Xb, forest, params["max_depth"]))
-        dist = np.clip(dist, 0.0, None)
-        prob = dist / np.maximum(dist.sum(axis=1, keepdims=True), 1e-12)
-        raw = dist * params["num_trees"]  # Spark rawPrediction = vote mass
-        return prob.argmax(axis=1).astype(np.float64), raw, prob
+        return cls._dist_to_preds(dist, params["num_trees"])
+
+    def fit_grid_folds(self, X, y, train_w, grids):
+        """Batched fold x grid forest sweep (one chunked launch per
+        max_depth group — see trees_common.forest_grid_folds)."""
+        k = self._n_classes(y)
+        return _forest_grid_folds(
+            self, X, y, train_w, grids, n_classes=k,
+            convert=lambda dist, cand: self._dist_to_preds(
+                dist, int(cand.get_param("num_trees", 20))))
 
 
 class OpDecisionTreeClassifier(OpRandomForestClassifier):
@@ -159,15 +175,10 @@ class _BoostedClassifierBase(_TreeClassifierBase):
                 "edges": edges, "max_depth": bp["max_depth"], "eta": bp["eta"],
                 "num_classes": k, "loss": loss}
 
-    @classmethod
-    def predict_arrays(cls, params: Dict[str, Any], X: np.ndarray
-                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        Xb = jnp.asarray(Tr.bin_with_edges(X, params["edges"]))
-        trees = Tr.Tree(jnp.asarray(params["split_feat"]),
-                        jnp.asarray(params["split_bin"]),
-                        jnp.asarray(params["leaf_val"]))
-        F = Tr.predict_gbt(Xb, trees, params["max_depth"], params["eta"])
-        if params["loss"] == "logistic":
+    @staticmethod
+    def _margins_to_preds(loss: str, F: np.ndarray
+                          ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        if loss == "logistic":
             z = np.asarray(F[:, 0], np.float64)
             p1 = 1.0 / (1.0 + np.exp(-z))
             raw = np.stack([-z, z], axis=1)
@@ -177,6 +188,30 @@ class _BoostedClassifierBase(_TreeClassifierBase):
         ez = np.exp(z - z.max(axis=1, keepdims=True))
         prob = ez / ez.sum(axis=1, keepdims=True)
         return z.argmax(axis=1).astype(np.float64), z, prob
+
+    @classmethod
+    def predict_arrays(cls, params: Dict[str, Any], X: np.ndarray
+                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        Xb = jnp.asarray(Tr.bin_with_edges(X, params["edges"]))
+        trees = Tr.Tree(jnp.asarray(params["split_feat"]),
+                        jnp.asarray(params["split_bin"]),
+                        jnp.asarray(params["leaf_val"]))
+        F = Tr.predict_gbt(Xb, trees, params["max_depth"], params["eta"])
+        return cls._margins_to_preds(params["loss"], np.asarray(F))
+
+    def fit_grid_folds(self, X, y, train_w, grids):
+        """Batched fold x grid sweep for boosted models (SURVEY §2.7 axis 2):
+        grids sharing static shape params train as one vmapped XLA launch
+        (ops/trees.fit_gbt_batch); mixed static params run one launch per
+        static group."""
+        k = self._n_classes(y)
+        loss = "logistic" if k == 2 else "softmax"
+
+        def convert(F):
+            return self._margins_to_preds(loss, F)
+
+        return _boosted_grid_folds(self, X, y, train_w, grids,
+                                   loss=loss, n_classes=k, convert=convert)
 
 
 class OpGBTClassifier(_BoostedClassifierBase):

@@ -128,10 +128,19 @@ class OpWord2VecModel(Model):
         self.vocabulary = list(vocabulary)
         self.vectors = np.asarray(vectors, dtype=np.float32)
 
+    @property
+    def _index(self) -> dict:
+        # cached vocab index keyed by list identity; per-record local scoring
+        # must not rebuild O(V), and a swapped vocabulary must invalidate
+        if getattr(self, "_index_cache_src", None) is not self.vocabulary:
+            self._index_cache = {t: i for i, t in enumerate(self.vocabulary)}
+            self._index_cache_src = self.vocabulary
+        return self._index_cache
+
     def transform_columns(self, cols: Sequence[Column]) -> VectorColumn:
         col = cols[0]
         assert isinstance(col, ObjectColumn)
-        index = {t: i for i, t in enumerate(self.vocabulary)}
+        index = self._index
         n = len(col)
         d = self.vectors.shape[1] if self.vectors.size else 0
         out = np.zeros((n, d), dtype=np.float32)

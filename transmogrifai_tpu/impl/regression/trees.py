@@ -14,7 +14,9 @@ import numpy as np
 
 from ...ops import trees as Tr
 from ..selector.predictor import PredictorEstimator
-from ..trees_common import TreeParamsMixin, gbt_boost_params, xgb_boost_params
+from ..trees_common import (TreeParamsMixin, boosted_grid_folds as _boosted_grid_folds,
+                            forest_grid_folds as _forest_grid_folds,
+                            gbt_boost_params, xgb_boost_params)
 
 
 class _TreeRegressorBase(TreeParamsMixin, PredictorEstimator):
@@ -67,6 +69,14 @@ class OpRandomForestRegressor(_TreeRegressorBase):
                          jnp.asarray(params["leaf_val"]))
         pred = np.asarray(Tr.predict_forest(Xb, forest, params["max_depth"]))[:, 0]
         return pred.astype(np.float64), None, None
+
+    def fit_grid_folds(self, X, y, train_w, grids):
+        """Batched fold x grid forest sweep (trees_common.forest_grid_folds);
+        variance-gain trees with mean leaves (n_classes=1)."""
+        return _forest_grid_folds(
+            self, X, y, train_w, grids, n_classes=1,
+            convert=lambda dist, cand: (np.asarray(dist[:, 0], np.float64),
+                                        None, None))
 
 
 class OpDecisionTreeRegressor(OpRandomForestRegressor):
@@ -139,6 +149,13 @@ class _BoostedRegressorBase(_TreeRegressorBase):
         F = Tr.predict_gbt(Xb, trees, params["max_depth"], params["eta"],
                            base_score=params["base_score"])
         return np.asarray(F[:, 0], np.float64), None, None
+
+    def fit_grid_folds(self, X, y, train_w, grids):
+        """Batched fold x grid sweep (see _BoostedClassifierBase)."""
+        return _boosted_grid_folds(
+            self, X, y, train_w, grids, loss="squared", n_classes=1,
+            convert=lambda F: (np.asarray(F[:, 0], np.float64), None, None),
+            fold_base_score=True)
 
 
 class OpGBTRegressor(_BoostedRegressorBase):

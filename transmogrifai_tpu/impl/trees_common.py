@@ -57,6 +57,166 @@ def gbt_boost_params(stage) -> Dict[str, Any]:
             "min_child_weight": float(stage.get_param("min_instances_per_node", 1))}
 
 
+#: boosting hyperparameters that are traced scalars in the kernel — grids
+#: varying only these batch into one launch
+_DYNAMIC_BOOST_KEYS = ("eta", "step_size", "reg_lambda", "gamma",
+                       "min_child_weight", "min_instances_per_node")
+
+
+def boosted_grid_folds(est, X, y, train_w, grids, loss: str, n_classes: int,
+                       convert, fold_base_score: bool = False) -> list:
+    """fold x grid sweep for boosted models: group grids by their static
+    shape params (rounds/depth/bins/subsample/colsample), train each group as
+    ONE vmapped launch (ops/trees.fit_gbt_batch), convert margins to
+    predictions with ``convert``.
+
+    Returns ``preds[fold][grid] = convert(F_margins_on_full_X)``.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops import trees as Tr
+
+    grids = [dict(g) for g in (grids or [{}])]
+    candidates = [est.copy_with_params(g) for g in grids]
+    bps = [c._boost_params() for c in candidates]
+    for g in grids:
+        for key in g:
+            # NOTE: "seed" is deliberately NOT batchable — the group shares
+            # one subsample/colsample draw, so per-candidate seeds must take
+            # the per-candidate fallback loop
+            if key not in _DYNAMIC_BOOST_KEYS and key not in (
+                    "num_round", "max_iter", "max_depth", "max_bins",
+                    "subsample", "subsampling_rate", "colsample_bytree"):
+                raise NotImplementedError(f"non-batchable boosting grid key {key}")
+
+    n_folds = train_w.shape[0]
+    n, d = X.shape
+    out = [[None] * len(grids) for _ in range(n_folds)]
+    groups: Dict[tuple, list] = {}
+    for ci, bp in enumerate(bps):
+        static = (bp["n_rounds"], bp["max_depth"], bp["n_bins"],
+                  bp["subsample"], bp["colsample"])
+        groups.setdefault(static, []).append(ci)
+
+    for (n_rounds, max_depth, n_bins, subsample, colsample), cis in groups.items():
+        rng = np.random.default_rng(int(est.get_param("seed", 42)))
+        Xb, _ = Tr.quantize(X, n_bins)
+        rw = Tr.subsample_weights(n, n_rounds, subsample, rng)
+        fms = Tr.feature_masks(d, n_rounds, colsample, rng)
+        B = n_folds * len(cis)
+        w_batch = np.empty((B, n), np.float32)
+        eta_b = np.empty(B, np.float32)
+        lam_b = np.empty(B, np.float32)
+        gam_b = np.empty(B, np.float32)
+        mcw_b = np.empty(B, np.float32)
+        base_b = np.zeros(B, np.float32)
+        yf = np.asarray(y, np.float32)
+        for bi, (f, ci) in enumerate((f, ci) for f in range(n_folds) for ci in cis):
+            bp = bps[ci]
+            w_batch[bi] = train_w[f]
+            eta_b[bi] = bp["eta"]
+            lam_b[bi] = max(bp["reg_lambda"], 1e-6)
+            gam_b[bi] = bp["gamma"]
+            mcw_b[bi] = bp["min_child_weight"]
+            if fold_base_score:  # regression starts from the fold's label mean
+                wsum = max(float(train_w[f].sum()), 1e-12)
+                base_b[bi] = float((yf * train_w[f]).sum() / wsum)
+        F = Tr.fit_gbt_batch(
+            jnp.asarray(Xb), jnp.asarray(yf),
+            jnp.asarray(w_batch), jnp.asarray(rw), jnp.asarray(fms), loss=loss,
+            n_rounds=n_rounds, max_depth=max_depth, n_bins=n_bins,
+            eta_b=jnp.asarray(eta_b), reg_lambda_b=jnp.asarray(lam_b),
+            gamma_b=jnp.asarray(gam_b), min_child_weight_b=jnp.asarray(mcw_b),
+            base_score_b=jnp.asarray(base_b), n_classes=n_classes)
+        F = np.asarray(F)
+        for bi, (f, ci) in enumerate((f, ci) for f in range(n_folds) for ci in cis):
+            out[f][ci] = convert(F[bi])
+    return out
+
+
+#: forest grid keys that batch (host-side or per-tree traced)
+_FOREST_GRID_KEYS = ("max_depth", "num_trees", "min_instances_per_node",
+                     "subsampling_rate", "feature_subset_strategy", "max_bins",
+                     "impurity")
+
+
+def forest_grid_folds(est, X, y, train_w, grids, n_classes: int, convert) -> list:
+    """fold x grid RF sweep: per (max_depth, num_trees, max_bins) group all
+    (fold, candidate, bootstrap-tree) triples train as one memory-chunked
+    launch (ops/trees.fit_forest_chunked) and evaluate with one grouped
+    predict.  ``convert(dist)`` maps each group's mean leaf vector on the
+    full X to (pred, raw, prob)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops import trees as Tr
+
+    grids = [dict(g) for g in (grids or [{}])]
+    for g in grids:
+        for key in g:
+            if key not in _FOREST_GRID_KEYS:
+                raise NotImplementedError(f"non-batchable forest grid key {key}")
+    candidates = [est.copy_with_params(g) for g in grids]
+    n_folds = train_w.shape[0]
+    n, d = X.shape
+    c = max(n_classes, 1)
+    out = [[None] * len(grids) for _ in range(n_folds)]
+    groups: Dict[tuple, list] = {}
+    for ci, cand in enumerate(candidates):
+        static = (int(cand.get_param("max_depth", 5)),
+                  int(cand.get_param("num_trees", 20)),
+                  int(cand.get_param("max_bins", 32)))
+        groups.setdefault(static, []).append(ci)
+
+    if n_classes >= 2:
+        G = -np.eye(n_classes, dtype=np.float32)[np.asarray(y, np.int64)]
+    else:
+        G = -np.asarray(y, np.float32)[:, None]
+    H = np.ones(n, np.float32)
+
+    for (max_depth, n_trees, n_bins), cis in groups.items():
+        Xb, _ = Tr.quantize(X, n_bins)
+        pairs = [(f, ci) for f in range(n_folds) for ci in cis]
+        TT = len(pairs) * n_trees
+        w_trees = np.empty((TT, n), np.float32)
+        fms = np.empty((TT, d), np.float32)
+        mcw = np.empty(TT, np.float32)
+        for gi, (f, ci) in enumerate(pairs):
+            cand = candidates[ci]
+            rng = np.random.default_rng(int(cand.get_param("seed", 42)))
+            if getattr(cand, "_grid_bootstrap", True):
+                boot = Tr.bootstrap_weights(
+                    n, n_trees, rng,
+                    rate=float(cand.get_param("subsampling_rate", 1.0)))
+                fm = Tr.feature_masks(d, n_trees, cand._subset_frac(d), rng)
+            else:  # single deterministic tree (OpDecisionTree*): no bagging
+                boot = np.ones((n_trees, n), np.float32)
+                fm = np.ones((n_trees, d), np.float32)
+            w_trees[gi * n_trees:(gi + 1) * n_trees] = boot * train_w[f][None, :]
+            fms[gi * n_trees:(gi + 1) * n_trees] = fm
+            mcw[gi * n_trees:(gi + 1) * n_trees] = float(
+                cand.get_param("min_instances_per_node", 1))
+        chunk = min(Tr.forest_chunk_size(max_depth, n_bins, d, c), TT)
+        pad = (-TT) % chunk
+        if pad:  # zero-weight padding trees grow no splits and are dropped
+            w_trees = np.concatenate([w_trees, np.zeros((pad, n), np.float32)])
+            fms = np.concatenate([fms, np.ones((pad, d), np.float32)])
+            mcw = np.concatenate([mcw, np.ones(pad, np.float32)])
+        forest = Tr.fit_forest_chunked(
+            jnp.asarray(Xb), jnp.asarray(G), jnp.asarray(H), jnp.asarray(w_trees),
+            jnp.asarray(fms), jnp.asarray(mcw), max_depth=max_depth,
+            n_bins=n_bins, chunk=chunk)
+        if pad:
+            forest = Tr.Tree(forest.split_feat[:TT], forest.split_bin[:TT],
+                             forest.leaf_val[:TT])
+        dist = np.asarray(Tr.predict_forest_groups(jnp.asarray(Xb), forest,
+                                                   max_depth, len(pairs)))
+        for gi, (f, ci) in enumerate(pairs):
+            out[f][ci] = convert(dist[gi], candidates[ci])
+    return out
+
+
 def xgb_boost_params(stage) -> Dict[str, Any]:
     """XGBoost param dict (numRound/eta/lambda/gamma/subsample/colsample)."""
     return {"n_rounds": int(stage.get_param("num_round", 100)),

@@ -1,1 +1,13 @@
-"""Package."""
+"""local — runtime-free per-record scoring (reference local/ module).
+
+Reference parity: local/src/main/scala/com/salesforce/op/local/
+OpWorkflowModelLocal.scala:42-80 — ``model.scoreFunction`` turns a fitted
+workflow into a plain ``Map[String, Any] => Map[String, Any]`` function with
+no Spark (here: no batch Dataset, no device math) in the loop: every stage
+runs through its row-wise ``transform_row`` path (``transformKeyValue``
+analog), so a fitted model can serve single records inside any Python
+process with numpy-only latency.
+"""
+from .scoring import ScoreFunction, load_model_local, score_function
+
+__all__ = ["ScoreFunction", "load_model_local", "score_function"]

@@ -1,0 +1,75 @@
+"""Per-record scoring function (OpWorkflowModelLocal.scala:42-80).
+
+The fitted DAG is walked once to precompute stage order; each call then
+threads a plain dict through every stage's ``transform_row`` — the reference
+runs OP stages via ``transformKeyValue`` and converts Spark-wrapped stages to
+MLeap row functions; here every stage already has a row path by construction
+(stages/base.py derives it from the batch path).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+
+from .. import types as T
+from ..features.generator import FeatureGeneratorStage
+from ..stages.base import Model, PipelineStage, Transformer
+from ..workflow.model import OpWorkflowModel, load_model
+
+
+class ScoreFunction:
+    """Callable record -> scores dict; precomputed stage schedule."""
+
+    def __init__(self, model: OpWorkflowModel):
+        self._raw_features = list(model.raw_features)
+        self._schedule: List[Transformer] = []
+        for layer in model.dag:
+            for stage in layer:
+                if not isinstance(stage, Transformer):
+                    raise TypeError(
+                        f"Model contains unfitted estimator {stage}; train first")
+                self._schedule.append(stage)
+        self._result_names = [f.name for f in model.result_features]
+
+    def __call__(self, record: Dict[str, Any]) -> Dict[str, Any]:
+        row: Dict[str, T.FeatureType] = {}
+        for f in self._raw_features:
+            stage = f.origin_stage
+            if isinstance(stage, FeatureGeneratorStage):
+                row[f.name] = stage.extract(record)
+            else:  # already-typed input
+                v = record.get(f.name)
+                row[f.name] = v if isinstance(v, T.FeatureType) else T.make(f.ftype, v)
+        for stage in self._schedule:
+            outs = stage.get_outputs()
+            if stage.n_outputs == 1:
+                row[outs[0].name] = stage.transform_row(row)
+            else:
+                vals = stage.transform_row(row)
+                for f, v in zip(outs, vals):
+                    row[f.name] = v
+        out: Dict[str, Any] = {}
+        for name in self._result_names:
+            v = row.get(name)
+            if v is None:
+                continue
+            if isinstance(v, T.Prediction):
+                out[name] = v.to_dict()
+            elif isinstance(v, T.FeatureType):
+                val = v.value
+                out[name] = val.tolist() if isinstance(val, np.ndarray) else val
+            else:
+                out[name] = v
+        return out
+
+
+def score_function(model: OpWorkflowModel) -> ScoreFunction:
+    """model.scoreFunction analog."""
+    return ScoreFunction(model)
+
+
+def load_model_local(path: str) -> ScoreFunction:
+    """Load a saved model directly as a local score function
+    (OpWorkflowModel.loadModel + scoreFunction in one step)."""
+    return ScoreFunction(load_model(path))

@@ -215,6 +215,55 @@ def predict_forest(Xb, forest: Tree, max_depth: int) -> jax.Array:
     return preds.mean(axis=0)
 
 
+def forest_chunk_size(max_depth: int, n_bins: int, d: int, c: int,
+                      budget_bytes: float = 1.5e9) -> int:
+    """Trees per chunk so one chunk's level histograms fit the budget.
+
+    The deepest level materializes G [m, d, B, c] + H [m, d, B] per tree
+    (m = 2^max_depth); deep trees would otherwise blow HBM when many train
+    at once."""
+    per_tree = (2 ** max_depth) * n_bins * d * (c + 1) * 4
+    return max(1, int(budget_bytes / max(per_tree, 1)))
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth", "n_bins", "chunk"))
+def fit_forest_chunked(Xb, g, h, w_trees, feat_masks, mcw_trees, max_depth: int,
+                       n_bins: int, chunk: int, reg_lambda: float = 1e-6) -> Tree:
+    """Train an arbitrary tree population with bounded memory: ``lax.map``
+    over chunks of ``chunk`` vmapped trees — one compile, sequential chunks.
+
+    The tree axis TT (a multiple of ``chunk``; callers pad with zero-weight
+    trees) may interleave folds x grid candidates x bootstrap replicas —
+    per-tree ``mcw_trees`` carries the grid's min-child-weight, so a whole
+    RF fold x grid sweep is a single launch (SURVEY §2.7 axis 2).
+    """
+    n = Xb.shape[0]
+    d = Xb.shape[1]
+
+    def one_chunk(args):
+        wts, fms, mcws = args
+
+        def one(wt, fm, mcw):
+            return grow_tree(Xb, g, h, wt, fm, max_depth, n_bins,
+                             reg_lambda=reg_lambda, gamma=0.0,
+                             min_child_weight=mcw)
+
+        return jax.vmap(one)(wts, fms, mcws)
+
+    trees = lax.map(one_chunk, (w_trees.reshape(-1, chunk, n),
+                                feat_masks.reshape(-1, chunk, d),
+                                mcw_trees.reshape(-1, chunk)))
+    return jax.tree.map(lambda a: a.reshape((-1,) + a.shape[2:]), trees)
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth", "n_groups"))
+def predict_forest_groups(Xb, forest: Tree, max_depth: int, n_groups: int) -> jax.Array:
+    """Mean leaf vector per group of trees: f32[n_groups, n, c] — the eval
+    half of the batched fold x grid RF sweep (forest axis = n_groups * T)."""
+    preds = jax.vmap(lambda t: predict_tree(Xb, t, max_depth))(forest)  # [TT, n, c]
+    return preds.reshape((n_groups, -1) + preds.shape[1:]).mean(axis=1)
+
+
 # ---------------------------------------------------------------------------
 # Gradient boosting — lax.scan over rounds
 # ---------------------------------------------------------------------------
@@ -231,20 +280,11 @@ def _grad_hess(loss: str, F, y, Y_onehot):
     raise ValueError(f"unknown loss {loss!r}")
 
 
-@functools.partial(jax.jit, static_argnames=("loss", "n_rounds", "max_depth",
-                                             "n_bins", "n_classes"))
-def fit_gbt(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int,
-            max_depth: int, n_bins: int, eta: float = 0.3,
-            reg_lambda: float = 1.0, gamma: float = 0.0,
-            min_child_weight: float = 1.0, base_score: float = 0.0,
-            n_classes: int = 1) -> Tuple[Tree, jax.Array]:
-    """XGBoost-style boosting: scan over rounds, one histogram tree per round.
-
-    row_w_rounds: f32[R, n] subsample weights per round; feat_mask_rounds:
-    f32[R, d] colsample masks.  Multiclass uses multi-output trees (leaf
-    vector per class) — a TPU-friendly variant of per-class tree sets.
-    Returns (stacked Tree [R, ...], final margins F [n, c]).
-    """
+def _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int,
+              max_depth: int, n_bins: int, eta, reg_lambda, gamma,
+              min_child_weight, base_score: float, n_classes: int
+              ) -> Tuple[Tree, jax.Array]:
+    """Traceable boosting body shared by fit_gbt and fit_gbt_batch."""
     n = Xb.shape[0]
     c = n_classes if loss == "softmax" else 1
     Y = jax.nn.one_hot(y.astype(jnp.int32), max(c, 2), dtype=jnp.float32) \
@@ -262,6 +302,55 @@ def fit_gbt(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int,
 
     F, trees = lax.scan(round_fn, F0, (row_w_rounds, feat_mask_rounds))
     return trees, F
+
+
+@functools.partial(jax.jit, static_argnames=("loss", "n_rounds", "max_depth",
+                                             "n_bins", "n_classes"))
+def fit_gbt(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int,
+            max_depth: int, n_bins: int, eta: float = 0.3,
+            reg_lambda: float = 1.0, gamma: float = 0.0,
+            min_child_weight: float = 1.0, base_score: float = 0.0,
+            n_classes: int = 1) -> Tuple[Tree, jax.Array]:
+    """XGBoost-style boosting: scan over rounds, one histogram tree per round.
+
+    row_w_rounds: f32[R, n] subsample weights per round; feat_mask_rounds:
+    f32[R, d] colsample masks.  Multiclass uses multi-output trees (leaf
+    vector per class) — a TPU-friendly variant of per-class tree sets.
+    Returns (stacked Tree [R, ...], final margins F [n, c]).
+    """
+    return _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss, n_rounds,
+                     max_depth, n_bins, eta, reg_lambda, gamma, min_child_weight,
+                     base_score, n_classes)
+
+
+@functools.partial(jax.jit, static_argnames=("loss", "n_rounds", "max_depth",
+                                             "n_bins", "n_classes"))
+def fit_gbt_batch(Xb, y, w_batch, row_w_rounds, feat_mask_rounds, loss: str,
+                  n_rounds: int, max_depth: int, n_bins: int,
+                  eta_b, reg_lambda_b, gamma_b, min_child_weight_b,
+                  base_score_b=None, n_classes: int = 1) -> jax.Array:
+    """The fold x grid boosting sweep as ONE launch (the OpValidator
+    thread-pool analog for boosted models — SURVEY §2.7 axis 2).
+
+    ``w_batch`` f32[B, n] carries fold-mask x sample weights per batch
+    element; ``eta_b``/``reg_lambda_b``/``gamma_b``/``min_child_weight_b``
+    f32[B] are the grid's dynamic hyperparameters (static shape params —
+    depth, rounds, bins — must match across the batch; the caller groups
+    grids accordingly).  Returns final margins F f32[B, n, c] on the FULL
+    dataset, from which fold-validation slices are taken.
+    """
+
+    if base_score_b is None:
+        base_score_b = jnp.zeros(w_batch.shape[0], jnp.float32)
+
+    def one(w, eta, lam, gam, mcw, base):
+        _, F = _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss,
+                         n_rounds, max_depth, n_bins, eta, lam, gam, mcw,
+                         base, n_classes)
+        return F
+
+    return jax.vmap(one)(w_batch, eta_b, reg_lambda_b, gamma_b,
+                         min_child_weight_b, base_score_b)
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth",))

@@ -1,0 +1,284 @@
+"""OpWorkflowRunner / OpApp — the production app harness.
+
+Reference parity: core/src/main/scala/com/salesforce/op/OpWorkflowRunner.scala:70
+and OpApp.scala:49 —
+
+- run types ``Train | Score | StreamingScore | Features | Evaluate``
+  (OpWorkflowRunner.scala:358-365),
+- ``run(run_type, params)`` (:296) installs the metrics listener, dispatches,
+  writes results/metrics to the configured locations,
+- ``OpApp`` (:49) is the CLI entry: parses args (scopt analog = argparse),
+  builds the runtime, calls the runner's ``main``; subclass and provide a
+  workflow (``OpAppWithRunner:191``).
+
+Where the reference boots a SparkSession + Kryo, here the runtime is the
+in-process JAX/XLA client — ``OpApp.configure_runtime`` is the hook for
+device/mesh setup (jax.distributed for multi-host).
+"""
+from __future__ import annotations
+
+import argparse
+import enum
+import json
+import os
+import sys
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, List, Optional
+
+from .columns import Dataset
+from .evaluators.base import OpEvaluatorBase
+from .readers.base import Reader
+from .readers.joined import StreamingReader
+from .utils.listener import AppMetrics, OpListener, OpStep
+from .workflow.model import OpWorkflowModel, load_model
+from .workflow.params import OpParams
+from .workflow.workflow import OpWorkflow
+
+
+class OpWorkflowRunType(str, enum.Enum):
+    """OpWorkflowRunner.scala:358-365."""
+
+    Train = "train"
+    Score = "score"
+    StreamingScore = "streamingScore"
+    Features = "features"
+    Evaluate = "evaluate"
+
+
+@dataclass
+class OpWorkflowRunnerResult:
+    """What a run produced (reference *Result classes per run type)."""
+
+    run_type: OpWorkflowRunType
+    model_location: Optional[str] = None
+    score_location: Optional[str] = None
+    metrics: Optional[Dict[str, Any]] = None
+    app_metrics: Optional[AppMetrics] = None
+    n_scored: int = 0
+
+
+class OpWorkflowRunner:
+    """Dispatches the five run types over a workflow (OpWorkflowRunner.scala:70)."""
+
+    def __init__(self, workflow: OpWorkflow,
+                 train_reader: Optional[Reader] = None,
+                 scoring_reader: Optional[Reader] = None,
+                 streaming_reader: Optional[StreamingReader] = None,
+                 evaluator: Optional[OpEvaluatorBase] = None,
+                 features_to_compute: Optional[List] = None):
+        self.workflow = workflow
+        self.train_reader = train_reader
+        self.scoring_reader = scoring_reader
+        self.streaming_reader = streaming_reader
+        self.evaluator = evaluator
+        self.features_to_compute = features_to_compute or []
+        self._end_handlers = []
+
+    def add_application_end_handler(self, fn) -> None:
+        self._end_handlers.append(fn)
+
+    # ---- dispatch (OpWorkflowRunner.run:296) -------------------------------
+    def run(self, run_type: OpWorkflowRunType,
+            params: Optional[OpParams] = None) -> OpWorkflowRunnerResult:
+        params = params or self.workflow.parameters or OpParams()
+        self.workflow.set_parameters(params)
+        run_type = OpWorkflowRunType(run_type)
+        listener = OpListener(run_type=run_type.value,
+                              collect_stage_metrics=params.collect_stage_metrics)
+        for fn in self._end_handlers:
+            listener.add_application_end_handler(fn)
+        with listener.install():
+            dispatch = {
+                OpWorkflowRunType.Train: self._train,
+                OpWorkflowRunType.Score: self._score,
+                OpWorkflowRunType.StreamingScore: self._streaming_score,
+                OpWorkflowRunType.Features: self._features,
+                OpWorkflowRunType.Evaluate: self._evaluate,
+            }
+            result = dispatch[run_type](params, listener)
+        result.app_metrics = listener.metrics
+        if params.metrics_location:
+            os.makedirs(params.metrics_location, exist_ok=True)
+            with open(os.path.join(params.metrics_location, "app_metrics.json"), "w") as fh:
+                json.dump(listener.metrics.to_json(), fh, indent=2)
+            if result.metrics is not None:
+                with open(os.path.join(params.metrics_location, "metrics.json"), "w") as fh:
+                    json.dump(result.metrics, fh, indent=2)
+        return result
+
+    # ---- run types ---------------------------------------------------------
+    def _train(self, params: OpParams, listener: OpListener) -> OpWorkflowRunnerResult:
+        if self.train_reader is not None:
+            self.workflow.set_reader(self.train_reader)
+        with listener.step(OpStep.FeatureEngineering):
+            model = self.workflow.train()
+        loc = params.model_location
+        if loc:
+            with listener.step(OpStep.ModelIO):
+                model.save(loc)
+        return OpWorkflowRunnerResult(OpWorkflowRunType.Train, model_location=loc,
+                                      metrics={"summary": model.summary()})
+
+    def _load_model(self, params: OpParams, listener: OpListener) -> OpWorkflowModel:
+        if not params.model_location:
+            raise ValueError("model_location is required for this run type")
+        with listener.step(OpStep.ModelIO):
+            model = load_model(params.model_location)
+        return model
+
+    def _scoring_data(self, model: OpWorkflowModel):
+        if self.scoring_reader is not None:
+            model.reader = self.scoring_reader
+        if model.reader is None:
+            raise ValueError("A scoring reader is required (scoring_reader=...)")
+        return model
+
+    def _write_scores(self, scored: Dataset, result_names: List[str],
+                      params: OpParams) -> Optional[str]:
+        if not params.write_location:
+            return None
+        os.makedirs(params.write_location, exist_ok=True)
+        path = os.path.join(params.write_location, "scores.json")
+        out: List[Dict[str, Any]] = []
+        for i in range(len(scored)):
+            row: Dict[str, Any] = {}
+            if scored.key is not None:
+                row["key"] = scored.key[i]
+            for n in result_names:
+                v = scored[n].to_scalar(i)
+                row[n] = v.to_dict() if hasattr(v, "to_dict") else v.value
+            out.append(row)
+        with open(path, "w") as fh:
+            json.dump(out, fh)
+        return path
+
+    def _score(self, params: OpParams, listener: OpListener) -> OpWorkflowRunnerResult:
+        model = self._scoring_data(self._load_model(params, listener))
+        names = [f.name for f in model.result_features]
+        reader_params = params.reader_params or None  # --read-location lands here
+        with listener.step(OpStep.Scoring):
+            if self.evaluator is not None:
+                scored, metrics = model.score_and_evaluate(self.evaluator,
+                                                           params=reader_params)
+            else:
+                scored, metrics = model.score(params=reader_params), None
+        with listener.step(OpStep.ResultsSaving):
+            path = self._write_scores(scored, names, params)
+        return OpWorkflowRunnerResult(OpWorkflowRunType.Score, score_location=path,
+                                      metrics=metrics, n_scored=len(scored))
+
+    def _streaming_score(self, params: OpParams, listener: OpListener
+                         ) -> OpWorkflowRunnerResult:
+        if self.streaming_reader is None:
+            raise ValueError("StreamingScore requires a streaming_reader")
+        model = self._load_model(params, listener)
+        names = [f.name for f in model.result_features]
+        fn = model.score_fn()
+        n_total, batch_idx = 0, 0
+        with listener.step(OpStep.Scoring):
+            for batch in self.streaming_reader.stream(model.raw_features,
+                                                      params.reader_params):
+                scored = fn(batch)
+                n_total += len(scored)
+                if params.write_location:
+                    os.makedirs(params.write_location, exist_ok=True)
+                    sub = OpParams.from_json(params.to_json())
+                    sub.write_location = os.path.join(params.write_location,
+                                                      f"batch_{batch_idx:05d}")
+                    self._write_scores(scored, names, sub)
+                batch_idx += 1
+        return OpWorkflowRunnerResult(OpWorkflowRunType.StreamingScore,
+                                      n_scored=n_total,
+                                      metrics={"batches": batch_idx})
+
+    def _features(self, params: OpParams, listener: OpListener) -> OpWorkflowRunnerResult:
+        """computeDataUpTo (OpWorkflowRunner.scala:190)."""
+        feats = self.features_to_compute or self.workflow.result_features
+        if not feats:
+            raise ValueError("Features run type needs features_to_compute or "
+                             "result features on the workflow")
+        if self.train_reader is not None:
+            self.workflow.set_reader(self.train_reader)
+        with listener.step(OpStep.FeatureEngineering):
+            data = self.workflow.compute_data_up_to(*feats)
+        path = None
+        if params.write_location:
+            os.makedirs(params.write_location, exist_ok=True)
+            path = os.path.join(params.write_location, "features.json")
+            data.to_pandas().to_json(path, orient="records")
+        return OpWorkflowRunnerResult(OpWorkflowRunType.Features,
+                                      score_location=path, n_scored=len(data))
+
+    def _evaluate(self, params: OpParams, listener: OpListener) -> OpWorkflowRunnerResult:
+        if self.evaluator is None:
+            raise ValueError("Evaluate requires an evaluator")
+        model = self._scoring_data(self._load_model(params, listener))
+        with listener.step(OpStep.Scoring):
+            metrics = model.evaluate(self.evaluator,
+                                     params=params.reader_params or None)
+        return OpWorkflowRunnerResult(OpWorkflowRunType.Evaluate, metrics=metrics)
+
+
+class OpApp:
+    """CLI application shell (OpApp.scala:49).
+
+    Subclass, implement ``runner()``, then ``MyApp().main(argv)``:
+
+        python -m my_app --run-type=train --model-location=/tmp/model \
+            --param-location=params.json
+    """
+
+    app_name: str = "OpApp"
+
+    def configure_runtime(self) -> None:
+        """SparkConf/Kryo analog: JAX device/mesh/distributed setup hook."""
+
+    def runner(self, args: argparse.Namespace) -> OpWorkflowRunner:
+        raise NotImplementedError
+
+    def parser(self) -> argparse.ArgumentParser:
+        p = argparse.ArgumentParser(prog=self.app_name)
+        p.add_argument("--run-type", required=True,
+                       choices=[t.value for t in OpWorkflowRunType])
+        p.add_argument("--param-location", help="OpParams JSON file")
+        p.add_argument("--model-location")
+        p.add_argument("--write-location")
+        p.add_argument("--metrics-location")
+        p.add_argument("--read-location", help="overrides readerParams.path")
+        p.add_argument("--collect-stage-metrics", action="store_true")
+        return p
+
+    def parse_params(self, args: argparse.Namespace) -> OpParams:
+        params = OpParams.load(args.param_location) if args.param_location else OpParams()
+        for attr in ("model_location", "write_location", "metrics_location"):
+            v = getattr(args, attr)
+            if v:
+                setattr(params, attr, v)
+        if args.read_location:
+            params.reader_params["path"] = args.read_location
+        if args.collect_stage_metrics:
+            params.collect_stage_metrics = True
+        return params
+
+    def main(self, argv: Optional[List[str]] = None) -> OpWorkflowRunnerResult:
+        """OpApp.main:178."""
+        args = self.parser().parse_args(argv)
+        self.configure_runtime()
+        params = self.parse_params(args)
+        runner = self.runner(args)
+        result = runner.run(OpWorkflowRunType(args.run_type), params)
+        print(f"{self.app_name}: {args.run_type} done "
+              f"(n_scored={result.n_scored}, model={result.model_location}, "
+              f"scores={result.score_location})")
+        return result
+
+
+class OpAppWithRunner(OpApp):
+    """OpApp whose runner is provided once (OpApp.scala:191)."""
+
+    def build_runner(self) -> OpWorkflowRunner:
+        raise NotImplementedError
+
+    def runner(self, args: argparse.Namespace) -> OpWorkflowRunner:
+        return self.build_runner()

@@ -1,1 +1,21 @@
-"""Package."""
+"""testkit — random typed-data generators + test fixtures + contract specs.
+
+Reference parity (testkit/src/main/scala/com/salesforce/op/{testkit,test}/):
+- random generators for every FeatureType with null-probability control
+  (``RandomReal:45``, ``RandomText:49``, ``RandomList/Map/Set/Binary/
+  Integral/Vector``; distributions normal/poisson/uniform),
+- ``TestFeatureBuilder:50`` — build (Dataset, Feature handles) from inline
+  values,
+- ``FeatureAsserts.assertFeature:63`` + the ``OpTransformerSpec`` /
+  ``OpEstimatorSpec`` contract checks (features/.../test/OpTransformerSpec.scala:53):
+  batch ``transform`` ≡ row-wise ``transform_row``, serialization
+  round-trip, output metadata sanity.
+"""
+from .random_data import (RandomBinary, RandomData, RandomDate, RandomDateList,
+                          RandomGeolocation, RandomIntegral, RandomList, RandomMap,
+                          RandomMultiPickList, RandomReal, RandomText, RandomVector)
+from .builder import TestFeatureBuilder
+from .asserts import (assert_estimator_contract, assert_feature,
+                      assert_transformer_contract)
+
+__all__ = [n for n in dir() if not n.startswith("_")]

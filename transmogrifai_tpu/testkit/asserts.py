@@ -1,0 +1,120 @@
+"""Contract-spec assertions — the OpTransformerSpec / OpEstimatorSpec analog.
+
+The reference's most distinctive testing idea (SURVEY §4): every stage test
+asserts the same uniform contract.  Here:
+
+- batch ``transform_columns`` ≡ row-wise ``transform_row`` on every row,
+- stage serialization round-trip (encode -> decode -> same outputs),
+- fitted-model identity (uid/inputs/outputs preserved through ``fit``),
+- feature lineage sanity (``assert_feature``).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Type
+
+import numpy as np
+
+from .. import types as T
+from ..columns import (Column, Dataset, NumericColumn, ObjectColumn,
+                       PredictionColumn, VectorColumn)
+from ..features.feature import Feature
+from ..stages.base import Estimator, Model, PipelineStage, Transformer
+
+
+def _scalar_eq(a: T.FeatureType, b: T.FeatureType) -> bool:
+    va, vb = a.value, b.value
+    if isinstance(va, float) and isinstance(vb, float):
+        return (np.isnan(va) and np.isnan(vb)) or abs(va - vb) < 1e-5
+    if isinstance(va, np.ndarray) or isinstance(vb, np.ndarray):
+        return np.allclose(np.asarray(va, dtype=float), np.asarray(vb, dtype=float),
+                           atol=1e-5)
+    return va == vb
+
+
+def _columns_close(a: Column, b: Column) -> bool:
+    if isinstance(a, NumericColumn) and isinstance(b, NumericColumn):
+        return (np.array_equal(a.mask, b.mask)
+                and np.allclose(a.values[a.mask], b.values[b.mask], atol=1e-5))
+    if isinstance(a, VectorColumn) and isinstance(b, VectorColumn):
+        return a.values.shape == b.values.shape and np.allclose(a.values, b.values,
+                                                                atol=1e-5)
+    if isinstance(a, PredictionColumn) and isinstance(b, PredictionColumn):
+        return np.allclose(a.prediction, b.prediction, atol=1e-5)
+    return all(_scalar_eq(a.to_scalar(i), b.to_scalar(i)) for i in range(len(a)))
+
+
+def assert_batch_row_parity(stage: Transformer, ds: Dataset,
+                            check_rows: Optional[int] = 10) -> None:
+    """Batch transform ≡ row-wise transform (OpTransformerSpec's core check)."""
+    batch = stage.transform_dataset(ds)
+    n = len(batch) if check_rows is None else min(check_rows, len(batch))
+    for i in range(n):
+        row = {f.name: ds[f.name].to_scalar(i) for f in stage.inputs}
+        row_out = stage.transform_row(row)
+        batch_out = batch.to_scalar(i)
+        assert _scalar_eq(batch_out, row_out), (
+            f"batch≠row at {i}: batch={batch_out.value!r} row={row_out.value!r} "
+            f"for stage {stage}")
+
+
+def assert_serialization_roundtrip(stage: PipelineStage, ds: Dataset) -> None:
+    """encode -> decode -> identical transform output."""
+    from ..workflow.serialization import _decode_stage, _encode_stage
+
+    arrays: dict = {}
+    encoded = _encode_stage(stage, arrays)
+    restored = _decode_stage(encoded, arrays)
+    restored.inputs = stage.inputs
+    restored._outputs = stage._outputs
+    assert restored.uid == stage.uid
+    assert type(restored) is type(stage)
+    if isinstance(stage, Transformer):
+        a = stage.transform_dataset(ds)
+        b = restored.transform_dataset(ds)
+        assert _columns_close(a, b), f"serialization changed outputs of {stage}"
+
+
+def assert_transformer_contract(stage: Transformer, ds: Dataset,
+                                expected: Optional[Sequence] = None,
+                                check_rows: Optional[int] = 10) -> Column:
+    """The OpTransformerSpec bundle: output values (optional), batch≡row,
+    serialization round-trip.  Returns the batch output column."""
+    out = stage.transform_dataset(ds)
+    assert len(out) == len(ds), "output row count must match input"
+    if expected is not None:
+        for i, e in enumerate(expected):
+            got = out.to_scalar(i)
+            want = e if isinstance(e, T.FeatureType) else T.make(stage.output_type, e)
+            assert _scalar_eq(got, want), f"row {i}: got {got.value!r} want {want.value!r}"
+    assert_batch_row_parity(stage, ds, check_rows)
+    assert_serialization_roundtrip(stage, ds)
+    return out
+
+
+def assert_estimator_contract(stage: Estimator, ds: Dataset,
+                              expected: Optional[Sequence] = None,
+                              check_rows: Optional[int] = 10) -> Column:
+    """The OpEstimatorSpec bundle: fit -> model identity + transformer contract."""
+    model = stage.fit(ds)
+    assert isinstance(model, Model), f"fit must return a Model, got {type(model)}"
+    assert model.uid == stage.uid, "fitted model must keep the estimator uid"
+    assert model.inputs == stage.inputs
+    return assert_transformer_contract(model, ds, expected, check_rows)
+
+
+def assert_feature(f: Feature, name: Optional[str] = None,
+                   ftype: Optional[Type[T.FeatureType]] = None,
+                   is_response: Optional[bool] = None,
+                   origin_ops: Optional[Sequence[str]] = None) -> None:
+    """FeatureAsserts.assertFeature (testkit/.../test/FeatureAsserts.scala:63)."""
+    assert f.uid, "feature must have a uid"
+    if name is not None:
+        assert f.name == name, f"name {f.name!r} != {name!r}"
+    if ftype is not None:
+        assert f.ftype is ftype, f"type {f.ftype} != {ftype}"
+    if is_response is not None:
+        assert f.is_response == is_response
+    if origin_ops is not None:
+        hist = f.history()
+        assert set(origin_ops) <= set(hist.stages), \
+            f"history {hist.stages} missing {origin_ops}"

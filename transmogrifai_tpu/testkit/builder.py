@@ -1,0 +1,49 @@
+"""TestFeatureBuilder — (Dataset, Feature handles) from inline values
+(testkit/.../test/TestFeatureBuilder.scala:50)."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Type
+
+import numpy as np
+
+from .. import types as T
+from ..columns import Dataset, column_from_scalars
+from ..features.builder import FeatureBuilder
+from ..features.feature import Feature
+
+
+class TestFeatureBuilder:
+    """Build a Dataset plus raw Feature handles from inline columns.
+
+    >>> ds, (x, label) = TestFeatureBuilder.of(
+    ...     ("x", T.Real, [1.0, None, 3.0]),
+    ...     ("label", T.RealNN, [0.0, 1.0, 0.0]), response="label")
+    """
+
+    @staticmethod
+    def of(*columns: Tuple[str, Type[T.FeatureType], Sequence[Any]],
+           response: Optional[str] = None,
+           key: Optional[Sequence[str]] = None) -> Tuple[Dataset, List[Feature]]:
+        if not columns:
+            raise ValueError("At least one column is required")
+        n = len(columns[0][2])
+        cols: Dict[str, Any] = {}
+        feats: List[Feature] = []
+        for name, ftype, values in columns:
+            if len(values) != n:
+                raise ValueError(f"Column {name!r} has {len(values)} rows, expected {n}")
+            scalars = [v if isinstance(v, T.FeatureType) else T.make(ftype, v)
+                       for v in values]
+            cols[name] = column_from_scalars(ftype, scalars)
+            fb = FeatureBuilder(name, ftype).from_field()
+            feats.append(fb.as_response() if name == response else fb.as_predictor())
+        keys = np.array([str(k) for k in (key if key is not None else range(n))],
+                        dtype=object)
+        return Dataset(cols, keys), feats
+
+    @staticmethod
+    def random(n: int, *gens: Tuple[str, "object"],
+               response: Optional[str] = None) -> Tuple[Dataset, List[Feature]]:
+        """Build from (name, RandomData generator) pairs."""
+        cols = [(name, gen.ftype, gen.take(n)) for name, gen in gens]
+        return TestFeatureBuilder.of(*cols, response=response)

@@ -1,0 +1,197 @@
+"""Random typed-data generators (testkit/.../testkit/Random*.scala).
+
+Every generator produces FeatureType instances with a controllable
+``prob_null``; ``take(n)`` is deterministic given the generator's seed.
+"""
+from __future__ import annotations
+
+import string
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import types as T
+
+
+class RandomData:
+    """Base generator (RandomData.scala:44)."""
+
+    def __init__(self, ftype, prob_null: float = 0.0, seed: int = 42):
+        self.ftype = ftype
+        self.prob_null = float(prob_null)
+        self.seed = int(seed)
+
+    def with_prob_null(self, p: float) -> "RandomData":
+        self.prob_null = float(p)
+        return self
+
+    def _value(self, rng: np.random.Generator) -> Any:
+        raise NotImplementedError
+
+    def take(self, n: int) -> List[T.FeatureType]:
+        rng = np.random.default_rng(self.seed)
+        out = []
+        for _ in range(n):
+            if self.prob_null > 0 and rng.random() < self.prob_null:
+                out.append(T.default_of(self.ftype))
+            else:
+                out.append(T.make(self.ftype, self._value(rng)))
+        return out
+
+    def limit(self, n: int) -> List[T.FeatureType]:  # reference API alias
+        return self.take(n)
+
+
+class RandomReal(RandomData):
+    """Normal / uniform / poisson reals (RandomReal.scala:45)."""
+
+    def __init__(self, ftype=T.Real, distribution: str = "normal",
+                 mean: float = 0.0, sigma: float = 1.0, low: float = 0.0,
+                 high: float = 1.0, lam: float = 1.0, prob_null: float = 0.0,
+                 seed: int = 42):
+        super().__init__(ftype, prob_null, seed)
+        assert distribution in ("normal", "uniform", "poisson")
+        self.distribution = distribution
+        self.mean, self.sigma, self.low, self.high, self.lam = mean, sigma, low, high, lam
+
+    @classmethod
+    def normal(cls, mean: float = 0.0, sigma: float = 1.0, **kw) -> "RandomReal":
+        return cls(distribution="normal", mean=mean, sigma=sigma, **kw)
+
+    @classmethod
+    def uniform(cls, low: float = 0.0, high: float = 1.0, **kw) -> "RandomReal":
+        return cls(distribution="uniform", low=low, high=high, **kw)
+
+    @classmethod
+    def poisson(cls, lam: float = 1.0, **kw) -> "RandomReal":
+        return cls(distribution="poisson", lam=lam, **kw)
+
+    def _value(self, rng):
+        if self.distribution == "normal":
+            return float(rng.normal(self.mean, self.sigma))
+        if self.distribution == "uniform":
+            return float(rng.uniform(self.low, self.high))
+        return float(rng.poisson(self.lam))
+
+
+class RandomIntegral(RandomData):
+    def __init__(self, low: int = 0, high: int = 100, prob_null: float = 0.0,
+                 seed: int = 42, ftype=T.Integral):
+        super().__init__(ftype, prob_null, seed)
+        self.low, self.high = int(low), int(high)
+
+    def _value(self, rng):
+        return int(rng.integers(self.low, self.high))
+
+
+class RandomBinary(RandomData):
+    def __init__(self, prob_true: float = 0.5, prob_null: float = 0.0, seed: int = 42):
+        super().__init__(T.Binary, prob_null, seed)
+        self.prob_true = float(prob_true)
+
+    def _value(self, rng):
+        return bool(rng.random() < self.prob_true)
+
+
+class RandomDate(RandomIntegral):
+    """Epoch-millis dates in a range (RandomIntegral over time)."""
+
+    def __init__(self, start_ms: int = 0, end_ms: int = 1_600_000_000_000,
+                 prob_null: float = 0.0, seed: int = 42):
+        super().__init__(start_ms, end_ms, prob_null, seed, ftype=T.Date)
+
+
+class RandomText(RandomData):
+    """Random words / picklist domains / emails / urls (RandomText.scala:49)."""
+
+    def __init__(self, ftype=T.Text, domain: Optional[Sequence[str]] = None,
+                 n_words: int = 3, word_len: int = 6, prob_null: float = 0.0,
+                 seed: int = 42):
+        super().__init__(ftype, prob_null, seed)
+        self.domain = list(domain) if domain is not None else None
+        self.n_words, self.word_len = n_words, word_len
+
+    @classmethod
+    def of(cls, domain: Sequence[str], ftype=T.PickList, **kw) -> "RandomText":
+        return cls(ftype=ftype, domain=domain, **kw)
+
+    @classmethod
+    def emails(cls, host: str = "example.com", **kw) -> "RandomText":
+        gen = cls(ftype=T.Email, **kw)
+        gen._email_host = host
+        return gen
+
+    def _word(self, rng) -> str:
+        letters = rng.integers(0, 26, self.word_len)
+        return "".join(string.ascii_lowercase[i] for i in letters)
+
+    def _value(self, rng):
+        if getattr(self, "_email_host", None):
+            return f"{self._word(rng)}@{self._email_host}"
+        if self.domain is not None:
+            return self.domain[int(rng.integers(0, len(self.domain)))]
+        return " ".join(self._word(rng) for _ in range(self.n_words))
+
+
+class RandomList(RandomData):
+    def __init__(self, element: RandomData, min_len: int = 0, max_len: int = 5,
+                 ftype=T.TextList, prob_null: float = 0.0, seed: int = 42):
+        super().__init__(ftype, prob_null, seed)
+        self.element = element
+        self.min_len, self.max_len = min_len, max_len
+
+    def _value(self, rng):
+        k = int(rng.integers(self.min_len, self.max_len + 1))
+        return [self.element._value(rng) for _ in range(k)]
+
+
+class RandomDateList(RandomList):
+    def __init__(self, start_ms: int = 0, end_ms: int = 1_600_000_000_000,
+                 min_len: int = 0, max_len: int = 5, prob_null: float = 0.0,
+                 seed: int = 42):
+        super().__init__(RandomDate(start_ms, end_ms), min_len, max_len,
+                         ftype=T.DateList, prob_null=prob_null, seed=seed)
+
+
+class RandomMultiPickList(RandomData):
+    def __init__(self, domain: Sequence[str], min_len: int = 0, max_len: int = 3,
+                 prob_null: float = 0.0, seed: int = 42):
+        super().__init__(T.MultiPickList, prob_null, seed)
+        self.domain = list(domain)
+        self.min_len, self.max_len = min_len, max_len
+
+    def _value(self, rng):
+        k = int(rng.integers(self.min_len, min(self.max_len, len(self.domain)) + 1))
+        return set(rng.choice(self.domain, size=k, replace=False).tolist())
+
+
+class RandomMap(RandomData):
+    def __init__(self, value_gen: RandomData, keys: Sequence[str],
+                 ftype=T.TextMap, prob_missing_key: float = 0.2,
+                 prob_null: float = 0.0, seed: int = 42):
+        super().__init__(ftype, prob_null, seed)
+        self.value_gen = value_gen
+        self.keys = list(keys)
+        self.prob_missing_key = float(prob_missing_key)
+
+    def _value(self, rng):
+        return {k: self.value_gen._value(rng) for k in self.keys
+                if rng.random() >= self.prob_missing_key}
+
+
+class RandomGeolocation(RandomData):
+    def __init__(self, prob_null: float = 0.0, seed: int = 42):
+        super().__init__(T.Geolocation, prob_null, seed)
+
+    def _value(self, rng):
+        return [float(rng.uniform(-90, 90)), float(rng.uniform(-180, 180)),
+                float(rng.integers(1, 10))]
+
+
+class RandomVector(RandomData):
+    def __init__(self, dim: int = 8, prob_null: float = 0.0, seed: int = 42):
+        super().__init__(T.OPVector, prob_null, seed)
+        self.dim = int(dim)
+
+    def _value(self, rng):
+        return rng.standard_normal(self.dim).astype(np.float32)

@@ -67,13 +67,26 @@ def _apply_layer_transforms(ds: Dataset, transformers: Sequence[Transformer]) ->
     new_cols = {}
     for t in transformers:
         out_feats = t.get_outputs()
-        col = t.transform_dataset(ds)
+        with _maybe_time(t, "transform", len(ds)):
+            col = t.transform_dataset(ds)
         if t.n_outputs == 1:
             new_cols[out_feats[0].name] = col
         else:
             for f, c in zip(out_feats, col):
                 new_cols[f.name] = c
     return ds.with_columns(new_cols)
+
+
+def _maybe_time(stage, phase: str, n_rows: int):
+    """Report into the installed OpListener, if any (OpSparkListener analog)."""
+    from ..utils.listener import current_listener
+
+    listener = current_listener()
+    if listener is None:
+        import contextlib
+
+        return contextlib.nullcontext()
+    return listener.time_stage(stage, phase, n_rows)
 
 
 def fit_and_transform_dag(dag: List[Layer], train: Dataset,
@@ -96,7 +109,8 @@ def fit_and_transform_dag(dag: List[Layer], train: Dataset,
                 transformers.append(model)
                 fitted.append(model)
             elif isinstance(stage, Estimator):
-                model = stage.fit(train)
+                with _maybe_time(stage, "fit", len(train)):
+                    model = stage.fit(train)
                 transformers.append(model)
                 fitted.append(model)
             elif isinstance(stage, Transformer):

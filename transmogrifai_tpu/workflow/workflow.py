@@ -176,10 +176,12 @@ class OpWorkflow(OpWorkflowCore):
         self.raw_features = keep
 
     # ---- partial materialization (OpWorkflow.scala:498) --------------------
-    def compute_data_up_to(self, feature: Feature,
+    def compute_data_up_to(self, *features: Feature,
                            params: Optional[Dict[str, Any]] = None) -> Dataset:
-        """Fit/transform only the sub-DAG needed for ``feature``."""
-        sub = dag_util.compute_dag([feature])
+        """Fit/transform only the sub-DAG needed for the given feature(s)."""
+        if not features:
+            raise ValueError("compute_data_up_to needs at least one feature")
+        sub = dag_util.compute_dag(list(features))
         data = self._generate_raw_data(params)
         fitted = dag_util.fit_and_transform_dag(sub, data)
         return fitted.train
