@@ -1,4 +1,4 @@
-"""Benchmark: ModelSelector sweep throughput (models trained / second).
+"""Benchmark: the REAL ModelSelector default sweep (models trained / second).
 
 The reference's hot path is the ModelSelector CV sweep — numFolds x models x
 param-grids individual Spark fits throttled by an 8-thread JVM pool
@@ -6,18 +6,26 @@ param-grids individual Spark fits throttled by an 8-thread JVM pool
 models with 3-fold CV).  BASELINE.md sets the target: >=30x wall-clock vs
 32-core Spark-local on a 48-model 3-fold Titanic-style sweep.
 
-This benchmark times the TPU-native equivalent: the full fold x grid
-logistic sweep as one compiled XLA program on real Titanic features
-(Transmogrifier-style vectorization), reporting models-trained/sec.
+This benchmark times the framework's own code path end-to-end: Titanic
+features through the framework's vectorizers, then
+``BinaryClassificationModelSelector`` with the REFERENCE DEFAULT grid —
+LR (8 grids) + RandomForest (6) + XGBoost (2) = 16 candidates x 3 folds =
+48 model fits — through ``ModelSelector.fit``, including splitter holdout,
+DataBalancer preparation, the batched fold x grid XLA sweeps, final refit
+and train+holdout evaluation.
+
+Backend handling: the experimental TPU platform can fail to initialize in
+some environments; the bench falls back to CPU and RECORDS the reason
+instead of crashing (round-1 failure mode).
 
 Baseline constant: the reference publishes no wall-clock numbers
 (BASELINE.md: "Reference wall-clock numbers must be measured locally") and
 Spark is not installed in this image, so ``vs_baseline`` divides by a
 DELIBERATELY GENEROUS estimate of Spark-local throughput: 8 concurrent JVM
-threads (ValidatorParamDefaults.Parallelism=8) each completing a Titanic-scale
-MLlib LR fit every 2s including job-scheduling overhead => 4 models/s.  Treat
-the ratio as an order-of-magnitude indicator until a measured Spark number
-replaces the constant.
+threads (ValidatorParamDefaults.Parallelism=8) each completing a
+Titanic-scale MLlib fit every 2s including job-scheduling overhead =>
+4 models/s.  Treat the ratio as an order-of-magnitude indicator until a
+measured Spark number replaces the constant.
 """
 from __future__ import annotations
 
@@ -30,6 +38,25 @@ import numpy as np
 
 BASELINE_MODELS_PER_SEC = 4.0  # generous Spark-local 8-thread estimate (see above)
 TITANIC = "/root/reference/test-data/PassengerDataAllWithHeader.csv"
+
+
+def init_backend():
+    """Initialize JAX robustly; returns (platform, fallback_reason|None).
+
+    Round-1 failure mode: the experimental axon TPU plugin either raises
+    ("Unable to initialize backend") or HANGS when the tunnel is absent.
+    utils/backend.py probes in a subprocess with a timeout and falls back to
+    CPU with a recorded reason — the bench always produces a JSON line.
+    """
+    try:
+        from transmogrifai_tpu.utils.backend import ensure_backend
+
+        return ensure_backend()
+    except Exception as e:  # pragma: no cover - nothing works
+        print(json.dumps({"metric": "selector_sweep_models_per_sec",
+                          "value": 0.0, "unit": "models/s", "vs_baseline": 0.0,
+                          "error": f"no backend: {e}"}))
+        sys.exit(0)
 
 
 def titanic_arrays():
@@ -85,51 +112,52 @@ def titanic_arrays():
     return np.asarray(X, np.float32), y
 
 
-def main():
-    import jax
+def make_selector():
+    from transmogrifai_tpu.impl.selector.factories import (
+        BinaryClassificationModelSelector)
 
-    from transmogrifai_tpu.parallel.sweep import (
-        eval_logistic_grid_folds, fit_logistic_grid_folds, make_fold_weights)
+    return BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3, seed=42)
+
+
+def main():
+    platform, fallback = init_backend()
 
     X, y = titanic_arrays()
-    n_folds, grid_size = 3, 48  # the reference Titanic-class sweep (BASELINE.md)
-    l2_grid = np.logspace(-4, 1, grid_size).astype(np.float32)
-    train_w, val_w = make_fold_weights(len(y), n_folds, stratify_labels=y)
 
-    import jax.numpy as jnp
-    Xd = jnp.asarray(X, jnp.float32)
-    yd = jnp.asarray(y, jnp.float32)
-    tw = jnp.asarray(train_w)
-    vw = jnp.asarray(val_w)
-    l2 = jnp.asarray(l2_grid)
+    # the sweep size of the REFERENCE default grid: LR 8 + RF 6 + XGB 2
+    sel = make_selector()
+    n_grids = sum(len(g) for _, g in sel.models)
+    n_models = sel.validator.num_folds * n_grids
 
-    # warmup / compile
-    coef, intercept = fit_logistic_grid_folds(Xd, yd, tw, l2, max_iter=30)
-    err = eval_logistic_grid_folds(Xd, yd, vw, coef, intercept)
-    np.asarray(err)
+    # warmup: compiles every kernel in the sweep (cached thereafter)
+    t_first = time.perf_counter()
+    sel.find_best_estimator(X, y)
+    warm = time.perf_counter() - t_first
 
-    reps = 5
+    reps = 3
     t0 = time.perf_counter()
-    for _ in range(reps):
-        coef, intercept = fit_logistic_grid_folds(Xd, yd, tw, l2, max_iter=30)
-        err = eval_logistic_grid_folds(Xd, yd, vw, coef, intercept)
-        # device->host fetch: the selector needs fold metrics on host to pick
-        # the winner, and block_until_ready alone does not guarantee
-        # completion on the experimental axon platform.
-        errs_host = np.asarray(err)
+    for r in range(reps):
+        sel2 = make_selector()
+        sel2.validator.seed = 42 + r  # new folds; same compiled kernels
+        _, _, summary = sel2.find_best_estimator(X, y)
+        assert summary.best.metric_value == summary.best.metric_value  # finite
     dt = (time.perf_counter() - t0) / reps
 
-    models_trained = n_folds * grid_size
-    models_per_sec = models_trained / dt
-    errs = errs_host.mean(axis=0)
-    assert np.all(np.isfinite(errs)), "sweep produced non-finite CV errors"
-
-    print(json.dumps({
+    models_per_sec = n_models / dt
+    out = {
         "metric": "selector_sweep_models_per_sec",
         "value": round(models_per_sec, 2),
         "unit": "models/s",
         "vs_baseline": round(models_per_sec / BASELINE_MODELS_PER_SEC, 2),
-    }))
+        "platform": platform,
+        "sweep": f"{n_grids} grids x {sel.validator.num_folds} folds (LR+RF+XGB defaults)",
+        "warmup_s": round(warm, 2),
+        "steady_s": round(dt, 2),
+    }
+    if fallback:
+        out["backend_fallback"] = fallback
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

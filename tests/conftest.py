@@ -18,6 +18,7 @@ import jax
 # start (before this conftest runs); flip back to the virtual CPU mesh.
 jax.config.update("jax_platforms", "cpu")
 
+
 import numpy as np
 import pandas as pd
 import pytest

@@ -76,16 +76,19 @@ class OpLogisticRegression(PredictorEstimator):
         reg, alpha = p["reg_param"], p["elastic_net_param"]
         l1 = reg * alpha
         l2 = reg * (1.0 - alpha)
-        Xd = jnp.asarray(X, jnp.float32)
-        yd = jnp.asarray(y, jnp.float32)
-        twd = jnp.asarray(train_w, jnp.float32)
+        from ...parallel.mesh import replicate_input, shard_candidates
+
+        Xd = replicate_input(np.asarray(X, np.float32))
+        yd = replicate_input(np.asarray(y, np.float32))
+        twd = replicate_input(np.asarray(train_w, np.float32))
         F, G = train_w.shape[0], len(grids)
         num_classes = int(np.max(np.asarray(y))) + 1 if len(y) else 2
         multinomial = base_family == "multinomial" or (base_family == "auto"
                                                        and num_classes > 2)
         if multinomial:
-            fitres = L.fit_softmax_grid_folds(Xd, yd, twd, jnp.asarray(l1),
-                                              jnp.asarray(l2),
+            l1d, _ = shard_candidates(l1, fill=0.0)
+            l2d, _ = shard_candidates(l2, fill=1.0)
+            fitres = L.fit_softmax_grid_folds(Xd, yd, twd, l1d, l2d,
                                               num_classes=max(num_classes, 2),
                                               max_iter=base_mi, fit_intercept=base_fi)
             raw, prob, pred = L.predict_softmax_grid(Xd, fitres.coef, fitres.intercept)
@@ -99,17 +102,20 @@ class OpLogisticRegression(PredictorEstimator):
         coef = np.zeros((F, G, d), np.float32)
         intercept = np.zeros((F, G, 1), np.float32)
         if len(newton_idx):
+            l2d, gn = shard_candidates(l2[newton_idx], fill=1.0)
             fitn = L.fit_logistic_grid_folds_newton(
-                Xd, yd, twd, jnp.asarray(l2[newton_idx]),
+                Xd, yd, twd, l2d,
                 max_iter=min(max(base_mi // 4, 10), 50), fit_intercept=base_fi)
-            coef[:, newton_idx] = np.asarray(fitn.coef)
-            intercept[:, newton_idx] = np.asarray(fitn.intercept)
+            coef[:, newton_idx] = np.asarray(fitn.coef)[:, :gn]
+            intercept[:, newton_idx] = np.asarray(fitn.intercept)[:, :gn]
         if len(fista_idx):
+            l1d, gf = shard_candidates(l1[fista_idx], fill=0.0)
+            l2d, _ = shard_candidates(l2[fista_idx], fill=1.0)
             fitf = L.fit_logistic_grid_folds_fista(
-                Xd, yd, twd, jnp.asarray(l1[fista_idx]), jnp.asarray(l2[fista_idx]),
+                Xd, yd, twd, l1d, l2d,
                 max_iter=max(base_mi, 200), fit_intercept=base_fi)
-            coef[:, fista_idx] = np.asarray(fitf.coef)
-            intercept[:, fista_idx] = np.asarray(fitf.intercept)
+            coef[:, fista_idx] = np.asarray(fitf.coef)[:, :gf]
+            intercept[:, fista_idx] = np.asarray(fitf.intercept)[:, :gf]
         raw, prob, pred = L.predict_binary_logistic_grid(
             Xd, jnp.asarray(coef), jnp.asarray(intercept))
         raw, prob, pred = np.asarray(raw), np.asarray(prob), np.asarray(pred)

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -39,12 +40,17 @@ class OpLinearSVC(PredictorEstimator):
         return {"coef": np.asarray(fit.coef), "intercept": np.asarray(fit.intercept)}
 
     def fit_grid_folds(self, X, y, train_w, grids):
-        l2s = jnp.asarray(self._grid_param_arrays(grids, ("reg_param",))["reg_param"])
-        Xd = jnp.asarray(X, jnp.float32)
-        yd = jnp.asarray(y, jnp.float32)
-        fits = L.fit_svc_grid_folds(Xd, yd, jnp.asarray(train_w, jnp.float32), l2s,
+        from ...parallel.mesh import replicate_input, shard_candidates
+
+        l2s, g = shard_candidates(
+            self._grid_param_arrays(grids, ("reg_param",))["reg_param"], fill=1.0)
+        Xd = replicate_input(np.asarray(X, np.float32))
+        yd = replicate_input(np.asarray(y, np.float32))
+        fits = L.fit_svc_grid_folds(Xd, yd, replicate_input(np.asarray(train_w, np.float32)),
+                                    l2s,
                                     max_iter=max(int(self.get_param("max_iter", 100)), 200),
                                     fit_intercept=bool(self.get_param("fit_intercept", True)))
+        fits = jax.tree.map(lambda a: a[:, :g], fits)
         z = np.asarray(jnp.einsum("nd,fgd->fgn", Xd, fits.coef) + fits.intercept[..., :1])
         pred = (z >= 0.0).astype(np.float32)
         raw = np.stack([-z, z], axis=-1)

@@ -21,9 +21,12 @@ import numpy as np
 
 from ...ops import trees as Tr
 from ..selector.predictor import PredictorEstimator
-from ..trees_common import (TreeParamsMixin, boosted_grid_folds as _boosted_grid_folds,
+from ..trees_common import (DEFAULT_MAX_FRONTIER, DEFAULT_MAX_FRONTIER_BOOSTED,
+                            TreeParamsMixin,
+                            boosted_grid_folds as _boosted_grid_folds,
                             forest_grid_folds as _forest_grid_folds,
-                            gbt_boost_params, xgb_boost_params)
+                            gbt_boost_params, tree_from_params, tree_params,
+                            xgb_boost_params)
 
 
 def _as_f32(x):
@@ -38,6 +41,34 @@ class _TreeClassifierBase(TreeParamsMixin, PredictorEstimator):
 
     def _n_classes(self, y: np.ndarray) -> int:
         return max(int(np.max(y)) + 1 if len(y) else 2, 2)
+
+    @staticmethod
+    def _class_grads(y: np.ndarray, k: int) -> np.ndarray:
+        """Gradient channels for forest growth: binary uses the 1-channel
+        variance kernel (variance impurity == gini/2 for 0/1 labels, so the
+        splits are identical and the leaf mean is p(class=1)); multiclass
+        uses -onehot (gini-equivalent, class-distribution leaves)."""
+        if k == 2:
+            return -np.asarray(y, np.float32)[:, None]
+        return -np.eye(k, dtype=np.float32)[np.asarray(y, np.int64)]
+
+    @staticmethod
+    def _expand_binary_leaves(forest, k: int):
+        """[..., 1] class-1 proportion leaves -> [..., 2] distribution."""
+        if k != 2:
+            return forest
+        v = forest.leaf_val
+        return forest._replace(leaf_val=jnp.concatenate([1.0 - v, v], axis=-1))
+
+    #: boosted subclasses override with DEFAULT_MAX_FRONTIER_BOOSTED so the
+    #: refit grows the same beam the CV sweep measured
+    _max_frontier_default = DEFAULT_MAX_FRONTIER
+
+    def _frontier(self, n: int, depth: int, mcw: float, h_max: float) -> int:
+        return Tr.frontier_cap(
+            n, depth, mcw, h_max=h_max,
+            max_frontier=int(self.get_param("max_frontier",
+                                            self._max_frontier_default)))
 
 
 class OpRandomForestClassifier(_TreeClassifierBase):
@@ -63,22 +94,21 @@ class OpRandomForestClassifier(_TreeClassifierBase):
         n_trees = int(self.get_param("num_trees", 20))
         rng = np.random.default_rng(int(self.get_param("seed", 42)))
         Xb, edges = Tr.quantize(X, n_bins)
-        Y = np.eye(k, dtype=np.float32)[np.asarray(y, np.int64)]
+        G = self._class_grads(y, k)
         sw = np.ones(n, np.float32) if w is None else np.asarray(w, np.float32)
         wt = Tr.bootstrap_weights(n, n_trees, rng,
                                   rate=float(self.get_param("subsampling_rate", 1.0))
                                   ) * sw[None, :]
         fms = Tr.feature_masks(d, n_trees, self._subset_frac(d), rng)
-        forest = Tr.fit_forest(jnp.asarray(Xb), jnp.asarray(-Y), _as_f32(np.ones(n)),
+        mcw = float(self.get_param("min_instances_per_node", 1))
+        forest = Tr.fit_forest(jnp.asarray(Xb), jnp.asarray(G), _as_f32(np.ones(n)),
                                jnp.asarray(wt), jnp.asarray(fms),
                                max_depth=depth, n_bins=n_bins,
-                               min_child_weight=float(
-                                   self.get_param("min_instances_per_node", 1)))
-        return {"split_feat": np.asarray(forest.split_feat),
-                "split_bin": np.asarray(forest.split_bin),
-                "leaf_val": np.asarray(forest.leaf_val),
-                "edges": edges, "max_depth": depth, "num_classes": k,
-                "num_trees": n_trees}
+                               frontier=self._frontier(n, depth, mcw, 1.0),
+                               min_child_weight=mcw)
+        forest = self._expand_binary_leaves(forest, k)
+        return tree_params(forest, edges=edges, max_depth=depth, num_classes=k,
+                           num_trees=n_trees)
 
     @staticmethod
     def _dist_to_preds(dist: np.ndarray, num_trees: int
@@ -92,11 +122,9 @@ class OpRandomForestClassifier(_TreeClassifierBase):
     def predict_arrays(cls, params: Dict[str, Any], X: np.ndarray
                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         Xb = jnp.asarray(Tr.bin_with_edges(X, params["edges"]))
-        forest = Tr.Tree(jnp.asarray(params["split_feat"]),
-                         jnp.asarray(params["split_bin"]),
-                         jnp.asarray(params["leaf_val"]))
-        dist = np.asarray(Tr.predict_forest(Xb, forest, params["max_depth"]))
-        return cls._dist_to_preds(dist, params["num_trees"])
+        forest = tree_from_params(params)
+        dist = np.asarray(Tr.predict_forest(Xb, forest, int(params["max_depth"])))
+        return cls._dist_to_preds(dist, int(params["num_trees"]))
 
     def fit_grid_folds(self, X, y, train_w, grids):
         """Batched fold x grid forest sweep (one chunked launch per
@@ -132,21 +160,23 @@ class OpDecisionTreeClassifier(OpRandomForestClassifier):
         n_bins = int(self.get_param("max_bins", 32))
         depth = int(self.get_param("max_depth", 5))
         Xb, edges = Tr.quantize(X, n_bins)
-        Y = np.eye(k, dtype=np.float32)[np.asarray(y, np.int64)]
+        G = self._class_grads(y, k)
         sw = np.ones(n, np.float32) if w is None else np.asarray(w, np.float32)
-        forest = Tr.fit_forest(jnp.asarray(Xb), jnp.asarray(-Y), _as_f32(np.ones(n)),
+        mcw = float(self.get_param("min_instances_per_node", 1))
+        forest = Tr.fit_forest(jnp.asarray(Xb), jnp.asarray(G), _as_f32(np.ones(n)),
                                jnp.asarray(sw[None, :]), jnp.asarray(np.ones((1, d), np.float32)),
                                max_depth=depth, n_bins=n_bins,
-                               min_child_weight=float(
-                                   self.get_param("min_instances_per_node", 1)))
-        return {"split_feat": np.asarray(forest.split_feat),
-                "split_bin": np.asarray(forest.split_bin),
-                "leaf_val": np.asarray(forest.leaf_val),
-                "edges": edges, "max_depth": depth, "num_classes": k, "num_trees": 1}
+                               frontier=self._frontier(n, depth, mcw, 1.0),
+                               min_child_weight=mcw)
+        forest = self._expand_binary_leaves(forest, k)
+        return tree_params(forest, edges=edges, max_depth=depth, num_classes=k,
+                           num_trees=1)
 
 
 class _BoostedClassifierBase(_TreeClassifierBase):
     """Shared boosting fit: binary logistic or multiclass softmax."""
+
+    _max_frontier_default = DEFAULT_MAX_FRONTIER_BOOSTED
 
     def _boost_params(self) -> Dict[str, Any]:
         raise NotImplementedError
@@ -162,18 +192,17 @@ class _BoostedClassifierBase(_TreeClassifierBase):
         rw = Tr.subsample_weights(n, bp["n_rounds"], bp["subsample"], rng)
         fms = Tr.feature_masks(d, bp["n_rounds"], bp["colsample"], rng)
         loss = "logistic" if k == 2 else "softmax"
+        frontier = self._frontier(n, bp["max_depth"], bp["min_child_weight"], 0.25)
         trees, _ = Tr.fit_gbt(jnp.asarray(Xb), _as_f32(y), jnp.asarray(sw),
                               jnp.asarray(rw), jnp.asarray(fms), loss=loss,
                               n_rounds=bp["n_rounds"], max_depth=bp["max_depth"],
-                              n_bins=bp["n_bins"], eta=bp["eta"],
+                              n_bins=bp["n_bins"], frontier=frontier,
+                              eta=bp["eta"],
                               reg_lambda=bp["reg_lambda"], gamma=bp["gamma"],
                               min_child_weight=bp["min_child_weight"],
                               n_classes=k)
-        return {"split_feat": np.asarray(trees.split_feat),
-                "split_bin": np.asarray(trees.split_bin),
-                "leaf_val": np.asarray(trees.leaf_val),
-                "edges": edges, "max_depth": bp["max_depth"], "eta": bp["eta"],
-                "num_classes": k, "loss": loss}
+        return tree_params(trees, edges=edges, max_depth=bp["max_depth"],
+                           eta=bp["eta"], num_classes=k, loss=loss)
 
     @staticmethod
     def _margins_to_preds(loss: str, F: np.ndarray
@@ -193,11 +222,10 @@ class _BoostedClassifierBase(_TreeClassifierBase):
     def predict_arrays(cls, params: Dict[str, Any], X: np.ndarray
                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         Xb = jnp.asarray(Tr.bin_with_edges(X, params["edges"]))
-        trees = Tr.Tree(jnp.asarray(params["split_feat"]),
-                        jnp.asarray(params["split_bin"]),
-                        jnp.asarray(params["leaf_val"]))
-        F = Tr.predict_gbt(Xb, trees, params["max_depth"], params["eta"])
-        return cls._margins_to_preds(params["loss"], np.asarray(F))
+        trees = tree_from_params(params)
+        F = Tr.predict_gbt(Xb, trees, int(params["max_depth"]),
+                           float(params["eta"]))
+        return cls._margins_to_preds(str(params["loss"]), np.asarray(F))
 
     def fit_grid_folds(self, X, y, train_w, grids):
         """Batched fold x grid sweep for boosted models (SURVEY §2.7 axis 2):
@@ -235,7 +263,7 @@ class OpXGBoostClassifier(_BoostedClassifierBase):
     """XGBoost-parameterized boosting (eta/numRound/lambda/gamma/subsample)."""
 
     def __init__(self, num_round: int = 100, eta: float = 0.3, max_depth: int = 6,
-                 max_bins: int = 64, reg_lambda: float = 1.0, gamma: float = 0.0,
+                 max_bins: int = 32, reg_lambda: float = 1.0, gamma: float = 0.0,
                  min_child_weight: float = 1.0, subsample: float = 1.0,
                  colsample_bytree: float = 1.0, seed: int = 42,
                  uid: Optional[str] = None, **extra):

@@ -52,9 +52,11 @@ class OpLinearRegression(PredictorEstimator):
         reg, alpha = p["reg_param"], p["elastic_net_param"]
         l1 = reg * alpha
         l2 = reg * (1.0 - alpha)
-        Xd = jnp.asarray(X, jnp.float32)
-        yd = jnp.asarray(y, jnp.float32)
-        twd = jnp.asarray(train_w, jnp.float32)
+        from ...parallel.mesh import replicate_input, shard_candidates
+
+        Xd = replicate_input(np.asarray(X, np.float32))
+        yd = replicate_input(np.asarray(y, np.float32))
+        twd = replicate_input(np.asarray(train_w, np.float32))
         F, G = train_w.shape[0], len(grids)
         d = X.shape[1]
         coef = np.zeros((F, G, d), np.float32)
@@ -62,17 +64,20 @@ class OpLinearRegression(PredictorEstimator):
         ridge_idx = np.where(l1 == 0.0)[0]
         fista_idx = np.where(l1 != 0.0)[0]
         if len(ridge_idx):
-            fitr = L.fit_ridge_grid_folds(Xd, yd, twd, jnp.asarray(l2[ridge_idx]),
+            l2d, gr = shard_candidates(l2[ridge_idx], fill=1.0)
+            fitr = L.fit_ridge_grid_folds(Xd, yd, twd, l2d,
                                           fit_intercept=fit_intercept)
-            coef[:, ridge_idx] = np.asarray(fitr.coef)
-            intercept[:, ridge_idx] = np.asarray(fitr.intercept)
+            coef[:, ridge_idx] = np.asarray(fitr.coef)[:, :gr]
+            intercept[:, ridge_idx] = np.asarray(fitr.intercept)[:, :gr]
         if len(fista_idx):
+            l1d, gf = shard_candidates(l1[fista_idx], fill=0.0)
+            l2d, _ = shard_candidates(l2[fista_idx], fill=1.0)
             fitf = L.fit_linear_grid_folds_fista(
-                Xd, yd, twd, jnp.asarray(l1[fista_idx]), jnp.asarray(l2[fista_idx]),
+                Xd, yd, twd, l1d, l2d,
                 max_iter=max(int(self.get_param("max_iter", 100)), 300),
                 fit_intercept=fit_intercept)
-            coef[:, fista_idx] = np.asarray(fitf.coef)
-            intercept[:, fista_idx] = np.asarray(fitf.intercept)
+            coef[:, fista_idx] = np.asarray(fitf.coef)[:, :gf]
+            intercept[:, fista_idx] = np.asarray(fitf.intercept)[:, :gf]
         z = np.asarray(jnp.einsum("nd,fgd->fgn", Xd, jnp.asarray(coef))
                        + jnp.asarray(intercept[..., :1]))
         return [[(z[f, c], None, None) for c in range(G)] for f in range(F)]

@@ -14,14 +14,27 @@ import numpy as np
 
 from ...ops import trees as Tr
 from ..selector.predictor import PredictorEstimator
-from ..trees_common import (TreeParamsMixin, boosted_grid_folds as _boosted_grid_folds,
+from ..trees_common import (DEFAULT_MAX_FRONTIER, DEFAULT_MAX_FRONTIER_BOOSTED,
+                            TreeParamsMixin,
+                            boosted_grid_folds as _boosted_grid_folds,
                             forest_grid_folds as _forest_grid_folds,
-                            gbt_boost_params, xgb_boost_params)
+                            gbt_boost_params, tree_from_params, tree_params,
+                            xgb_boost_params)
 
 
 class _TreeRegressorBase(TreeParamsMixin, PredictorEstimator):
     is_classifier = False
     _auto_subset = "onethird"  # Spark regression-forest default
+
+    #: boosted subclasses override with DEFAULT_MAX_FRONTIER_BOOSTED so the
+    #: refit grows the same beam the CV sweep measured
+    _max_frontier_default = DEFAULT_MAX_FRONTIER
+
+    def _frontier(self, n: int, depth: int, mcw: float, h_max: float = 1.0) -> int:
+        return Tr.frontier_cap(
+            n, depth, mcw, h_max=h_max,
+            max_frontier=int(self.get_param("max_frontier",
+                                            self._max_frontier_default)))
 
 
 class OpRandomForestRegressor(_TreeRegressorBase):
@@ -50,24 +63,20 @@ class OpRandomForestRegressor(_TreeRegressorBase):
                                   ) * sw[None, :]
         fms = Tr.feature_masks(d, n_trees, self._subset_frac(d), rng)
         g = jnp.asarray(-np.asarray(y, np.float32)[:, None])
+        mcw = float(self.get_param("min_instances_per_node", 1))
         forest = Tr.fit_forest(jnp.asarray(Xb), g, jnp.ones(n, jnp.float32),
                                jnp.asarray(wt), jnp.asarray(fms),
                                max_depth=depth, n_bins=n_bins,
-                               min_child_weight=float(
-                                   self.get_param("min_instances_per_node", 1)))
-        return {"split_feat": np.asarray(forest.split_feat),
-                "split_bin": np.asarray(forest.split_bin),
-                "leaf_val": np.asarray(forest.leaf_val),
-                "edges": edges, "max_depth": depth}
+                               frontier=self._frontier(n, depth, mcw),
+                               min_child_weight=mcw)
+        return tree_params(forest, edges=edges, max_depth=depth)
 
     @classmethod
     def predict_arrays(cls, params: Dict[str, Any], X: np.ndarray
                        ) -> Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]:
         Xb = jnp.asarray(Tr.bin_with_edges(X, params["edges"]))
-        forest = Tr.Tree(jnp.asarray(params["split_feat"]),
-                         jnp.asarray(params["split_bin"]),
-                         jnp.asarray(params["leaf_val"]))
-        pred = np.asarray(Tr.predict_forest(Xb, forest, params["max_depth"]))[:, 0]
+        forest = tree_from_params(params)
+        pred = np.asarray(Tr.predict_forest(Xb, forest, int(params["max_depth"])))[:, 0]
         return pred.astype(np.float64), None, None
 
     def fit_grid_folds(self, X, y, train_w, grids):
@@ -99,19 +108,19 @@ class OpDecisionTreeRegressor(OpRandomForestRegressor):
         Xb, edges = Tr.quantize(X, n_bins)
         sw = np.ones(n, np.float32) if w is None else np.asarray(w, np.float32)
         g = jnp.asarray(-np.asarray(y, np.float32)[:, None])
+        mcw = float(self.get_param("min_instances_per_node", 1))
         forest = Tr.fit_forest(jnp.asarray(Xb), g, jnp.ones(n, jnp.float32),
                                jnp.asarray(sw[None, :]),
                                jnp.asarray(np.ones((1, d), np.float32)),
                                max_depth=depth, n_bins=n_bins,
-                               min_child_weight=float(
-                                   self.get_param("min_instances_per_node", 1)))
-        return {"split_feat": np.asarray(forest.split_feat),
-                "split_bin": np.asarray(forest.split_bin),
-                "leaf_val": np.asarray(forest.leaf_val),
-                "edges": edges, "max_depth": depth}
+                               frontier=self._frontier(n, depth, mcw),
+                               min_child_weight=mcw)
+        return tree_params(forest, edges=edges, max_depth=depth)
 
 
 class _BoostedRegressorBase(_TreeRegressorBase):
+    _max_frontier_default = DEFAULT_MAX_FRONTIER_BOOSTED
+
     def _boost_params(self) -> Dict[str, Any]:
         raise NotImplementedError
 
@@ -125,29 +134,27 @@ class _BoostedRegressorBase(_TreeRegressorBase):
         rw = Tr.subsample_weights(n, bp["n_rounds"], bp["subsample"], rng)
         fms = Tr.feature_masks(d, bp["n_rounds"], bp["colsample"], rng)
         base = float(np.average(y, weights=np.maximum(sw, 1e-12)))
+        frontier = self._frontier(n, bp["max_depth"], bp["min_child_weight"])
         trees, _ = Tr.fit_gbt(jnp.asarray(Xb), jnp.asarray(np.asarray(y, np.float32)),
                               jnp.asarray(sw), jnp.asarray(rw), jnp.asarray(fms),
                               loss="squared", n_rounds=bp["n_rounds"],
                               max_depth=bp["max_depth"], n_bins=bp["n_bins"],
+                              frontier=frontier,
                               eta=bp["eta"], reg_lambda=bp["reg_lambda"],
                               gamma=bp["gamma"],
                               min_child_weight=bp["min_child_weight"],
                               base_score=base)
-        return {"split_feat": np.asarray(trees.split_feat),
-                "split_bin": np.asarray(trees.split_bin),
-                "leaf_val": np.asarray(trees.leaf_val),
-                "edges": edges, "max_depth": bp["max_depth"], "eta": bp["eta"],
-                "base_score": base}
+        return tree_params(trees, edges=edges, max_depth=bp["max_depth"],
+                           eta=bp["eta"], base_score=base)
 
     @classmethod
     def predict_arrays(cls, params: Dict[str, Any], X: np.ndarray
                        ) -> Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]:
         Xb = jnp.asarray(Tr.bin_with_edges(X, params["edges"]))
-        trees = Tr.Tree(jnp.asarray(params["split_feat"]),
-                        jnp.asarray(params["split_bin"]),
-                        jnp.asarray(params["leaf_val"]))
-        F = Tr.predict_gbt(Xb, trees, params["max_depth"], params["eta"],
-                           base_score=params["base_score"])
+        trees = tree_from_params(params)
+        F = Tr.predict_gbt(Xb, trees, int(params["max_depth"]),
+                           float(params["eta"]),
+                           base_score=float(params["base_score"]))
         return np.asarray(F[:, 0], np.float64), None, None
 
     def fit_grid_folds(self, X, y, train_w, grids):
@@ -175,7 +182,7 @@ class OpGBTRegressor(_BoostedRegressorBase):
 
 class OpXGBoostRegressor(_BoostedRegressorBase):
     def __init__(self, num_round: int = 100, eta: float = 0.3, max_depth: int = 6,
-                 max_bins: int = 64, reg_lambda: float = 1.0, gamma: float = 0.0,
+                 max_bins: int = 32, reg_lambda: float = 1.0, gamma: float = 0.0,
                  min_child_weight: float = 1.0, subsample: float = 1.0,
                  colsample_bytree: float = 1.0, seed: int = 42,
                  uid: Optional[str] = None, **extra):

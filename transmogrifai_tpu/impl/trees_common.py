@@ -12,6 +12,36 @@ from typing import Any, Dict
 
 _SUBSET_STRATEGIES = ("auto", "all", "sqrt", "log2", "onethird")
 
+#: default beam caps for the bounded-frontier grower (ops/trees.frontier_cap);
+#: overridable per stage via the ``max_frontier`` param.  Boosted models get a
+#: tighter cap: with shrinkage (eta) damping every tree, truncating a level to
+#: its best 32 splits is practically lossless, and the sweep runs hundreds of
+#: sequential rounds so per-level cost dominates wall-clock.
+DEFAULT_MAX_FRONTIER = 256
+DEFAULT_MAX_FRONTIER_BOOSTED = 64
+
+
+def tree_params(tree, **extra) -> Dict[str, Any]:
+    """Flatten a fitted ops.trees.Tree into a serializable params dict."""
+    import numpy as np
+
+    return {"split_feat": np.asarray(tree.split_feat),
+            "split_bin": np.asarray(tree.split_bin),
+            "left": np.asarray(tree.left), "right": np.asarray(tree.right),
+            "leaf_val": np.asarray(tree.leaf_val), **extra}
+
+
+def tree_from_params(params):
+    """Rebuild an ops.trees.Tree pytree from a params dict."""
+    import jax.numpy as jnp
+
+    from ..ops.trees import Tree
+
+    return Tree(jnp.asarray(params["split_feat"]),
+                jnp.asarray(params["split_bin"]),
+                jnp.asarray(params["left"]), jnp.asarray(params["right"]),
+                jnp.asarray(params["leaf_val"]))
+
 
 class TreeParamsMixin:
     """Spark featureSubsetStrategy resolution shared by all tree models.
@@ -99,11 +129,17 @@ def boosted_grid_folds(est, X, y, train_w, grids, loss: str, n_classes: int,
                   bp["subsample"], bp["colsample"])
         groups.setdefault(static, []).append(ci)
 
+    h_max = 0.25 if loss in ("logistic", "softmax") else 1.0
     for (n_rounds, max_depth, n_bins, subsample, colsample), cis in groups.items():
         rng = np.random.default_rng(int(est.get_param("seed", 42)))
         Xb, _ = Tr.quantize(X, n_bins)
         rw = Tr.subsample_weights(n, n_rounds, subsample, rng)
         fms = Tr.feature_masks(d, n_rounds, colsample, rng)
+        mcw_min = min(bps[ci]["min_child_weight"] for ci in cis)
+        frontier = Tr.frontier_cap(
+            n, max_depth, mcw_min, h_max=h_max,
+            max_frontier=int(est.get_param("max_frontier",
+                                           DEFAULT_MAX_FRONTIER_BOOSTED)))
         B = n_folds * len(cis)
         w_batch = np.empty((B, n), np.float32)
         eta_b = np.empty(B, np.float32)
@@ -122,14 +158,25 @@ def boosted_grid_folds(est, X, y, train_w, grids, loss: str, n_classes: int,
             if fold_base_score:  # regression starts from the fold's label mean
                 wsum = max(float(train_w[f].sum()), 1e-12)
                 base_b[bi] = float((yf * train_w[f]).sum() / wsum)
+        # candidate axis sharded over the active mesh's model axis (zero-weight
+        # padding candidates train on no rows); inputs replicated
+        from ..parallel.mesh import replicate_input, shard_candidates
+
+        w_dev, _ = shard_candidates(w_batch, fill=0.0)
+        eta_dev, _ = shard_candidates(eta_b, fill=0.1)
+        lam_dev, _ = shard_candidates(lam_b, fill=1.0)
+        gam_dev, _ = shard_candidates(gam_b, fill=0.0)
+        mcw_dev, _ = shard_candidates(mcw_b, fill=1.0)
+        base_dev, _ = shard_candidates(base_b, fill=0.0)
         F = Tr.fit_gbt_batch(
-            jnp.asarray(Xb), jnp.asarray(yf),
-            jnp.asarray(w_batch), jnp.asarray(rw), jnp.asarray(fms), loss=loss,
+            replicate_input(Xb), replicate_input(yf),
+            w_dev, replicate_input(rw), replicate_input(fms), loss=loss,
             n_rounds=n_rounds, max_depth=max_depth, n_bins=n_bins,
-            eta_b=jnp.asarray(eta_b), reg_lambda_b=jnp.asarray(lam_b),
-            gamma_b=jnp.asarray(gam_b), min_child_weight_b=jnp.asarray(mcw_b),
-            base_score_b=jnp.asarray(base_b), n_classes=n_classes)
-        F = np.asarray(F)
+            frontier=frontier,
+            eta_b=eta_dev, reg_lambda_b=lam_dev,
+            gamma_b=gam_dev, min_child_weight_b=mcw_dev,
+            base_score_b=base_dev, n_classes=n_classes)
+        F = np.asarray(F)[:B]
         for bi, (f, ci) in enumerate((f, ci) for f in range(n_folds) for ci in cis):
             out[f][ci] = convert(F[bi])
     return out
@@ -147,6 +194,7 @@ def forest_grid_folds(est, X, y, train_w, grids, n_classes: int, convert) -> lis
     launch (ops/trees.fit_forest_chunked) and evaluate with one grouped
     predict.  ``convert(dist)`` maps each group's mean leaf vector on the
     full X to (pred, raw, prob)."""
+    import jax
     import jax.numpy as jnp
     import numpy as np
 
@@ -160,7 +208,7 @@ def forest_grid_folds(est, X, y, train_w, grids, n_classes: int, convert) -> lis
     candidates = [est.copy_with_params(g) for g in grids]
     n_folds = train_w.shape[0]
     n, d = X.shape
-    c = max(n_classes, 1)
+    c = 1 if n_classes <= 2 else n_classes
     out = [[None] * len(grids) for _ in range(n_folds)]
     groups: Dict[tuple, list] = {}
     for ci, cand in enumerate(candidates):
@@ -169,7 +217,12 @@ def forest_grid_folds(est, X, y, train_w, grids, n_classes: int, convert) -> lis
                   int(cand.get_param("max_bins", 32)))
         groups.setdefault(static, []).append(ci)
 
-    if n_classes >= 2:
+    # Binary classification uses the 1-channel variance kernel: for 0/1
+    # labels, variance impurity p(1-p) is gini/2, so variance-gain splits are
+    # IDENTICAL to gini splits and the leaf mean is p(class=1) — half the
+    # histogram work of a 2-channel one-hot kernel.
+    binary = n_classes == 2
+    if n_classes >= 2 and not binary:
         G = -np.eye(n_classes, dtype=np.float32)[np.asarray(y, np.int64)]
     else:
         G = -np.asarray(y, np.float32)[:, None]
@@ -177,6 +230,11 @@ def forest_grid_folds(est, X, y, train_w, grids, n_classes: int, convert) -> lis
 
     for (max_depth, n_trees, n_bins), cis in groups.items():
         Xb, _ = Tr.quantize(X, n_bins)
+        mcw_min = min(float(candidates[ci].get_param("min_instances_per_node", 1))
+                      for ci in cis)
+        frontier = Tr.frontier_cap(
+            n, max_depth, mcw_min, h_max=1.0,
+            max_frontier=int(est.get_param("max_frontier", DEFAULT_MAX_FRONTIER)))
         pairs = [(f, ci) for f in range(n_folds) for ci in cis]
         TT = len(pairs) * n_trees
         w_trees = np.empty((TT, n), np.float32)
@@ -197,31 +255,48 @@ def forest_grid_folds(est, X, y, train_w, grids, n_classes: int, convert) -> lis
             fms[gi * n_trees:(gi + 1) * n_trees] = fm
             mcw[gi * n_trees:(gi + 1) * n_trees] = float(
                 cand.get_param("min_instances_per_node", 1))
-        chunk = min(Tr.forest_chunk_size(max_depth, n_bins, d, c), TT)
-        pad = (-TT) % chunk
+        from ..parallel.mesh import MODEL_AXIS, active_mesh, model_shards
+
+        n_shard = model_shards()
+        chunk = min(Tr.forest_chunk_size(max_depth, n_bins, d, c, frontier),
+                    max(TT // n_shard, 1))
+        pad = (-TT) % (chunk * n_shard)
         if pad:  # zero-weight padding trees grow no splits and are dropped
             w_trees = np.concatenate([w_trees, np.zeros((pad, n), np.float32)])
             fms = np.concatenate([fms, np.ones((pad, d), np.float32)])
             mcw = np.concatenate([mcw, np.ones(pad, np.float32)])
-        forest = Tr.fit_forest_chunked(
-            jnp.asarray(Xb), jnp.asarray(G), jnp.asarray(H), jnp.asarray(w_trees),
-            jnp.asarray(fms), jnp.asarray(mcw), max_depth=max_depth,
-            n_bins=n_bins, chunk=chunk)
+        if n_shard > 1:  # tree axis spread over the mesh model axis
+            forest = Tr.fit_forest_sharded(
+                active_mesh(), MODEL_AXIS, jnp.asarray(Xb), jnp.asarray(G),
+                jnp.asarray(H), jnp.asarray(w_trees), jnp.asarray(fms),
+                jnp.asarray(mcw), max_depth=max_depth, n_bins=n_bins,
+                chunk=chunk, frontier=frontier)
+            forest = jax.tree.map(lambda a: jnp.asarray(np.asarray(a)), forest)
+        else:
+            forest = Tr.fit_forest_chunked(
+                jnp.asarray(Xb), jnp.asarray(G), jnp.asarray(H), jnp.asarray(w_trees),
+                jnp.asarray(fms), jnp.asarray(mcw), max_depth=max_depth,
+                n_bins=n_bins, chunk=chunk, frontier=frontier)
         if pad:
-            forest = Tr.Tree(forest.split_feat[:TT], forest.split_bin[:TT],
-                             forest.leaf_val[:TT])
+            forest = jax.tree.map(lambda a: a[:TT], forest)
         dist = np.asarray(Tr.predict_forest_groups(jnp.asarray(Xb), forest,
                                                    max_depth, len(pairs)))
+        if binary:  # expand the 1-channel class-1 proportion to [p0, p1]
+            dist = np.concatenate([1.0 - dist, dist], axis=-1)
         for gi, (f, ci) in enumerate(pairs):
             out[f][ci] = convert(dist[gi], candidates[ci])
     return out
 
 
 def xgb_boost_params(stage) -> Dict[str, Any]:
-    """XGBoost param dict (numRound/eta/lambda/gamma/subsample/colsample)."""
+    """XGBoost param dict (numRound/eta/lambda/gamma/subsample/colsample).
+
+    ``max_bins`` defaults to 32 — the Spark MLlib maxBins default, applied
+    uniformly to our histogram formulation (xgboost4j used exact greedy
+    splits; a TPU-native static-shape kernel must bin)."""
     return {"n_rounds": int(stage.get_param("num_round", 100)),
             "max_depth": int(stage.get_param("max_depth", 6)),
-            "n_bins": int(stage.get_param("max_bins", 64)),
+            "n_bins": int(stage.get_param("max_bins", 32)),
             "eta": float(stage.get_param("eta", 0.3)),
             "subsample": float(stage.get_param("subsample", 1.0)),
             "colsample": float(stage.get_param("colsample_bytree", 1.0)),

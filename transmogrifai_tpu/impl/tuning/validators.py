@@ -98,11 +98,23 @@ class OpValidator:
     validation_type = "validator"
 
     def __init__(self, evaluator: OpEvaluatorBase, seed: int = 42,
-                 stratify: bool = False, parallelism: int = DEFAULT_PARALLELISM):
+                 stratify: bool = False, parallelism: int = DEFAULT_PARALLELISM,
+                 mesh: Any = "auto"):
         self.evaluator = evaluator
         self.seed = seed
         self.stratify = stratify
         self.parallelism = parallelism  # API parity; the sweep is one launch
+        #: "auto" = all local devices on the model axis; None = single device;
+        #: or an explicit jax.sharding.Mesh.  The TPU replacement for the
+        #: reference's 8-thread pool (OpValidator.scala:373-380).
+        self.mesh = mesh
+
+    def _resolve_mesh(self):
+        from ...parallel.mesh import auto_mesh
+
+        if isinstance(self.mesh, str) and self.mesh == "auto":
+            return auto_mesh()
+        return self.mesh
 
     # ---- folds -------------------------------------------------------------
     def make_folds(self, n: int, y: Optional[np.ndarray]
@@ -135,6 +147,18 @@ class OpValidator:
             metric_name=self.evaluator.default_metric,
             is_larger_better=self.evaluator.is_larger_better,
         )
+        from ...parallel.mesh import use_mesh
+
+        with use_mesh(self._resolve_mesh()):
+            self._sweep(candidates, X, y, train_w, val_mask, summary)
+        if not summary.results or all(r.error for r in summary.results):
+            raise RuntimeError("All models in the selector grid failed to fit")
+        vals = [r.metric_value for r in summary.results]
+        summary.best_index = int(np.argmax(vals) if self.evaluator.is_larger_better
+                                 else np.argmin(vals))
+        return summary
+
+    def _sweep(self, candidates, X, y, train_w, val_mask, summary) -> None:
         for est, grids in candidates:
             grids = list(grids) or [{}]
             preds = None
@@ -174,12 +198,6 @@ class OpValidator:
                     model_type=type(est).__name__, grid=dict(grid),
                     metric_name=self.evaluator.default_metric,
                     fold_metrics=fold_metrics, metric_value=value, error=err))
-        if not summary.results or all(r.error for r in summary.results):
-            raise RuntimeError("All models in the selector grid failed to fit")
-        vals = [r.metric_value for r in summary.results]
-        summary.best_index = int(np.argmax(vals) if self.evaluator.is_larger_better
-                                 else np.argmin(vals))
-        return summary
 
 
 class OpCrossValidation(OpValidator):
@@ -190,8 +208,9 @@ class OpCrossValidation(OpValidator):
 
     def __init__(self, evaluator: OpEvaluatorBase, num_folds: int = DEFAULT_NUM_FOLDS,
                  seed: int = 42, stratify: bool = False,
-                 parallelism: int = DEFAULT_PARALLELISM):
-        super().__init__(evaluator, seed=seed, stratify=stratify, parallelism=parallelism)
+                 parallelism: int = DEFAULT_PARALLELISM, mesh: Any = "auto"):
+        super().__init__(evaluator, seed=seed, stratify=stratify,
+                         parallelism=parallelism, mesh=mesh)
         if num_folds < 2:
             raise ValueError("num_folds must be >= 2")
         self.num_folds = num_folds
@@ -211,8 +230,9 @@ class OpTrainValidationSplit(OpValidator):
 
     def __init__(self, evaluator: OpEvaluatorBase, train_ratio: float = DEFAULT_TRAIN_RATIO,
                  seed: int = 42, stratify: bool = False,
-                 parallelism: int = DEFAULT_PARALLELISM):
-        super().__init__(evaluator, seed=seed, stratify=stratify, parallelism=parallelism)
+                 parallelism: int = DEFAULT_PARALLELISM, mesh: Any = "auto"):
+        super().__init__(evaluator, seed=seed, stratify=stratify,
+                         parallelism=parallelism, mesh=mesh)
         if not 0.0 < train_ratio < 1.0:
             raise ValueError("train_ratio must be in (0, 1)")
         self.train_ratio = train_ratio

@@ -6,25 +6,36 @@ core/.../impl/classification/OpXGBoostClassifier.scala:47).  On TPU the
 idiomatic formulation is the *histogram method* with static shapes and no
 per-row control flow (SURVEY §7 "Trees/GBT/XGBoost on TPU"):
 
-- features are pre-quantized to ``n_bins`` integer bins (quantile sketch,
-  Spark's maxBins analog),
-- a tree is grown breadth-first, level by level, over a FIXED full binary
-  heap of ``2^(max_depth+1)-1`` nodes; per level the (node, feature, bin)
-  gradient histograms are built with ``segment_sum`` (one scatter per
-  feature, vmapped) and the best split per node is a pure cumsum/argmax
-  reduction — everything batchable on the VPU/MXU,
-- rows carry a node id; the level update is a gather + compare, no branching,
+- features are pre-quantized to ``n_bins`` integer bins (subsampled quantile
+  sketch — XGBoost's approx sketch analog; Spark's maxBins),
+- a tree grows breadth-first over a BOUNDED FRONTIER of ``M`` node slots:
+  early levels are unrolled at their exact widths (1, 2, 4, ... nodes), deep
+  levels run in ONE ``lax.fori_loop`` body with a fixed ``M``-slot frontier —
+  so compile cost is independent of depth and per-level memory/compute is
+  capped at ``M * d * B`` instead of ``2^depth * d * B``,
+- per level the (slot, feature, bin) gradient histograms are built with
+  ``segment_sum`` (one scatter per feature, vmapped) and the best split per
+  slot is a pure cumsum/argmax reduction — all VPU/MXU-friendly,
+- rows carry a frontier-slot id; the level update is a gather + compare,
 - second-order (g, h) statistics make the same builder serve XGBoost-style
   boosting (Newton leaves), RF regression (g = -y: variance gain, mean
   leaves), and RF classification (g = -onehot(y): gini-equivalent gain,
   class-distribution leaves),
 - a forest is ``vmap(grow_tree)`` over bootstrap row-weights and feature
-  masks; boosting is ``lax.scan`` over rounds — so a whole RF trains as ONE
-  XLA launch, and boosting compiles to a single fixed-trip loop.
+  masks; boosting is ``lax.scan`` over rounds — a whole RF trains as ONE
+  XLA launch and boosting compiles to a single fixed-trip loop.
 
-Trees are stored as flat arrays (heap layout): ``split_feat`` (-1 = leaf),
-``split_bin``, ``leaf_val[heap, c]`` — pytree-friendly and trivially
-serializable.
+Frontier exactness: depth-wise growth is EXACT whenever every level has at
+most ``M // 2`` valid splits.  A valid split needs hessian weight
+``>= min_child_weight`` in each child, so at most ``H_total / (2 * mcw)``
+nodes per level can split — ``frontier_cap`` sizes ``M`` from that bound.
+When data is so large that the bound exceeds ``max_frontier``, growth becomes
+a gain-ranked beam (LightGBM max-leaves analog) — the standard bounded-width
+compromise, documented here rather than hidden.
+
+Trees are stored as flat pointer arrays: ``split_feat`` (-1 = leaf),
+``split_bin``, ``left``/``right`` child pool indices, ``leaf_val[pool, c]``
+— pytree-friendly and trivially serializable.
 """
 from __future__ import annotations
 
@@ -39,161 +50,303 @@ from jax import lax
 
 
 class Tree(NamedTuple):
-    """One tree in heap layout; leading axes may batch trees/rounds."""
+    """One tree as a flat node pool; leading axes may batch trees/rounds."""
 
-    split_feat: jax.Array  # i32[heap]  (-1 => leaf)
-    split_bin: jax.Array   # i32[heap]  (go right if bin > split_bin)
-    leaf_val: jax.Array    # f32[heap, c]
+    split_feat: jax.Array  # i32[P]  (-1 => leaf)
+    split_bin: jax.Array   # i32[P]  (go right if bin > split_bin)
+    left: jax.Array        # i32[P]  pool index of left child
+    right: jax.Array       # i32[P]  pool index of right child
+    leaf_val: jax.Array    # f32[P, c]
 
 
 # ---------------------------------------------------------------------------
-# Quantization (host side, once per fit) — Spark maxBins / XGBoost sketch
+# Quantization — subsampled quantile sketch (XGBoost approx / Spark maxBins)
 # ---------------------------------------------------------------------------
-def quantize(X: np.ndarray, n_bins: int = 32) -> Tuple[np.ndarray, np.ndarray]:
-    """Equi-depth binning: returns (X_binned i32[n, d], edges f32[d, n_bins-1]).
+_SKETCH_ROWS = 1 << 18  # 262144 — plenty for <=256 quantile edges
+
+
+def _bin_dtype(n_bins: int):
+    return np.int8 if n_bins <= 127 else np.int32
+
+
+@jax.jit
+def _bin_chunk(X, edges):
+    """i32[n, d]: per-feature searchsorted (left) — log2(B) compare steps."""
+    return jax.vmap(lambda e, x: jnp.searchsorted(e, x, side="left"),
+                    in_axes=(0, 1), out_axes=1)(edges, X)
+
+
+def sketch_edges(X: np.ndarray, n_bins: int, seed: int = 0) -> np.ndarray:
+    """Quantile split candidates f32[d, n_bins-1] from a row subsample."""
+    X = np.asarray(X, np.float32)
+    n = X.shape[0]
+    if n > _SKETCH_ROWS:
+        idx = np.random.default_rng(seed).choice(n, _SKETCH_ROWS, replace=False)
+        X = X[idx]
+    qs = np.linspace(0.0, 1.0, n_bins + 1)[1:-1]
+    return np.quantile(X, qs, axis=0).T.astype(np.float32)  # [d, n_bins-1]
+
+
+def bin_with_edges(X: np.ndarray, edges: np.ndarray,
+                   chunk: int = 1 << 20) -> np.ndarray:
+    """Apply fitted edges (vectorized on device, row-chunked for huge n).
 
     Bin b holds values in (edges[b-1], edges[b]]; value <= edges[0] is bin 0;
-    value > edges[-1] is bin n_bins-1.  Matches Spark's quantile-based
-    continuous-feature splits (maxBins default 32).
+    value > edges[-1] is the last bin.
     """
     X = np.asarray(X, np.float32)
-    n, d = X.shape
-    qs = np.linspace(0.0, 1.0, n_bins + 1)[1:-1]
-    edges = np.quantile(X, qs, axis=0).T.astype(np.float32)  # [d, n_bins-1]
-    # deduplicate edges per feature to avoid empty bins producing NaN gains
-    Xb = np.empty((n, d), np.int32)
-    for j in range(d):
-        Xb[:, j] = np.searchsorted(edges[j], X[:, j], side="left")
-    return Xb, edges
+    n = X.shape[0]
+    n_bins = edges.shape[1] + 1
+    dt = _bin_dtype(n_bins)
+    ed = jnp.asarray(edges)
+    if n <= chunk:
+        return np.asarray(_bin_chunk(jnp.asarray(X), ed)).astype(dt)
+    out = np.empty(X.shape, dt)
+    for lo in range(0, n, chunk):
+        hi = min(lo + chunk, n)
+        out[lo:hi] = np.asarray(_bin_chunk(jnp.asarray(X[lo:hi]), ed)).astype(dt)
+    return out
 
 
-def bin_with_edges(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
-    """Apply fitted edges to new data (scoring path)."""
-    X = np.asarray(X, np.float32)
-    n, d = X.shape
-    Xb = np.empty((n, d), np.int32)
-    for j in range(d):
-        Xb[:, j] = np.searchsorted(edges[j], X[:, j], side="left")
-    return Xb
+def quantize(X: np.ndarray, n_bins: int = 32,
+             seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Equi-depth binning: (X_binned int8/i32[n, d], edges f32[d, n_bins-1])."""
+    edges = sketch_edges(X, n_bins, seed=seed)
+    return bin_with_edges(X, edges), edges
+
+
+# ---------------------------------------------------------------------------
+# Frontier sizing
+# ---------------------------------------------------------------------------
+def _next_pow2(x: int) -> int:
+    return 1 << max(int(x) - 1, 1).bit_length()
+
+
+def frontier_cap(n: int, max_depth: int, min_child_weight: float = 1.0,
+                 h_max: float = 1.0, max_frontier: int = 512) -> int:
+    """Frontier slots M for ``grow_tree`` (static; power of two).
+
+    At most ``H_total / (2 * mcw)`` nodes can validly split per level
+    (children need hessian weight >= mcw each), so a frontier of
+    ``H_total / mcw`` slots loses nothing.  ``h_max`` bounds one row's
+    hessian (1 for variance/gini trees, 0.25 for logistic/softmax); the 1.25
+    factor absorbs Poisson-bootstrap weight inflation.  Beyond
+    ``max_frontier`` growth is a gain-ranked beam (see module docstring).
+    """
+    if max_depth <= 1:
+        return 2
+    exact = int(np.ceil(1.25 * h_max * n / max(min_child_weight, 1e-3)))
+    # 2^max_depth (not 2^(max_depth-1)): the last split level's children must
+    # all fit the next frontier, else the beam silently halves the deepest
+    # level; when this term binds the tree is fully unrolled and exact.
+    m = min(1 << max_depth, max(exact, 2), max_frontier, _next_pow2(n))
+    return max(_next_pow2(m) if m & (m - 1) else m, 2)
+
+
+def _pool_size(max_depth: int, frontier: int) -> int:
+    """Node-pool capacity: exact heap for unrolled levels + M per loop level."""
+    if max_depth <= 0:
+        return 1
+    L = frontier.bit_length() - 1  # log2(M)
+    u = min(max_depth, L)
+    return (1 << (u + 1)) - 1 + max(max_depth - L, 0) * frontier
 
 
 # ---------------------------------------------------------------------------
 # Tree growth
 # ---------------------------------------------------------------------------
-def _level_histograms(Xb, gw, hw, node_local, active, m: int, n_bins: int):
-    """Per-(node, feature, bin) stats for one level.
+def _level_histograms(Xb, ghw, row_slot, m: int, n_bins: int):
+    """Per-(slot, feature, bin) stats: G [m, d, B, c], H [m, d, B].
 
-    Xb: i32[n, d]; gw: f32[n, c]; hw: f32[n]; node_local: i32[n] in [0, m).
-    Returns G [m, d, B, c], H [m, d, B].
+    ghw: f32[n, c+1] — weighted gradients with the weighted hessian as the
+    last channel, so G and H come out of ONE scatter per feature.
+    row_slot: i32[n] in [0, m) or -1 (resting at a leaf -> overflow bucket).
     """
     B = n_bins
-    base = jnp.where(active, node_local * B, m * B)  # overflow bucket for dead rows
+    d = Xb.shape[1]
+    dead = row_slot < 0
+    base = jnp.where(dead, m * B, row_slot * B)
 
     def per_feature(bins_j):
-        seg = base + jnp.where(active, bins_j, 0)
-        G = jax.ops.segment_sum(gw, seg, num_segments=m * B + 1)[:-1]  # [m*B, c]
-        H = jax.ops.segment_sum(hw, seg, num_segments=m * B + 1)[:-1]
-        return G, H
+        seg = base + jnp.where(dead, 0, bins_j)
+        return jax.ops.segment_sum(ghw, seg, num_segments=m * B + 1)[:-1]
 
-    G, H = jax.vmap(per_feature, in_axes=1, out_axes=0)(Xb)  # [d, m*B, ...]
-    c = gw.shape[1]
-    G = G.reshape(Xb.shape[1], m, B, c).transpose(1, 0, 2, 3)
-    H = H.reshape(Xb.shape[1], m, B).transpose(1, 0, 2)
-    return G, H
+    GH = jax.vmap(per_feature, in_axes=1, out_axes=0)(Xb)  # [d, m*B, c+1]
+    c = ghw.shape[1] - 1
+    GH = GH.reshape(d, m, B, c + 1).transpose(1, 0, 2, 3)
+    return GH[..., :c], GH[..., c]
+
+
+def _grow_level(Xb, ghw, feat_mask, tree: Tree, next_free, slot_node,
+                row_slot, m: int, next_cap: int, n_bins: int, reg_lambda,
+                gamma, min_child_weight):
+    """One breadth-first level over an ``m``-slot frontier.
+
+    Returns (tree', next_free', slot_node'[next_cap], row_slot').  ``m`` and
+    ``next_cap`` are static; when ``next_cap < 2 * m`` the level keeps only
+    the top ``next_cap // 2`` splits by gain (beam cap — see module doc).
+    """
+    B = n_bins
+    d = Xb.shape[1]
+    P = tree.split_feat.shape[0]
+    G, H = _level_histograms(Xb, ghw, row_slot, m, B)
+    GT = G[:, 0].sum(axis=1)   # [m, c] — node totals (identical across features)
+    HT = H[:, 0].sum(axis=1)   # [m]
+    in_use = slot_node >= 0
+    vals = -GT / (HT + reg_lambda)[:, None]
+    leaf_val = tree.leaf_val.at[jnp.where(in_use, slot_node, P)].set(
+        vals, mode="drop")
+
+    GL = jnp.cumsum(G, axis=2)                   # [m, d, B, c]
+    HL = jnp.cumsum(H, axis=2)                   # [m, d, B]
+    GR = GT[:, None, None, :] - GL
+    HR = HT[:, None, None] - HL
+
+    def score(Gp, Hp):
+        return (Gp * Gp).sum(axis=-1) / (Hp + reg_lambda)
+
+    gain = score(GL, HL) + score(GR, HR) - score(GT, HT)[:, None, None]  # [m,d,B]
+    valid = (HL >= min_child_weight) & (HR >= min_child_weight)
+    valid &= feat_mask[None, :, None] > 0.0
+    valid &= jnp.arange(B)[None, None, :] < B - 1  # last bin: empty right side
+    gain = jnp.where(valid, gain, -jnp.inf)
+    flat = gain.reshape(m, d * B)
+    best = jnp.argmax(flat, axis=1)              # [m]
+    best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+    bf = (best // B).astype(jnp.int32)
+    bb = (best % B).astype(jnp.int32)
+    do_split = (best_gain > gamma) & in_use
+    if next_cap < 2 * m:  # beam cap: keep top next_cap//2 splits by gain
+        order = jnp.argsort(-jnp.where(do_split, best_gain, -jnp.inf))
+        rank = jnp.zeros((m,), jnp.int32).at[order].set(jnp.arange(m))
+        do_split &= rank < next_cap // 2
+
+    k = jnp.cumsum(do_split.astype(jnp.int32))   # inclusive counts
+    child_idx = (k - 1) * 2                      # left child's next-level slot
+    left_pool = next_free + child_idx
+    right_pool = left_pool + 1
+    tgt = jnp.where(do_split, slot_node, P)
+    tree = Tree(
+        split_feat=tree.split_feat.at[tgt].set(bf, mode="drop"),
+        split_bin=tree.split_bin.at[tgt].set(bb, mode="drop"),
+        left=tree.left.at[tgt].set(left_pool, mode="drop"),
+        right=tree.right.at[tgt].set(right_pool, mode="drop"),
+        leaf_val=leaf_val)
+    # children's leaf values straight from the winning split's stats
+    GLf = GL.reshape(m, d * B, -1)
+    HLf = HL.reshape(m, d * B)
+    GL_best = jnp.take_along_axis(GLf, best[:, None, None], axis=1)[:, 0]  # [m,c]
+    HL_best = jnp.take_along_axis(HLf, best[:, None], axis=1)[:, 0]        # [m]
+    GR_best = GT - GL_best
+    HR_best = HT - HL_best
+    lval = -GL_best / (HL_best + reg_lambda)[:, None]
+    rval = -GR_best / (HR_best + reg_lambda)[:, None]
+    leaf_val = tree.leaf_val
+    leaf_val = leaf_val.at[jnp.where(do_split, left_pool, P)].set(lval, mode="drop")
+    leaf_val = leaf_val.at[jnp.where(do_split, right_pool, P)].set(rval, mode="drop")
+    tree = tree._replace(leaf_val=leaf_val)
+    # next frontier: children packed into slots [0, 2k)
+    new_slot = jnp.full((next_cap,), -1, jnp.int32)
+    new_slot = new_slot.at[jnp.where(do_split, child_idx, next_cap)].set(
+        left_pool, mode="drop")
+    new_slot = new_slot.at[jnp.where(do_split, child_idx + 1, next_cap)].set(
+        right_pool, mode="drop")
+    # route rows: gather their slot's split; rows on leaves rest (-1)
+    s_safe = jnp.maximum(row_slot, 0)
+    splits_here = do_split[s_safe] & (row_slot >= 0)
+    row_bin = jnp.take_along_axis(Xb, bf[s_safe][:, None], axis=1)[:, 0]
+    go_right = (row_bin > bb[s_safe]).astype(jnp.int32)
+    new_row_slot = jnp.where(splits_here, child_idx[s_safe] + go_right, -1)
+    next_free = next_free + 2 * k[-1]
+    return tree, next_free, new_slot, new_row_slot
 
 
 def grow_tree(Xb, g, h, w, feat_mask, max_depth: int, n_bins: int,
-              reg_lambda: float = 1.0, gamma: float = 0.0,
+              frontier: int, reg_lambda: float = 1.0, gamma: float = 0.0,
               min_child_weight: float = 1.0) -> Tree:
     """Grow one second-order histogram tree (traceable; static shapes).
 
-    Xb: i32[n, d] pre-binned features; g: f32[n, c] gradients; h: f32[n]
+    Xb: int[n, d] pre-binned features; g: f32[n, c] gradients; h: f32[n]
     hessians; w: f32[n] row weights (bootstrap/balancing; 0 drops the row);
-    feat_mask: f32[d] 1/0 feature subsampling mask.
+    feat_mask: f32[d] 1/0 feature subsampling mask; ``frontier``: static
+    frontier width M (see ``frontier_cap``).
 
     Gain (XGBoost): sum_c GL_c^2/(HL+l) + GR_c^2/(HR+l) - GT_c^2/(HT+l);
-    leaf value: -G/(H+l).  With g=-y, h=1, l=0 this is exactly variance-gain
+    leaf value: -G/(H+l).  With g=-y, h=1, l~0 this is exactly variance-gain
     splitting with mean leaves (Spark variance impurity), and with
-    g=-onehot(y) it is gini-equivalent splitting with class-distribution
-    leaves (Spark gini impurity).
+    g=-onehot(y) it is gini-equivalent gain with class-distribution leaves
+    (Spark gini impurity).
     """
+    Xb = Xb.astype(jnp.int32)
     n, d = Xb.shape
     c = g.shape[1]
-    B = n_bins
-    heap = 2 ** (max_depth + 1) - 1
-    split_feat = jnp.full((heap,), -1, jnp.int32)
-    split_bin = jnp.zeros((heap,), jnp.int32)
-    leaf_val = jnp.zeros((heap, c), jnp.float32)
-    node_ids = jnp.zeros((n,), jnp.int32)
+    P = _pool_size(max_depth, frontier)
+    tree = Tree(split_feat=jnp.full((P,), -1, jnp.int32),
+                split_bin=jnp.zeros((P,), jnp.int32),
+                left=jnp.zeros((P,), jnp.int32),
+                right=jnp.zeros((P,), jnp.int32),
+                leaf_val=jnp.zeros((P, c), jnp.float32))
     gw = g * w[:, None]
     hw = h * w
+    if max_depth <= 0:  # single leaf
+        GT = gw.sum(axis=0)
+        HT = hw.sum()
+        return tree._replace(leaf_val=tree.leaf_val.at[0].set(
+            -GT / (HT + reg_lambda)))
+    ghw = jnp.concatenate([gw, hw[:, None]], axis=1)  # one scatter per feature
 
-    for t in range(max_depth + 1):
-        offset = 2 ** t - 1
-        m = 2 ** t
-        active = node_ids >= offset
-        node_local = jnp.clip(node_ids - offset, 0, m - 1)
-        G, H = _level_histograms(Xb, gw, hw, node_local, active, m, B)
-        # node totals are identical across features; take feature 0's sums
-        GT = G[:, 0].sum(axis=1)   # [m, c]
-        HT = H[:, 0].sum(axis=1)   # [m]
-        # leaf values for every active node at this level
-        vals = -GT / (HT + reg_lambda)[:, None]      # [m, c]
-        leaf_val = lax.dynamic_update_slice(leaf_val, vals, (offset, 0))
-        if t == max_depth:
-            break
-        # split search: cumulative left stats over bins
-        GL = jnp.cumsum(G, axis=2)                   # [m, d, B, c]
-        HL = jnp.cumsum(H, axis=2)                   # [m, d, B]
-        GR = GT[:, None, None, :] - GL
-        HR = HT[:, None, None] - HL
+    M = frontier
+    L = M.bit_length() - 1
+    next_free = jnp.asarray(1, jnp.int32)
+    slot_node = jnp.zeros((1,), jnp.int32)       # root in slot 0
+    row_slot = jnp.zeros((n,), jnp.int32)
+    # exact unrolled levels: widths 1, 2, 4, ..., min(2^(depth-1), M/ --)
+    u = min(max_depth, L)
+    for t in range(u):
+        next_cap = 1 << (t + 1)                  # = 2m: no beam cap
+        tree, next_free, slot_node, row_slot = _grow_level(
+            Xb, ghw, feat_mask, tree, next_free, slot_node, row_slot,
+            m=1 << t, next_cap=next_cap, n_bins=n_bins,
+            reg_lambda=reg_lambda, gamma=gamma,
+            min_child_weight=min_child_weight)
+    # deep levels: ONE fori_loop body at fixed M slots
+    if max_depth > L:
+        def body(_, carry):
+            tree, next_free, slot_node, row_slot = carry
+            return _grow_level(Xb, ghw, feat_mask, tree, next_free,
+                               slot_node, row_slot, m=M, next_cap=M,
+                               n_bins=n_bins, reg_lambda=reg_lambda,
+                               gamma=gamma, min_child_weight=min_child_weight)
 
-        def score(Gp, Hp):
-            return (Gp * Gp).sum(axis=-1) / (Hp + reg_lambda)
-
-        gain = score(GL, HL) + score(GR, HR) - score(GT, HT)[:, None, None]  # [m,d,B]
-        valid = (HL >= min_child_weight) & (HR >= min_child_weight)
-        valid &= feat_mask[None, :, None] > 0.0
-        valid &= jnp.arange(B)[None, None, :] < B - 1  # last bin: empty right
-        gain = jnp.where(valid, gain, -jnp.inf)
-        flat = gain.reshape(m, d * B)
-        best = jnp.argmax(flat, axis=1)              # [m]
-        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
-        bf = (best // B).astype(jnp.int32)
-        bb = (best % B).astype(jnp.int32)
-        do_split = best_gain > gamma
-        sf = jnp.where(do_split, bf, -1)
-        split_feat = lax.dynamic_update_slice(split_feat, sf, (offset,))
-        split_bin = lax.dynamic_update_slice(split_bin, bb, (offset,))
-        # route rows: gather this node's split; stay put on leaves
-        nf = split_feat[node_ids]                    # [n]
-        nb = split_bin[node_ids]
-        row_bin = jnp.take_along_axis(Xb, jnp.maximum(nf, 0)[:, None], axis=1)[:, 0]
-        go_right = (row_bin > nb).astype(jnp.int32)
-        child = 2 * node_ids + 1 + go_right
-        node_ids = jnp.where((nf >= 0) & active, child, node_ids)
-    return Tree(split_feat, split_bin, leaf_val)
+        tree, next_free, slot_node, row_slot = lax.fori_loop(
+            L, max_depth, body, (tree, next_free, slot_node, row_slot))
+    return tree
 
 
 def predict_tree(Xb, tree: Tree, max_depth: int) -> jax.Array:
-    """f32[n, c] — walk the fixed-depth heap; rows rest at leaves."""
+    """f32[n, c] — pointer walk for ``max_depth`` steps; rows rest at leaves."""
+    Xb = Xb.astype(jnp.int32)
     n = Xb.shape[0]
-    node = jnp.zeros((n,), jnp.int32)
-    for _ in range(max_depth):
+    node0 = jnp.zeros((n,), jnp.int32)
+
+    def step(_, node):
         nf = tree.split_feat[node]
         nb = tree.split_bin[node]
         row_bin = jnp.take_along_axis(Xb, jnp.maximum(nf, 0)[:, None], axis=1)[:, 0]
-        child = 2 * node + 1 + (row_bin > nb).astype(jnp.int32)
-        node = jnp.where(nf >= 0, child, node)
+        child = jnp.where(row_bin > nb, tree.right[node], tree.left[node])
+        return jnp.where(nf >= 0, child, node)
+
+    node = lax.fori_loop(0, max_depth, step, node0) if max_depth > 0 else node0
     return tree.leaf_val[node]
 
 
 # ---------------------------------------------------------------------------
 # Random forest — vmap over trees
 # ---------------------------------------------------------------------------
-@functools.partial(jax.jit, static_argnames=("max_depth", "n_bins"))
+@functools.partial(jax.jit, static_argnames=("max_depth", "n_bins", "frontier"))
 def fit_forest(Xb, g, h, w_trees, feat_masks, max_depth: int, n_bins: int,
-               reg_lambda: float = 1e-6, min_child_weight: float = 1.0) -> Tree:
+               frontier: int, reg_lambda: float = 1e-6,
+               min_child_weight: float = 1.0) -> Tree:
     """Train all trees of a forest in one launch.
 
     w_trees: f32[T, n] bootstrap weights; feat_masks: f32[T, d].
@@ -201,7 +354,7 @@ def fit_forest(Xb, g, h, w_trees, feat_masks, max_depth: int, n_bins: int,
     """
 
     def one(wt, fm):
-        return grow_tree(Xb, g, h, wt, fm, max_depth, n_bins,
+        return grow_tree(Xb, g, h, wt, fm, max_depth, n_bins, frontier,
                          reg_lambda=reg_lambda, gamma=0.0,
                          min_child_weight=min_child_weight)
 
@@ -216,19 +369,20 @@ def predict_forest(Xb, forest: Tree, max_depth: int) -> jax.Array:
 
 
 def forest_chunk_size(max_depth: int, n_bins: int, d: int, c: int,
-                      budget_bytes: float = 1.5e9) -> int:
+                      frontier: int, budget_bytes: float = 1.5e9) -> int:
     """Trees per chunk so one chunk's level histograms fit the budget.
 
-    The deepest level materializes G [m, d, B, c] + H [m, d, B] per tree
-    (m = 2^max_depth); deep trees would otherwise blow HBM when many train
-    at once."""
-    per_tree = (2 ** max_depth) * n_bins * d * (c + 1) * 4
+    A level materializes G [M, d, B, c] + cumsums per tree; the x3 covers
+    the cumsum/gain temporaries."""
+    per_tree = frontier * n_bins * d * (c + 1) * 4 * 3
     return max(1, int(budget_bytes / max(per_tree, 1)))
 
 
-@functools.partial(jax.jit, static_argnames=("max_depth", "n_bins", "chunk"))
+@functools.partial(jax.jit,
+                   static_argnames=("max_depth", "n_bins", "chunk", "frontier"))
 def fit_forest_chunked(Xb, g, h, w_trees, feat_masks, mcw_trees, max_depth: int,
-                       n_bins: int, chunk: int, reg_lambda: float = 1e-6) -> Tree:
+                       n_bins: int, chunk: int, frontier: int,
+                       reg_lambda: float = 1e-6) -> Tree:
     """Train an arbitrary tree population with bounded memory: ``lax.map``
     over chunks of ``chunk`` vmapped trees — one compile, sequential chunks.
 
@@ -244,7 +398,7 @@ def fit_forest_chunked(Xb, g, h, w_trees, feat_masks, mcw_trees, max_depth: int,
         wts, fms, mcws = args
 
         def one(wt, fm, mcw):
-            return grow_tree(Xb, g, h, wt, fm, max_depth, n_bins,
+            return grow_tree(Xb, g, h, wt, fm, max_depth, n_bins, frontier,
                              reg_lambda=reg_lambda, gamma=0.0,
                              min_child_weight=mcw)
 
@@ -254,6 +408,37 @@ def fit_forest_chunked(Xb, g, h, w_trees, feat_masks, mcw_trees, max_depth: int,
                                 feat_masks.reshape(-1, chunk, d),
                                 mcw_trees.reshape(-1, chunk)))
     return jax.tree.map(lambda a: a.reshape((-1,) + a.shape[2:]), trees)
+
+
+def fit_forest_sharded(mesh, axis_name: str, Xb, g, h, w_trees, feat_masks,
+                       mcw_trees, max_depth: int, n_bins: int, chunk: int,
+                       frontier: int, reg_lambda: float = 1e-6) -> Tree:
+    """Tree-axis-sharded forest training: each mesh shard grows its slice of
+    the tree population with the memory-chunked kernel — zero communication
+    (SURVEY §2.7 axis 2; the OpValidator thread pool spread over chips).
+
+    TT must be a multiple of shards * chunk (callers pad with zero-weight
+    trees).  Returns the full forest with the tree axis sharded over
+    ``axis_name``.
+    """
+    try:
+        from jax import shard_map  # jax >= 0.6
+        no_check = {"check_vma": False}
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+        no_check = {"check_rep": False}
+    from jax.sharding import PartitionSpec as P
+
+    def local(xb, gg, hh, w, fm, mc):
+        return fit_forest_chunked(xb, gg, hh, w, fm, mc, max_depth=max_depth,
+                                  n_bins=n_bins, chunk=chunk, frontier=frontier,
+                                  reg_lambda=reg_lambda)
+
+    sm = shard_map(local, mesh=mesh,
+                   in_specs=(P(), P(), P(), P(axis_name), P(axis_name),
+                             P(axis_name)),
+                   out_specs=P(axis_name), **no_check)
+    return sm(Xb, g, h, w_trees, feat_masks, mcw_trees)
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth", "n_groups"))
@@ -281,8 +466,8 @@ def _grad_hess(loss: str, F, y, Y_onehot):
 
 
 def _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int,
-              max_depth: int, n_bins: int, eta, reg_lambda, gamma,
-              min_child_weight, base_score: float, n_classes: int
+              max_depth: int, n_bins: int, frontier: int, eta, reg_lambda,
+              gamma, min_child_weight, base_score: float, n_classes: int
               ) -> Tuple[Tree, jax.Array]:
     """Traceable boosting body shared by fit_gbt and fit_gbt_batch."""
     n = Xb.shape[0]
@@ -294,7 +479,7 @@ def _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int
     def round_fn(F, xs):
         rw, fm = xs
         g, hh = _grad_hess(loss, F, y, Y)
-        tree = grow_tree(Xb, g, hh, w * rw, fm, max_depth, n_bins,
+        tree = grow_tree(Xb, g, hh, w * rw, fm, max_depth, n_bins, frontier,
                          reg_lambda=reg_lambda, gamma=gamma,
                          min_child_weight=min_child_weight)
         F = F + eta * predict_tree(Xb, tree, max_depth)
@@ -305,9 +490,9 @@ def _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int
 
 
 @functools.partial(jax.jit, static_argnames=("loss", "n_rounds", "max_depth",
-                                             "n_bins", "n_classes"))
+                                             "n_bins", "n_classes", "frontier"))
 def fit_gbt(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int,
-            max_depth: int, n_bins: int, eta: float = 0.3,
+            max_depth: int, n_bins: int, frontier: int, eta: float = 0.3,
             reg_lambda: float = 1.0, gamma: float = 0.0,
             min_child_weight: float = 1.0, base_score: float = 0.0,
             n_classes: int = 1) -> Tuple[Tree, jax.Array]:
@@ -319,14 +504,14 @@ def fit_gbt(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int,
     Returns (stacked Tree [R, ...], final margins F [n, c]).
     """
     return _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss, n_rounds,
-                     max_depth, n_bins, eta, reg_lambda, gamma, min_child_weight,
-                     base_score, n_classes)
+                     max_depth, n_bins, frontier, eta, reg_lambda, gamma,
+                     min_child_weight, base_score, n_classes)
 
 
 @functools.partial(jax.jit, static_argnames=("loss", "n_rounds", "max_depth",
-                                             "n_bins", "n_classes"))
+                                             "n_bins", "n_classes", "frontier"))
 def fit_gbt_batch(Xb, y, w_batch, row_w_rounds, feat_mask_rounds, loss: str,
-                  n_rounds: int, max_depth: int, n_bins: int,
+                  n_rounds: int, max_depth: int, n_bins: int, frontier: int,
                   eta_b, reg_lambda_b, gamma_b, min_child_weight_b,
                   base_score_b=None, n_classes: int = 1) -> jax.Array:
     """The fold x grid boosting sweep as ONE launch (the OpValidator
@@ -345,8 +530,8 @@ def fit_gbt_batch(Xb, y, w_batch, row_w_rounds, feat_mask_rounds, loss: str,
 
     def one(w, eta, lam, gam, mcw, base):
         _, F = _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss,
-                         n_rounds, max_depth, n_bins, eta, lam, gam, mcw,
-                         base, n_classes)
+                         n_rounds, max_depth, n_bins, frontier, eta, lam, gam,
+                         mcw, base, n_classes)
         return F
 
     return jax.vmap(one)(w_batch, eta_b, reg_lambda_b, gamma_b,
@@ -376,14 +561,14 @@ def bootstrap_weights(n: int, n_trees: int, rng: np.random.Generator,
 
 def feature_masks(d: int, n_trees: int, frac: float,
                   rng: np.random.Generator) -> np.ndarray:
-    """Per-tree feature-subset masks (featureSubsetStrategy / colsample)."""
+    """Per-tree feature-subset masks (featureSubsetStrategy / colsample):
+    exactly k features per tree via a random-key threshold (vectorized)."""
     if frac >= 1.0:
         return np.ones((n_trees, d), np.float32)
     k = max(1, int(round(frac * d)))
-    masks = np.zeros((n_trees, d), np.float32)
-    for t in range(n_trees):
-        masks[t, rng.choice(d, size=k, replace=False)] = 1.0
-    return masks
+    r = rng.random((n_trees, d))
+    thresh = np.partition(r, k - 1, axis=1)[:, k - 1: k]
+    return (r <= thresh).astype(np.float32)
 
 
 def subsample_weights(n: int, n_rounds: int, frac: float,

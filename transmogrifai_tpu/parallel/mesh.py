@@ -16,7 +16,8 @@ the code below is agnostic to how many processes back the device list.
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+import contextlib
+from typing import Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -25,6 +26,46 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+
+#: the mesh the validator sweep currently runs under (see ``use_mesh``)
+_ACTIVE_MESH: Optional[Mesh] = None
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Optional[Mesh]) -> Iterator[Optional[Mesh]]:
+    """Scope a mesh for the batched fold x grid kernels.
+
+    ``OpValidator.validate`` wraps the sweep in this; every estimator's
+    ``fit_grid_folds`` consults ``active_mesh()`` and shards its candidate
+    axis over the mesh ``model`` axis — the estimator API stays unchanged,
+    and custom estimators simply run replicated."""
+    global _ACTIVE_MESH
+    prev = _ACTIVE_MESH
+    _ACTIVE_MESH = mesh
+    try:
+        yield mesh
+    finally:
+        _ACTIVE_MESH = prev
+
+
+def active_mesh() -> Optional[Mesh]:
+    return _ACTIVE_MESH
+
+
+def model_shards() -> int:
+    """Number of shards a batched sweep should pad its candidate axis to."""
+    m = _ACTIVE_MESH
+    return int(m.shape[MODEL_AXIS]) if m is not None else 1
+
+
+def auto_mesh() -> Optional[Mesh]:
+    """All local devices on the ``model`` axis (the OpValidator default) —
+    the TPU replacement for the reference's 8-thread sweep pool
+    (OpValidator.scala:373-380).  None on a single device."""
+    devs = jax.devices()
+    if len(devs) <= 1:
+        return None
+    return make_mesh(n_data=1, n_model=len(devs))
 
 
 def make_mesh(n_data: Optional[int] = None, n_model: int = 1,
@@ -58,6 +99,32 @@ def model_sharding(mesh: Mesh) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def shard_candidates(x, fill: float = 0.0) -> Tuple[jax.Array, int]:
+    """Pad axis 0 to the active mesh's model-shard count and place sharded.
+
+    Returns (device array sharded over MODEL_AXIS, original length).  With no
+    active mesh this is a plain device transfer."""
+    import jax.numpy as jnp
+
+    x = np.asarray(x)
+    mesh = active_mesh()
+    if mesh is None:
+        return jnp.asarray(x), x.shape[0]
+    padded, n = pad_to_multiple(x, mesh.shape[MODEL_AXIS], axis=0, fill=fill)
+    return jax.device_put(jnp.asarray(padded), NamedSharding(mesh, P(MODEL_AXIS))), n
+
+
+def replicate_input(x) -> jax.Array:
+    """Place an array replicated on the active mesh (no-op without one)."""
+    import jax.numpy as jnp
+
+    mesh = active_mesh()
+    arr = jnp.asarray(x)
+    if mesh is None:
+        return arr
+    return jax.device_put(arr, NamedSharding(mesh, P()))
 
 
 def pad_to_multiple(x: np.ndarray, multiple: int, axis: int = 0,

@@ -232,7 +232,16 @@ class OpApp:
     app_name: str = "OpApp"
 
     def configure_runtime(self) -> None:
-        """SparkConf/Kryo analog: JAX device/mesh/distributed setup hook."""
+        """SparkConf/Kryo analog: JAX device/mesh/distributed setup hook.
+
+        Default: pick a usable platform without hanging (the experimental TPU
+        plugin can stall indefinitely when its device tunnel is absent)."""
+        from .utils.backend import ensure_backend
+
+        platform, fallback = ensure_backend()
+        if fallback:
+            print(f"{self.app_name}: falling back to {platform} ({fallback})",
+                  file=sys.stderr)
 
     def runner(self, args: argparse.Namespace) -> OpWorkflowRunner:
         raise NotImplementedError
