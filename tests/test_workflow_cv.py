@@ -1,0 +1,120 @@
+"""Workflow-level CV (cut_dag) — the OpWorkflowCVTest analog.
+
+Reference: OpWorkflow.scala:376-455 (fitStages CV branch),
+FitStagesUtil.cutDAG:302, core/src/test/scala/com/salesforce/op/
+OpWorkflowCVTest.scala — workflow-level CV (per-fold refits of the
+label-using feature DAG) must select a comparable model to selector-level
+CV, and the cut must put label-free stages before, label-using stages
+during, and post-selector stages after.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.impl.classification.logistic import OpLogisticRegression
+from transmogrifai_tpu.impl.classification.trees import OpRandomForestClassifier
+from transmogrifai_tpu.impl.preparators.sanity_checker import SanityChecker
+from transmogrifai_tpu.impl.selector.model_selector import ModelSelector
+from transmogrifai_tpu.impl.tuning.validators import OpCrossValidation
+from transmogrifai_tpu.impl.feature.vectorizers import (OneHotVectorizer,
+                                                        RealVectorizer,
+                                                        VectorsCombiner)
+from transmogrifai_tpu.workflow import dag as dag_util
+
+
+def _df(n=400, seed=3):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(0, 1, n)
+    x2 = rng.normal(0, 1, n)
+    cat = rng.choice(["a", "b", "c"], n)
+    z = 1.3 * x1 - 0.8 * x2 + (cat == "a") * 1.0
+    y = (1 / (1 + np.exp(-z)) > rng.random(n)).astype(int)
+    return pd.DataFrame({"id": np.arange(n), "y": y, "x1": x1, "x2": x2,
+                         "cat": cat})
+
+
+def _build(selector):
+    y = FeatureBuilder("y", T.RealNN).extract(field="y").as_response()
+    x1 = FeatureBuilder("x1", T.Real).extract(field="x1").as_predictor()
+    x2 = FeatureBuilder("x2", T.Real).extract(field="x2").as_predictor()
+    cat = FeatureBuilder("cat", T.PickList).extract(field="cat").as_predictor()
+    reals = RealVectorizer().set_input(x1, x2).get_output()
+    cats = OneHotVectorizer(top_k=5, min_support=1).set_input(cat).get_output()
+    vec = VectorsCombiner().set_input(reals, cats).get_output()
+    checked = SanityChecker().set_input(y, vec).get_output()
+    pred = selector.set_input(y, checked).get_output()
+    return OpWorkflow().set_result_features(pred), pred
+
+
+def _selector(seed=11):
+    return ModelSelector(
+        validator=OpCrossValidation(Evaluators.BinaryClassification.auPR(),
+                                    num_folds=3, seed=seed),
+        splitter=None,
+        models=[
+            (OpLogisticRegression(max_iter=20),
+             [{"reg_param": 0.001, "elastic_net_param": 0.0},
+              {"reg_param": 0.1, "elastic_net_param": 0.0}]),
+            (OpRandomForestClassifier(num_trees=8, max_depth=3, seed=5),
+             [{"min_instances_per_node": 1}]),
+        ])
+
+
+def test_cut_dag_label_using_suffix():
+    """SanityChecker (label-using) is 'during'; the label-free vectorizers
+    stay 'before'; the selector terminates 'during'."""
+    wf, _ = _build(_selector())
+    cut = dag_util.cut_dag(wf.dag)
+    assert cut.model_selector is not None
+    during_names = [type(s).__name__ for layer in cut.during for s in layer]
+    assert during_names == ["SanityChecker", "ModelSelector"]
+    before_names = {type(s).__name__ for layer in cut.before for s in layer}
+    assert "SanityChecker" not in before_names
+    assert {"RealVectorizer", "OneHotVectorizer", "VectorsCombiner"} <= before_names
+    assert cut.after == []
+
+
+def test_workflow_cv_equivalent_to_selector_cv():
+    df = _df()
+    wf_cv, pred_cv = _build(_selector())
+    m_cv = wf_cv.with_workflow_cv().set_input_dataset(df, key="id").train()
+
+    wf_plain, pred_plain = _build(_selector())
+    m_plain = wf_plain.set_input_dataset(df, key="id").train()
+
+    sel_cv = next(s for s in m_cv.stages if hasattr(s, "summary") and s.summary)
+    sel_plain = next(s for s in m_plain.stages
+                     if hasattr(s, "summary") and s.summary)
+    s_cv, s_plain = sel_cv.summary, sel_plain.summary
+
+    # workflow-CV ran: validation type marks it, per-fold metrics recorded
+    assert s_cv.validation_type.startswith("workflow-")
+    assert all(len(r["foldMetrics"]) == 3 for r in s_cv.validation_results)
+    # OpWorkflowCVTest contract: same winner, comparable metric
+    assert s_cv.best_model_name == s_plain.best_model_name
+    v_cv = max(r["metricValue"] for r in s_cv.validation_results)
+    v_plain = max(r["metricValue"] for r in s_plain.validation_results)
+    assert abs(v_cv - v_plain) < 0.1, (v_cv, v_plain)
+    # both models score
+    sc = m_cv.score()
+    assert len(sc[pred_cv.name].prediction) == len(df)
+
+
+def test_workflow_cv_without_label_using_ancestors_falls_back():
+    """No SanityChecker: nothing can leak, so the selector's own batched CV
+    runs (reference firstCVTSIndex == -1 branch)."""
+    df = _df()
+    y = FeatureBuilder("y", T.RealNN).extract(field="y").as_response()
+    x1 = FeatureBuilder("x1", T.Real).extract(field="x1").as_predictor()
+    x2 = FeatureBuilder("x2", T.Real).extract(field="x2").as_predictor()
+    vec = RealVectorizer().set_input(x1, x2).get_output()
+    sel = _selector()
+    pred = sel.set_input(y, vec).get_output()
+    wf = OpWorkflow().set_result_features(pred).with_workflow_cv()
+    model = wf.set_input_dataset(df, key="id").train()
+    stage = next(s for s in model.stages if hasattr(s, "summary") and s.summary)
+    assert not stage.summary.validation_type.startswith("workflow-")
+    assert stage.summary.best_model_name

@@ -117,6 +117,11 @@ class ModelSelector(BinaryEstimator, AllowLabelAsInput):
             raise ValueError("ModelSelector needs at least one candidate model")
         self.evaluators = list(evaluators)
         self.validation_summary: Optional[ValidationSummary] = None
+        #: pre-selected (estimator, grid, summary) from workflow-level CV —
+        #: when set, ``fit`` skips its own validation sweep and refits this
+        #: winner (reference ``bestEstimator``, ModelSelector.scala:116,145)
+        self.best_estimator: Optional[Tuple[PredictorEstimator, Dict[str, Any],
+                                            ValidationSummary]] = None
 
     def check_input_types(self, features) -> None:
         super().check_input_types(features)
@@ -137,6 +142,86 @@ class ModelSelector(BinaryEstimator, AllowLabelAsInput):
         best = summary.best
         est = next(e for e, _ in self.models if e.uid == best.model_uid)
         return est, best.grid, summary
+
+    # ---- workflow-level CV (OpWorkflow.scala:403-453) ----------------------
+    def find_best_estimator_cv(self, during_layers, ds: Dataset
+                               ) -> Tuple[PredictorEstimator, Dict[str, Any],
+                                          ValidationSummary]:
+        """Leakage-free sweep: per CV fold, REFIT the selector's upstream
+        feature estimators (``during_layers``) on the fold's training rows
+        only, transform the fold's validation rows with those fold-fitted
+        models, and sweep the candidate grid on the fold-local features.
+
+        Reference: OpValidator.applyDAG per-fold feature-DAG refit
+        (OpValidator.scala:250) driven from OpWorkflow.fitStages
+        (OpWorkflow.scala:403-453); equivalence with selector-level CV is the
+        OpWorkflowCVTest contract.
+        """
+        from ...parallel.mesh import use_mesh
+        from ...workflow import dag as dag_util
+
+        label_f, vec_f = self.inputs
+        lab = ds[label_f.name]
+        if not lab.mask.all():  # unlabeled rows never train or validate
+            ds = ds.take(np.where(lab.mask)[0])
+        y_all = ds[label_f.name].values.astype(np.float32)
+        n = len(y_all)
+        v = self.validator
+        train_w, val_mask = v.make_folds(n, y_all if v.stratify else None)
+
+        fold_summaries = []
+        with use_mesh(v._resolve_mesh()):
+            for f in range(train_w.shape[0]):
+                tr_idx = np.where(train_w[f] > 0)[0]
+                va_idx = np.where(val_mask[f])[0]
+                ds_tr = ds.take(tr_idx)
+                fitted = dag_util.fit_and_transform_dag(during_layers, ds_tr)
+                by_uid = {s.uid: s for s in fitted.fitted_stages}
+                models_dag = [[by_uid[s.uid] for s in layer]
+                              for layer in during_layers]
+                ds_va = dag_util.apply_transformations_dag(ds.take(va_idx),
+                                                           models_dag)
+                Xtr = fitted.train[vec_f.name].values
+                Xva = ds_va[vec_f.name].values
+                ytr, yva = y_all[tr_idx], y_all[va_idx]
+                prep_w = (self.splitter.prepare_weights(ytr)
+                          if self.splitter is not None else
+                          np.ones(len(ytr), np.float32))
+                X = np.vstack([Xtr, Xva]).astype(np.float32)
+                y = np.concatenate([ytr, yva])
+                w_row = np.concatenate([prep_w,
+                                        np.zeros(len(yva), np.float32)])
+                vm = np.zeros(len(y), dtype=bool)
+                vm[len(ytr):] = True
+                s = ValidationSummary(
+                    validation_type=f"workflow-{v.validation_type}",
+                    evaluator_name=v.evaluator.name,
+                    metric_name=v.evaluator.default_metric,
+                    is_larger_better=v.evaluator.is_larger_better)
+                v._sweep(self.models, X, y, w_row[None, :], vm[None, :], s)
+                fold_summaries.append(s)
+
+        merged = fold_summaries[0]
+        for s in fold_summaries[1:]:
+            for acc, r in zip(merged.results, s.results):
+                acc.fold_metrics.extend(r.fold_metrics)
+                if r.error and not acc.error:
+                    acc.error = r.error
+        for acc in merged.results:
+            if acc.fold_metrics and not acc.error:
+                acc.metric_value = float(np.mean(acc.fold_metrics))
+            else:
+                acc.metric_value = (-np.inf if v.evaluator.is_larger_better
+                                    else np.inf)
+        if all(r.error for r in merged.results):
+            raise RuntimeError("All models in the workflow-CV grid failed to fit")
+        vals = [r.metric_value for r in merged.results]
+        merged.best_index = int(np.argmax(vals) if v.evaluator.is_larger_better
+                                else np.argmin(vals))
+        best = merged.best
+        est = next(e for e, _ in self.models if e.uid == best.model_uid)
+        self.best_estimator = (est, best.grid, merged)
+        return self.best_estimator
 
     # ---- fit (ModelSelector.scala:145) -------------------------------------
     def fit_columns(self, cols: Sequence[Column], dataset: Dataset) -> "SelectedModel":
@@ -161,8 +246,11 @@ class ModelSelector(BinaryEstimator, AllowLabelAsInput):
             prep_summary = self.splitter.pre_validation_prepare(ytr)
             prep_w = self.splitter.prepare_weights(ytr)
 
-        # 3. the sweep
-        best_est, best_grid, vsummary = self.find_best_estimator(Xtr, ytr, prep_w)
+        # 3. the sweep (skipped when workflow-level CV already chose a winner)
+        if self.best_estimator is not None:
+            best_est, best_grid, vsummary = self.best_estimator
+        else:
+            best_est, best_grid, vsummary = self.find_best_estimator(Xtr, ytr, prep_w)
         self.validation_summary = vsummary
 
         # 4. final refit on the full prepared train (validationPrepare ->

@@ -149,10 +149,17 @@ class CutDAG:
 
 
 def cut_dag(dag: List[Layer]) -> CutDAG:
-    """Split for workflow-level CV (FitStagesUtil.cutDAG:302): everything at
-    distances > the selector's layer is 'before' (fit once), the selector's
-    ancestors within closer layers form 'during' (refit per fold), the rest
-    'after'.  At most one ModelSelector allowed (:310)."""
+    """Split for workflow-level CV (FitStagesUtil.cutDAG:302).
+
+    Reference semantics: 'during' (refit per fold) is the suffix of the
+    selector's ancestor sub-DAG starting at the FIRST layer containing a
+    label-using stage (inputs mix response and predictors — e.g. a
+    SanityChecker); label-free feature engineering cannot leak the label, so
+    it fits once in 'before' (:330-344 firstCVTSIndex).  Whole layers are
+    taken from that point, so transformers downstream of refit estimators
+    refit too.  Layers closer to the result than the selector are 'after'.
+    The selector itself terminates 'during'.  At most one ModelSelector
+    (:310)."""
     selectors = [(i, s) for i, layer in enumerate(dag) for s in layer
                  if getattr(s, "is_model_selector", False)]
     if not selectors:
@@ -161,29 +168,19 @@ def cut_dag(dag: List[Layer]) -> CutDAG:
         raise ValueError(
             f"Only one ModelSelector is supported per workflow, found {len(selectors)}")
     idx, selector = selectors[0]
-    # ancestors of the selector (stages its inputs depend on)
-    ancestor_uids: Set[str] = set()
-    for f in selector.inputs:
-        for st in f.parent_stages():
-            ancestor_uids.add(st.uid)
+    # the selector's ancestor sub-DAG (farthest first, selector not included)
+    anc = compute_dag(list(selector.inputs))
+    ci = next((i for i, layer in enumerate(anc) for s in layer
+               if any(f.is_response for f in s.inputs)
+               and any(not f.is_response for f in s.inputs)), None)
+    during_feats: List[Layer] = [list(l) for l in anc[ci:]] if ci is not None else []
+    during_uids: Set[str] = {s.uid for layer in during_feats for s in layer}
+
     before: List[Layer] = []
-    during: List[Layer] = []
-    after: List[Layer] = []
-    for i, layer in enumerate(dag):
-        if i < idx:
-            # estimator ancestors of the selector refit per fold; pure
-            # transformers and non-ancestors fit/apply once up front
-            dur = [s for s in layer if s.uid in ancestor_uids and isinstance(s, Estimator)]
-            bef = [s for s in layer if s not in dur]
-            if bef:
-                before.append(bef)
-            if dur:
-                during.append(dur)
-        elif i == idx:
-            rest = [s for s in layer if s is not selector]
-            if rest:
-                after.append(rest)
-            during.append([selector])
-        else:
-            after.append(list(layer))
-    return CutDAG(selector, before=before, during=during, after=after)
+    for layer in dag[:idx + 1]:
+        keep = [s for s in layer if s is not selector and s.uid not in during_uids]
+        if keep:
+            before.append(keep)
+    after: List[Layer] = [list(l) for l in dag[idx + 1:]]
+    return CutDAG(selector, before=before,
+                  during=during_feats + [[selector]], after=after)

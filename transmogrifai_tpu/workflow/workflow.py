@@ -78,6 +78,16 @@ class OpWorkflow(OpWorkflowCore):
         self.raw_feature_filter = None  # set by with_raw_feature_filter
         self._fitted_stage_map: Dict[str, PipelineStage] = {}
         self.rff_results = None
+        self.workflow_cv = False  # set by with_workflow_cv
+
+    def with_workflow_cv(self) -> "OpWorkflow":
+        """Enable workflow-level cross-validation (OpWorkflow.scala:376-455):
+        ``train()`` cuts the DAG around the ModelSelector (cut_dag), fits the
+        before-DAG once, per fold REFITS the selector's upstream feature
+        estimators on the fold-train rows only (leakage-free), sweeps the
+        grid, then fits the full during+after DAG with the chosen winner."""
+        self.workflow_cv = True
+        return self
 
     # ---- DAG setup ---------------------------------------------------------
     def set_result_features(self, *features: Feature) -> "OpWorkflow":
@@ -136,8 +146,11 @@ class OpWorkflow(OpWorkflowCore):
                 self._set_blocklist(result.dropped_features, result.dropped_map_keys)
                 data = result.clean(data)
 
-        fitted = dag_util.fit_and_transform_dag(
-            self.dag, data, fitted_so_far=self._fitted_stage_map)
+        if self.workflow_cv:
+            fitted = self._fit_stages_cv(data)
+        else:
+            fitted = dag_util.fit_and_transform_dag(
+                self.dag, data, fitted_so_far=self._fitted_stage_map)
 
         model = OpWorkflowModel()
         model.reader = self.reader
@@ -174,6 +187,32 @@ class OpWorkflow(OpWorkflowCore):
                             f"RawFeatureFilter dropped all inputs of stage {stage.uid}")
                     stage.inputs = kept_inputs
         self.raw_features = keep
+
+    def _fit_stages_cv(self, data: Dataset) -> dag_util.FittedDAG:
+        """The workflow-level CV path (OpWorkflow.fitStages CV branch,
+        OpWorkflow.scala:403-453): cut_dag -> fit before-DAG once ->
+        ModelSelector.find_best_estimator_cv (per-fold during-DAG refits) ->
+        fit during+after DAG with the winner pinned."""
+        cut = dag_util.cut_dag(self.dag)
+        if cut.model_selector is None:
+            return dag_util.fit_and_transform_dag(
+                self.dag, data, fitted_so_far=self._fitted_stage_map)
+        before = dag_util.fit_and_transform_dag(
+            cut.before, data, fitted_so_far=self._fitted_stage_map)
+        selector = cut.model_selector
+        feature_layers = [layer for layer in cut.during
+                          if not (len(layer) == 1 and layer[0] is selector)]
+        if feature_layers:
+            selector.find_best_estimator_cv(feature_layers, before.train)
+        # no label-using ancestors: nothing can leak — the selector's own
+        # batched weight-mask CV is equivalent and faster (reference
+        # firstCVTSIndex == -1 branch)
+        rest = dag_util.fit_and_transform_dag(
+            cut.during + cut.after, before.train,
+            fitted_so_far=self._fitted_stage_map)
+        return dag_util.FittedDAG(
+            train=rest.train, test=None,
+            fitted_stages=before.fitted_stages + rest.fitted_stages)
 
     # ---- partial materialization (OpWorkflow.scala:498) --------------------
     def compute_data_up_to(self, *features: Feature,
