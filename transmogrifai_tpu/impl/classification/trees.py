@@ -75,12 +75,14 @@ class OpRandomForestClassifier(_TreeClassifierBase):
     """Gini-equivalent histogram forest with class-distribution leaves."""
 
     def __init__(self, num_trees: int = 20, max_depth: int = 5, max_bins: int = 32,
-                 min_instances_per_node: int = 1, subsampling_rate: float = 1.0,
+                 min_instances_per_node: int = 1, min_info_gain: float = 0.0,
+                 subsampling_rate: float = 1.0,
                  feature_subset_strategy: str = "auto", impurity: str = "gini",
                  seed: int = 42, uid: Optional[str] = None, **extra):
         super().__init__(operation_name="OpRandomForestClassifier", uid=uid,
                          num_trees=num_trees, max_depth=max_depth, max_bins=max_bins,
                          min_instances_per_node=min_instances_per_node,
+                         min_info_gain=min_info_gain,
                          subsampling_rate=subsampling_rate,
                          feature_subset_strategy=feature_subset_strategy,
                          impurity=impurity, seed=seed, **extra)
@@ -105,7 +107,9 @@ class OpRandomForestClassifier(_TreeClassifierBase):
                                jnp.asarray(wt), jnp.asarray(fms),
                                max_depth=depth, n_bins=n_bins,
                                frontier=self._frontier(n, depth, mcw, 1.0),
-                               min_child_weight=mcw)
+                               min_child_weight=mcw,
+                               min_info_gain=float(
+                                   self.get_param("min_info_gain", 0.0)))
         forest = self._expand_binary_leaves(forest, k)
         return tree_params(forest, edges=edges, max_depth=depth, num_classes=k,
                            num_trees=n_trees)
@@ -140,7 +144,8 @@ class OpDecisionTreeClassifier(OpRandomForestClassifier):
     """Single gini tree (num_trees=1, no bagging/subsetting)."""
 
     def __init__(self, max_depth: int = 5, max_bins: int = 32,
-                 min_instances_per_node: int = 1, impurity: str = "gini",
+                 min_instances_per_node: int = 1, min_info_gain: float = 0.0,
+                 impurity: str = "gini",
                  seed: int = 42, uid: Optional[str] = None, **extra):
         # drop fixed-by-construction params resurfacing via copy_with_params
         for k in ("num_trees", "feature_subset_strategy", "subsampling_rate",
@@ -148,6 +153,7 @@ class OpDecisionTreeClassifier(OpRandomForestClassifier):
             extra.pop(k, None)
         super().__init__(num_trees=1, max_depth=max_depth, max_bins=max_bins,
                          min_instances_per_node=min_instances_per_node,
+                         min_info_gain=min_info_gain,
                          subsampling_rate=1.0, feature_subset_strategy="all",
                          impurity=impurity, seed=seed, uid=uid, **extra)
         self.operation_name = "OpDecisionTreeClassifier"
@@ -167,7 +173,9 @@ class OpDecisionTreeClassifier(OpRandomForestClassifier):
                                jnp.asarray(sw[None, :]), jnp.asarray(np.ones((1, d), np.float32)),
                                max_depth=depth, n_bins=n_bins,
                                frontier=self._frontier(n, depth, mcw, 1.0),
-                               min_child_weight=mcw)
+                               min_child_weight=mcw,
+                               min_info_gain=float(
+                                   self.get_param("min_info_gain", 0.0)))
         forest = self._expand_binary_leaves(forest, k)
         return tree_params(forest, edges=edges, max_depth=depth, num_classes=k,
                            num_trees=1)
@@ -200,7 +208,8 @@ class _BoostedClassifierBase(_TreeClassifierBase):
                               eta=bp["eta"],
                               reg_lambda=bp["reg_lambda"], gamma=bp["gamma"],
                               min_child_weight=bp["min_child_weight"],
-                              n_classes=k)
+                              n_classes=k,
+                              min_info_gain=bp.get("min_info_gain", 0.0))
         return tree_params(trees, edges=edges, max_depth=bp["max_depth"],
                            eta=bp["eta"], num_classes=k, loss=loss)
 
@@ -247,12 +256,13 @@ class OpGBTClassifier(_BoostedClassifierBase):
 
     def __init__(self, max_iter: int = 20, max_depth: int = 5, max_bins: int = 32,
                  step_size: float = 0.1, subsampling_rate: float = 1.0,
-                 min_instances_per_node: int = 1, seed: int = 42,
-                 uid: Optional[str] = None, **extra):
+                 min_instances_per_node: int = 1, min_info_gain: float = 0.0,
+                 seed: int = 42, uid: Optional[str] = None, **extra):
         super().__init__(operation_name="OpGBTClassifier", uid=uid,
                          max_iter=max_iter, max_depth=max_depth, max_bins=max_bins,
                          step_size=step_size, subsampling_rate=subsampling_rate,
-                         min_instances_per_node=min_instances_per_node, seed=seed,
+                         min_instances_per_node=min_instances_per_node,
+                         min_info_gain=min_info_gain, seed=seed,
                          **extra)
 
     def _boost_params(self):

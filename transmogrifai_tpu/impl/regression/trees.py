@@ -39,12 +39,14 @@ class _TreeRegressorBase(TreeParamsMixin, PredictorEstimator):
 
 class OpRandomForestRegressor(_TreeRegressorBase):
     def __init__(self, num_trees: int = 20, max_depth: int = 5, max_bins: int = 32,
-                 min_instances_per_node: int = 1, subsampling_rate: float = 1.0,
+                 min_instances_per_node: int = 1, min_info_gain: float = 0.0,
+                 subsampling_rate: float = 1.0,
                  feature_subset_strategy: str = "auto", impurity: str = "variance",
                  seed: int = 42, uid: Optional[str] = None, **extra):
         super().__init__(operation_name="OpRandomForestRegressor", uid=uid,
                          num_trees=num_trees, max_depth=max_depth, max_bins=max_bins,
                          min_instances_per_node=min_instances_per_node,
+                         min_info_gain=min_info_gain,
                          subsampling_rate=subsampling_rate,
                          feature_subset_strategy=feature_subset_strategy,
                          impurity=impurity, seed=seed, **extra)
@@ -68,7 +70,9 @@ class OpRandomForestRegressor(_TreeRegressorBase):
                                jnp.asarray(wt), jnp.asarray(fms),
                                max_depth=depth, n_bins=n_bins,
                                frontier=self._frontier(n, depth, mcw),
-                               min_child_weight=mcw)
+                               min_child_weight=mcw,
+                               min_info_gain=float(
+                                   self.get_param("min_info_gain", 0.0)))
         return tree_params(forest, edges=edges, max_depth=depth)
 
     @classmethod
@@ -90,14 +94,15 @@ class OpRandomForestRegressor(_TreeRegressorBase):
 
 class OpDecisionTreeRegressor(OpRandomForestRegressor):
     def __init__(self, max_depth: int = 5, max_bins: int = 32,
-                 min_instances_per_node: int = 1, seed: int = 42,
-                 uid: Optional[str] = None, **extra):
+                 min_instances_per_node: int = 1, min_info_gain: float = 0.0,
+                 seed: int = 42, uid: Optional[str] = None, **extra):
         # drop fixed-by-construction params resurfacing via copy_with_params
         for k in ("num_trees", "feature_subset_strategy", "subsampling_rate",
                   "impurity"):
             extra.pop(k, None)
         super().__init__(num_trees=1, max_depth=max_depth, max_bins=max_bins,
                          min_instances_per_node=min_instances_per_node,
+                         min_info_gain=min_info_gain,
                          feature_subset_strategy="all", seed=seed, uid=uid, **extra)
         self.operation_name = "OpDecisionTreeRegressor"
 
@@ -114,7 +119,9 @@ class OpDecisionTreeRegressor(OpRandomForestRegressor):
                                jnp.asarray(np.ones((1, d), np.float32)),
                                max_depth=depth, n_bins=n_bins,
                                frontier=self._frontier(n, depth, mcw),
-                               min_child_weight=mcw)
+                               min_child_weight=mcw,
+                               min_info_gain=float(
+                                   self.get_param("min_info_gain", 0.0)))
         return tree_params(forest, edges=edges, max_depth=depth)
 
 
@@ -143,7 +150,8 @@ class _BoostedRegressorBase(_TreeRegressorBase):
                               eta=bp["eta"], reg_lambda=bp["reg_lambda"],
                               gamma=bp["gamma"],
                               min_child_weight=bp["min_child_weight"],
-                              base_score=base)
+                              base_score=base,
+                              min_info_gain=bp.get("min_info_gain", 0.0))
         return tree_params(trees, edges=edges, max_depth=bp["max_depth"],
                            eta=bp["eta"], base_score=base)
 
@@ -168,12 +176,13 @@ class _BoostedRegressorBase(_TreeRegressorBase):
 class OpGBTRegressor(_BoostedRegressorBase):
     def __init__(self, max_iter: int = 20, max_depth: int = 5, max_bins: int = 32,
                  step_size: float = 0.1, subsampling_rate: float = 1.0,
-                 min_instances_per_node: int = 1, seed: int = 42,
-                 uid: Optional[str] = None, **extra):
+                 min_instances_per_node: int = 1, min_info_gain: float = 0.0,
+                 seed: int = 42, uid: Optional[str] = None, **extra):
         super().__init__(operation_name="OpGBTRegressor", uid=uid,
                          max_iter=max_iter, max_depth=max_depth, max_bins=max_bins,
                          step_size=step_size, subsampling_rate=subsampling_rate,
-                         min_instances_per_node=min_instances_per_node, seed=seed,
+                         min_instances_per_node=min_instances_per_node,
+                         min_info_gain=min_info_gain, seed=seed,
                          **extra)
 
     def _boost_params(self):

@@ -51,12 +51,18 @@ def linear_regression_grid() -> List[Dict[str, Any]]:
 
 
 def random_forest_grid() -> List[Dict[str, Any]]:
-    return grid(max_depth=MAX_DEPTH, min_instances_per_node=MIN_INSTANCES_PER_NODE,
+    # MaxDepth(3) x MinInfoGain(3) x MinInstancesPerNode(2) x MaxTrees(1) = 18
+    # candidates (BinaryClassificationModelSelector.scala:81-87)
+    return grid(max_depth=MAX_DEPTH, min_info_gain=MIN_INFO_GAIN,
+                min_instances_per_node=MIN_INSTANCES_PER_NODE,
                 num_trees=MAX_TREES)
 
 
 def gbt_grid() -> List[Dict[str, Any]]:
-    return grid(max_depth=MAX_DEPTH, min_instances_per_node=MIN_INSTANCES_PER_NODE,
+    # MaxDepth(3) x MinInfoGain(3) x MinInstancesPerNode(2) = 18 candidates
+    # (BinaryClassificationModelSelector.scala:90-98)
+    return grid(max_depth=MAX_DEPTH, min_info_gain=MIN_INFO_GAIN,
+                min_instances_per_node=MIN_INSTANCES_PER_NODE,
                 max_iter=MAX_ITER_TREE, step_size=STEP_SIZE)
 
 
@@ -74,7 +80,9 @@ def naive_bayes_grid() -> List[Dict[str, Any]]:
 
 
 def decision_tree_grid() -> List[Dict[str, Any]]:
-    return grid(max_depth=MAX_DEPTH, min_instances_per_node=MIN_INSTANCES_PER_NODE)
+    # MaxDepth(3) x MinInfoGain(3) x MinInstancesPerNode(2) = 18 candidates
+    return grid(max_depth=MAX_DEPTH, min_info_gain=MIN_INFO_GAIN,
+                min_instances_per_node=MIN_INSTANCES_PER_NODE)
 
 
 class RandomParamBuilder:

@@ -84,13 +84,15 @@ def gbt_boost_params(stage) -> Dict[str, Any]:
             "eta": float(stage.get_param("step_size", 0.1)),
             "subsample": float(stage.get_param("subsampling_rate", 1.0)),
             "colsample": 1.0, "reg_lambda": 1e-6, "gamma": 0.0,
-            "min_child_weight": float(stage.get_param("min_instances_per_node", 1))}
+            "min_child_weight": float(stage.get_param("min_instances_per_node", 1)),
+            "min_info_gain": float(stage.get_param("min_info_gain", 0.0))}
 
 
 #: boosting hyperparameters that are traced scalars in the kernel — grids
 #: varying only these batch into one launch
 _DYNAMIC_BOOST_KEYS = ("eta", "step_size", "reg_lambda", "gamma",
-                       "min_child_weight", "min_instances_per_node")
+                       "min_child_weight", "min_instances_per_node",
+                       "min_info_gain")
 
 
 def boosted_grid_folds(est, X, y, train_w, grids, loss: str, n_classes: int,
@@ -146,6 +148,7 @@ def boosted_grid_folds(est, X, y, train_w, grids, loss: str, n_classes: int,
         lam_b = np.empty(B, np.float32)
         gam_b = np.empty(B, np.float32)
         mcw_b = np.empty(B, np.float32)
+        mig_b = np.zeros(B, np.float32)
         base_b = np.zeros(B, np.float32)
         yf = np.asarray(y, np.float32)
         for bi, (f, ci) in enumerate((f, ci) for f in range(n_folds) for ci in cis):
@@ -155,6 +158,7 @@ def boosted_grid_folds(est, X, y, train_w, grids, loss: str, n_classes: int,
             lam_b[bi] = max(bp["reg_lambda"], 1e-6)
             gam_b[bi] = bp["gamma"]
             mcw_b[bi] = bp["min_child_weight"]
+            mig_b[bi] = bp.get("min_info_gain", 0.0)
             if fold_base_score:  # regression starts from the fold's label mean
                 wsum = max(float(train_w[f].sum()), 1e-12)
                 base_b[bi] = float((yf * train_w[f]).sum() / wsum)
@@ -167,6 +171,7 @@ def boosted_grid_folds(est, X, y, train_w, grids, loss: str, n_classes: int,
         lam_dev, _ = shard_candidates(lam_b, fill=1.0)
         gam_dev, _ = shard_candidates(gam_b, fill=0.0)
         mcw_dev, _ = shard_candidates(mcw_b, fill=1.0)
+        mig_dev, _ = shard_candidates(mig_b, fill=0.0)
         base_dev, _ = shard_candidates(base_b, fill=0.0)
         F = Tr.fit_gbt_batch(
             replicate_input(Xb), replicate_input(yf),
@@ -175,7 +180,8 @@ def boosted_grid_folds(est, X, y, train_w, grids, loss: str, n_classes: int,
             frontier=frontier,
             eta_b=eta_dev, reg_lambda_b=lam_dev,
             gamma_b=gam_dev, min_child_weight_b=mcw_dev,
-            base_score_b=base_dev, n_classes=n_classes)
+            base_score_b=base_dev, n_classes=n_classes,
+            min_info_gain_b=mig_dev)
         F = np.asarray(F)[:B]
         for bi, (f, ci) in enumerate((f, ci) for f in range(n_folds) for ci in cis):
             out[f][ci] = convert(F[bi])
@@ -185,7 +191,7 @@ def boosted_grid_folds(est, X, y, train_w, grids, loss: str, n_classes: int,
 #: forest grid keys that batch (host-side or per-tree traced)
 _FOREST_GRID_KEYS = ("max_depth", "num_trees", "min_instances_per_node",
                      "subsampling_rate", "feature_subset_strategy", "max_bins",
-                     "impurity")
+                     "impurity", "min_info_gain")
 
 
 def forest_grid_folds(est, X, y, train_w, grids, n_classes: int, convert) -> list:
@@ -240,6 +246,7 @@ def forest_grid_folds(est, X, y, train_w, grids, n_classes: int, convert) -> lis
         w_trees = np.empty((TT, n), np.float32)
         fms = np.empty((TT, d), np.float32)
         mcw = np.empty(TT, np.float32)
+        mig = np.zeros(TT, np.float32)
         for gi, (f, ci) in enumerate(pairs):
             cand = candidates[ci]
             rng = np.random.default_rng(int(cand.get_param("seed", 42)))
@@ -255,6 +262,8 @@ def forest_grid_folds(est, X, y, train_w, grids, n_classes: int, convert) -> lis
             fms[gi * n_trees:(gi + 1) * n_trees] = fm
             mcw[gi * n_trees:(gi + 1) * n_trees] = float(
                 cand.get_param("min_instances_per_node", 1))
+            mig[gi * n_trees:(gi + 1) * n_trees] = float(
+                cand.get_param("min_info_gain", 0.0))
         from ..parallel.mesh import MODEL_AXIS, active_mesh, model_shards
 
         n_shard = model_shards()
@@ -265,18 +274,20 @@ def forest_grid_folds(est, X, y, train_w, grids, n_classes: int, convert) -> lis
             w_trees = np.concatenate([w_trees, np.zeros((pad, n), np.float32)])
             fms = np.concatenate([fms, np.ones((pad, d), np.float32)])
             mcw = np.concatenate([mcw, np.ones(pad, np.float32)])
+            mig = np.concatenate([mig, np.zeros(pad, np.float32)])
         if n_shard > 1:  # tree axis spread over the mesh model axis
             forest = Tr.fit_forest_sharded(
                 active_mesh(), MODEL_AXIS, jnp.asarray(Xb), jnp.asarray(G),
                 jnp.asarray(H), jnp.asarray(w_trees), jnp.asarray(fms),
                 jnp.asarray(mcw), max_depth=max_depth, n_bins=n_bins,
-                chunk=chunk, frontier=frontier)
+                chunk=chunk, frontier=frontier, mig_trees=jnp.asarray(mig))
             forest = jax.tree.map(lambda a: jnp.asarray(np.asarray(a)), forest)
         else:
             forest = Tr.fit_forest_chunked(
                 jnp.asarray(Xb), jnp.asarray(G), jnp.asarray(H), jnp.asarray(w_trees),
                 jnp.asarray(fms), jnp.asarray(mcw), max_depth=max_depth,
-                n_bins=n_bins, chunk=chunk, frontier=frontier)
+                n_bins=n_bins, chunk=chunk, frontier=frontier,
+                mig_trees=jnp.asarray(mig))
         if pad:
             forest = jax.tree.map(lambda a: a[:TT], forest)
         dist = np.asarray(Tr.predict_forest_groups(jnp.asarray(Xb), forest,

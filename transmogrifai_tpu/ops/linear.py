@@ -494,3 +494,20 @@ def predict_svc(X, coef, intercept):
     raw = jnp.stack([-z, z], axis=-1)
     pred = (z >= 0.0).astype(jnp.float32)
     return raw, pred
+
+
+# ---------------------------------------------------------------------------
+# FLOPs accounting (bench MFU): wrap the sweep payload kernels so every call
+# records its XLA cost_analysis when utils.flops is enabled — call sites
+# stay untouched; overhead is one `if` per call otherwise.
+# ---------------------------------------------------------------------------
+from ..utils import flops as _flops  # noqa: E402
+
+for _n in ("fit_logistic_grid_folds_newton", "fit_ridge_grid_folds",
+           "fit_logistic_grid_folds_fista", "fit_softmax_grid_folds",
+           "fit_linear_grid_folds_fista", "fit_svc_grid_folds",
+           "predict_binary_logistic_grid", "predict_softmax_grid",
+           "fit_logistic_newton", "fit_logistic_fista", "fit_softmax",
+           "fit_ridge", "fit_linear_fista", "fit_linear_svc", "fit_glm_irls"):
+    globals()[_n] = _flops.wrap(f"linear.{_n}", globals()[_n])
+del _n

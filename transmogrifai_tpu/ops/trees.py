@@ -179,7 +179,7 @@ def _level_histograms(Xb, ghw, row_slot, m: int, n_bins: int):
 
 def _grow_level(Xb, ghw, feat_mask, tree: Tree, next_free, slot_node,
                 row_slot, m: int, next_cap: int, n_bins: int, reg_lambda,
-                gamma, min_child_weight):
+                gamma, min_child_weight, min_info_gain=0.0):
     """One breadth-first level over an ``m``-slot frontier.
 
     Returns (tree', next_free', slot_node'[next_cap], row_slot').  ``m`` and
@@ -215,7 +215,11 @@ def _grow_level(Xb, ghw, feat_mask, tree: Tree, next_free, slot_node,
     best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
     bf = (best // B).astype(jnp.int32)
     bb = (best % B).astype(jnp.int32)
-    do_split = (best_gain > gamma) & in_use
+    # Spark minInfoGain parity: our gain is the total-sum-of-squares drop,
+    # which equals node_weight * Spark's per-row impurity decrease for both
+    # gini (g=-onehot) and variance (g=-y) trees — so the per-row threshold
+    # scales by the node's hessian total (DefaultSelectorParams.MinInfoGain).
+    do_split = (best_gain > gamma) & (best_gain >= min_info_gain * HT) & in_use
     if next_cap < 2 * m:  # beam cap: keep top next_cap//2 splits by gain
         order = jnp.argsort(-jnp.where(do_split, best_gain, -jnp.inf))
         rank = jnp.zeros((m,), jnp.int32).at[order].set(jnp.arange(m))
@@ -263,7 +267,7 @@ def _grow_level(Xb, ghw, feat_mask, tree: Tree, next_free, slot_node,
 
 def grow_tree(Xb, g, h, w, feat_mask, max_depth: int, n_bins: int,
               frontier: int, reg_lambda: float = 1.0, gamma: float = 0.0,
-              min_child_weight: float = 1.0) -> Tree:
+              min_child_weight: float = 1.0, min_info_gain=0.0) -> Tree:
     """Grow one second-order histogram tree (traceable; static shapes).
 
     Xb: int[n, d] pre-binned features; g: f32[n, c] gradients; h: f32[n]
@@ -308,7 +312,7 @@ def grow_tree(Xb, g, h, w, feat_mask, max_depth: int, n_bins: int,
             Xb, ghw, feat_mask, tree, next_free, slot_node, row_slot,
             m=1 << t, next_cap=next_cap, n_bins=n_bins,
             reg_lambda=reg_lambda, gamma=gamma,
-            min_child_weight=min_child_weight)
+            min_child_weight=min_child_weight, min_info_gain=min_info_gain)
     # deep levels: ONE fori_loop body at fixed M slots
     if max_depth > L:
         def body(_, carry):
@@ -316,7 +320,8 @@ def grow_tree(Xb, g, h, w, feat_mask, max_depth: int, n_bins: int,
             return _grow_level(Xb, ghw, feat_mask, tree, next_free,
                                slot_node, row_slot, m=M, next_cap=M,
                                n_bins=n_bins, reg_lambda=reg_lambda,
-                               gamma=gamma, min_child_weight=min_child_weight)
+                               gamma=gamma, min_child_weight=min_child_weight,
+                               min_info_gain=min_info_gain)
 
         tree, next_free, slot_node, row_slot = lax.fori_loop(
             L, max_depth, body, (tree, next_free, slot_node, row_slot))
@@ -346,7 +351,7 @@ def predict_tree(Xb, tree: Tree, max_depth: int) -> jax.Array:
 @functools.partial(jax.jit, static_argnames=("max_depth", "n_bins", "frontier"))
 def fit_forest(Xb, g, h, w_trees, feat_masks, max_depth: int, n_bins: int,
                frontier: int, reg_lambda: float = 1e-6,
-               min_child_weight: float = 1.0) -> Tree:
+               min_child_weight: float = 1.0, min_info_gain: float = 0.0) -> Tree:
     """Train all trees of a forest in one launch.
 
     w_trees: f32[T, n] bootstrap weights; feat_masks: f32[T, d].
@@ -356,7 +361,8 @@ def fit_forest(Xb, g, h, w_trees, feat_masks, max_depth: int, n_bins: int,
     def one(wt, fm):
         return grow_tree(Xb, g, h, wt, fm, max_depth, n_bins, frontier,
                          reg_lambda=reg_lambda, gamma=0.0,
-                         min_child_weight=min_child_weight)
+                         min_child_weight=min_child_weight,
+                         min_info_gain=min_info_gain)
 
     return jax.vmap(one)(w_trees, feat_masks)
 
@@ -382,37 +388,42 @@ def forest_chunk_size(max_depth: int, n_bins: int, d: int, c: int,
                    static_argnames=("max_depth", "n_bins", "chunk", "frontier"))
 def fit_forest_chunked(Xb, g, h, w_trees, feat_masks, mcw_trees, max_depth: int,
                        n_bins: int, chunk: int, frontier: int,
-                       reg_lambda: float = 1e-6) -> Tree:
+                       reg_lambda: float = 1e-6, mig_trees=None) -> Tree:
     """Train an arbitrary tree population with bounded memory: ``lax.map``
     over chunks of ``chunk`` vmapped trees — one compile, sequential chunks.
 
     The tree axis TT (a multiple of ``chunk``; callers pad with zero-weight
     trees) may interleave folds x grid candidates x bootstrap replicas —
-    per-tree ``mcw_trees`` carries the grid's min-child-weight, so a whole
-    RF fold x grid sweep is a single launch (SURVEY §2.7 axis 2).
+    per-tree ``mcw_trees``/``mig_trees`` carry the grid's min-child-weight
+    and min-info-gain, so a whole RF fold x grid sweep is a single launch
+    (SURVEY §2.7 axis 2).
     """
     n = Xb.shape[0]
     d = Xb.shape[1]
+    if mig_trees is None:
+        mig_trees = jnp.zeros_like(mcw_trees)
 
     def one_chunk(args):
-        wts, fms, mcws = args
+        wts, fms, mcws, migs = args
 
-        def one(wt, fm, mcw):
+        def one(wt, fm, mcw, mig):
             return grow_tree(Xb, g, h, wt, fm, max_depth, n_bins, frontier,
                              reg_lambda=reg_lambda, gamma=0.0,
-                             min_child_weight=mcw)
+                             min_child_weight=mcw, min_info_gain=mig)
 
-        return jax.vmap(one)(wts, fms, mcws)
+        return jax.vmap(one)(wts, fms, mcws, migs)
 
     trees = lax.map(one_chunk, (w_trees.reshape(-1, chunk, n),
                                 feat_masks.reshape(-1, chunk, d),
-                                mcw_trees.reshape(-1, chunk)))
+                                mcw_trees.reshape(-1, chunk),
+                                mig_trees.reshape(-1, chunk)))
     return jax.tree.map(lambda a: a.reshape((-1,) + a.shape[2:]), trees)
 
 
 def fit_forest_sharded(mesh, axis_name: str, Xb, g, h, w_trees, feat_masks,
                        mcw_trees, max_depth: int, n_bins: int, chunk: int,
-                       frontier: int, reg_lambda: float = 1e-6) -> Tree:
+                       frontier: int, reg_lambda: float = 1e-6,
+                       mig_trees=None) -> Tree:
     """Tree-axis-sharded forest training: each mesh shard grows its slice of
     the tree population with the memory-chunked kernel — zero communication
     (SURVEY §2.7 axis 2; the OpValidator thread pool spread over chips).
@@ -429,16 +440,19 @@ def fit_forest_sharded(mesh, axis_name: str, Xb, g, h, w_trees, feat_masks,
         no_check = {"check_rep": False}
     from jax.sharding import PartitionSpec as P
 
-    def local(xb, gg, hh, w, fm, mc):
+    if mig_trees is None:
+        mig_trees = jnp.zeros_like(mcw_trees)
+
+    def local(xb, gg, hh, w, fm, mc, mg):
         return fit_forest_chunked(xb, gg, hh, w, fm, mc, max_depth=max_depth,
                                   n_bins=n_bins, chunk=chunk, frontier=frontier,
-                                  reg_lambda=reg_lambda)
+                                  reg_lambda=reg_lambda, mig_trees=mg)
 
     sm = shard_map(local, mesh=mesh,
                    in_specs=(P(), P(), P(), P(axis_name), P(axis_name),
-                             P(axis_name)),
+                             P(axis_name), P(axis_name)),
                    out_specs=P(axis_name), **no_check)
-    return sm(Xb, g, h, w_trees, feat_masks, mcw_trees)
+    return sm(Xb, g, h, w_trees, feat_masks, mcw_trees, mig_trees)
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth", "n_groups"))
@@ -467,8 +481,8 @@ def _grad_hess(loss: str, F, y, Y_onehot):
 
 def _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int,
               max_depth: int, n_bins: int, frontier: int, eta, reg_lambda,
-              gamma, min_child_weight, base_score: float, n_classes: int
-              ) -> Tuple[Tree, jax.Array]:
+              gamma, min_child_weight, base_score: float, n_classes: int,
+              min_info_gain=0.0) -> Tuple[Tree, jax.Array]:
     """Traceable boosting body shared by fit_gbt and fit_gbt_batch."""
     n = Xb.shape[0]
     c = n_classes if loss == "softmax" else 1
@@ -481,7 +495,8 @@ def _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int
         g, hh = _grad_hess(loss, F, y, Y)
         tree = grow_tree(Xb, g, hh, w * rw, fm, max_depth, n_bins, frontier,
                          reg_lambda=reg_lambda, gamma=gamma,
-                         min_child_weight=min_child_weight)
+                         min_child_weight=min_child_weight,
+                         min_info_gain=min_info_gain)
         F = F + eta * predict_tree(Xb, tree, max_depth)
         return F, tree
 
@@ -495,7 +510,8 @@ def fit_gbt(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int,
             max_depth: int, n_bins: int, frontier: int, eta: float = 0.3,
             reg_lambda: float = 1.0, gamma: float = 0.0,
             min_child_weight: float = 1.0, base_score: float = 0.0,
-            n_classes: int = 1) -> Tuple[Tree, jax.Array]:
+            n_classes: int = 1, min_info_gain: float = 0.0
+            ) -> Tuple[Tree, jax.Array]:
     """XGBoost-style boosting: scan over rounds, one histogram tree per round.
 
     row_w_rounds: f32[R, n] subsample weights per round; feat_mask_rounds:
@@ -505,7 +521,8 @@ def fit_gbt(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int,
     """
     return _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss, n_rounds,
                      max_depth, n_bins, frontier, eta, reg_lambda, gamma,
-                     min_child_weight, base_score, n_classes)
+                     min_child_weight, base_score, n_classes,
+                     min_info_gain=min_info_gain)
 
 
 @functools.partial(jax.jit, static_argnames=("loss", "n_rounds", "max_depth",
@@ -513,7 +530,8 @@ def fit_gbt(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int,
 def fit_gbt_batch(Xb, y, w_batch, row_w_rounds, feat_mask_rounds, loss: str,
                   n_rounds: int, max_depth: int, n_bins: int, frontier: int,
                   eta_b, reg_lambda_b, gamma_b, min_child_weight_b,
-                  base_score_b=None, n_classes: int = 1) -> jax.Array:
+                  base_score_b=None, n_classes: int = 1,
+                  min_info_gain_b=None) -> jax.Array:
     """The fold x grid boosting sweep as ONE launch (the OpValidator
     thread-pool analog for boosted models — SURVEY §2.7 axis 2).
 
@@ -527,15 +545,17 @@ def fit_gbt_batch(Xb, y, w_batch, row_w_rounds, feat_mask_rounds, loss: str,
 
     if base_score_b is None:
         base_score_b = jnp.zeros(w_batch.shape[0], jnp.float32)
+    if min_info_gain_b is None:
+        min_info_gain_b = jnp.zeros(w_batch.shape[0], jnp.float32)
 
-    def one(w, eta, lam, gam, mcw, base):
+    def one(w, eta, lam, gam, mcw, base, mig):
         _, F = _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss,
                          n_rounds, max_depth, n_bins, frontier, eta, lam, gam,
-                         mcw, base, n_classes)
+                         mcw, base, n_classes, min_info_gain=mig)
         return F
 
     return jax.vmap(one)(w_batch, eta_b, reg_lambda_b, gamma_b,
-                         min_child_weight_b, base_score_b)
+                         min_child_weight_b, base_score_b, min_info_gain_b)
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth",))
@@ -577,3 +597,18 @@ def subsample_weights(n: int, n_rounds: int, frac: float,
     if frac >= 1.0:
         return np.ones((n_rounds, n), np.float32)
     return (rng.random((n_rounds, n)) < frac).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# FLOPs accounting (bench MFU): wrap the tree kernels so every call records
+# its XLA cost_analysis when utils.flops is enabled.  NOTE: tree-histogram
+# work is scatter/cumsum-heavy (VPU, not MXU); the recorded flops are XLA's
+# arithmetic count for the optimized HLO, the honest numerator for an
+# arithmetic-utilization figure rather than an MXU duty cycle.
+# ---------------------------------------------------------------------------
+from ..utils import flops as _flops  # noqa: E402
+
+for _n in ("fit_forest", "fit_forest_chunked", "fit_gbt", "fit_gbt_batch",
+           "predict_forest", "predict_forest_groups", "predict_gbt"):
+    globals()[_n] = _flops.wrap(f"trees.{_n}", globals()[_n])
+del _n

@@ -1,0 +1,122 @@
+"""FLOPs accounting via XLA ``cost_analysis`` — the MFU instrumentation.
+
+The judging criterion for single-chip performance is MFU (model FLOPs
+utilization), so the bench needs a defensible FLOPs count for the sweep it
+times.  Rather than hand-derived formulas for every kernel (fragile for the
+histogram trees, whose work is scatter/cumsum-heavy), each hot jitted kernel
+call-site calls :func:`record`, which AOT-lowers the SAME jitted callable at
+the call's exact arguments and reads the compiled executable's
+``cost_analysis()['flops']`` — XLA's own static count of the optimized HLO.
+
+Zero overhead unless enabled (the bench enables it); each (kernel, shape
+signature) is lowered once and cached, so steady-state calls add a dict
+lookup.  Numbers are per-call costs summed over calls — i.e. total optimized
+FLOPs dispatched to the device, the honest numerator for
+
+    MFU = flops_total / wall_clock / peak_flops.
+
+Caveat (stated where the bench reports it): XLA counts every op's arithmetic
+— including the VPU-bound scatter/cumsum work of tree histogram building —
+so tree-sweep "MFU" is utilization of peak *arithmetic* throughput, not an
+MXU duty cycle.  The linear-model sweeps are matmul-dominated and their MFU
+reads conventionally.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+_enabled: bool = bool(int(os.environ.get("TMOG_COUNT_FLOPS", "0") or 0))
+_totals: Dict[str, float] = {"flops": 0.0, "bytes_accessed": 0.0, "calls": 0.0}
+_by_fn: Dict[str, Dict[str, float]] = {}
+_cost_cache: Dict[Tuple, Optional[Dict[str, float]]] = {}
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def reset() -> None:
+    _totals.update(flops=0.0, bytes_accessed=0.0, calls=0.0)
+    _by_fn.clear()
+
+
+def totals() -> Dict[str, Any]:
+    """{"flops": total, "bytes_accessed": total, "calls": n, "by_fn": {...}}"""
+    out: Dict[str, Any] = dict(_totals)
+    out["by_fn"] = {k: dict(v) for k, v in _by_fn.items()}
+    return out
+
+
+def _signature(args, kwargs) -> Tuple:
+    leaves, treedef = jax.tree.flatten((args, kwargs))
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        if shape is not None:
+            sig.append(("a", tuple(shape), str(getattr(leaf, "dtype", "?"))))
+        else:
+            sig.append(("s", repr(leaf)))
+    return (str(treedef), tuple(sig))
+
+
+def _cost(fn, args, kwargs) -> Optional[Dict[str, float]]:
+    try:
+        compiled = fn.lower(*args, **kwargs).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):  # older jax returns [dict]
+            ca = ca[0] if ca else {}
+        return {"flops": float(ca.get("flops", 0.0)),
+                "bytes_accessed": float(ca.get("bytes accessed",
+                                               ca.get("bytes_accessed", 0.0)))}
+    except Exception:
+        return None
+
+
+def wrap(name: str, jitted):
+    """Wrap a jitted kernel so every call records its XLA cost when
+    accounting is enabled.  Applied once at module bottom in ops/ — call
+    sites stay untouched and always-on overhead is one ``if`` per call."""
+    import functools
+
+    @functools.wraps(jitted)
+    def wrapper(*args, **kwargs):
+        out = jitted(*args, **kwargs)
+        if _enabled:
+            record(name, jitted, *args, **kwargs)
+        return out
+
+    wrapper.__wrapped_jit__ = jitted
+    return wrapper
+
+
+def record(name: str, fn, *args, **kwargs) -> None:
+    """Accumulate the XLA-optimized cost of ONE call of jitted ``fn`` at
+    these arguments.  No-op unless enabled; per-(fn, shapes) cost is cached.
+    ``fn`` must be the jit-wrapped callable itself (has ``.lower``)."""
+    if not _enabled:
+        return
+    key = (name, _signature(args, kwargs))
+    if key not in _cost_cache:
+        _cost_cache[key] = _cost(fn, args, kwargs)
+    cost = _cost_cache[key]
+    if cost is None:
+        return
+    _totals["flops"] += cost["flops"]
+    _totals["bytes_accessed"] += cost["bytes_accessed"]
+    _totals["calls"] += 1
+    agg = _by_fn.setdefault(name, {"flops": 0.0, "calls": 0.0})
+    agg["flops"] += cost["flops"]
+    agg["calls"] += 1
