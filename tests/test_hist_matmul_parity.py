@@ -1,0 +1,101 @@
+"""Parity of the two histogram formulations (segment_sum vs MXU matmul).
+
+The TPU path builds level histograms as one-hot matmuls
+(ops/trees._level_histograms_mm); CPU keeps segment_sum.  Split decisions
+must be IDENTICAL — both compute the same (slot, feature, bin) sums, only
+the reduction route differs.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from transmogrifai_tpu.ops import trees as Tr
+
+
+@pytest.fixture
+def forced_matmul(monkeypatch):
+    monkeypatch.setenv("TMOG_HIST_MATMUL", "1")
+    yield
+    monkeypatch.setenv("TMOG_HIST_MATMUL", "0")
+
+
+def _fixture(seed=0, n=400, d=6, k=2):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * rng.normal(size=n) > 0).astype(np.float32)
+    Xb, _ = Tr.quantize(X, 16)
+    return Xb, y, rng
+
+
+def _grow(Xb, y, wt, fm, mig=0.0):
+    return Tr.grow_tree(jnp.asarray(Xb), jnp.asarray(-y[:, None]),
+                        jnp.ones(len(y)), jnp.asarray(wt), jnp.asarray(fm),
+                        max_depth=5, n_bins=16, frontier=16,
+                        min_child_weight=5.0, min_info_gain=mig)
+
+
+def test_matmul_histograms_match_segment_sum(monkeypatch):
+    Xb, y, rng = _fixture()
+    n, d = Xb.shape
+    wt = Tr.bootstrap_weights(n, 1, rng)[0]
+    fm = np.ones(d, np.float32)
+
+    monkeypatch.setenv("TMOG_HIST_MATMUL", "0")
+    t0 = _grow(Xb, y, wt, fm)
+    # grow directly with the shared one-hot (exactly what the TPU path does)
+    Obin = Tr.bin_onehot(jnp.asarray(Xb), 16)
+    t1 = Tr.grow_tree(jnp.asarray(Xb), jnp.asarray(-y[:, None]),
+                      jnp.ones(n), jnp.asarray(wt), jnp.asarray(fm),
+                      max_depth=5, n_bins=16, frontier=16,
+                      min_child_weight=5.0, Obin=Obin)
+    assert np.array_equal(np.asarray(t0.split_feat), np.asarray(t1.split_feat))
+    assert np.array_equal(np.asarray(t0.split_bin), np.asarray(t1.split_bin))
+    np.testing.assert_allclose(np.asarray(t0.leaf_val),
+                               np.asarray(t1.leaf_val), atol=1e-4)
+
+
+def test_forest_chunked_matmul_flag_parity(monkeypatch):
+    Xb, y, rng = _fixture(seed=3)
+    n, d = Xb.shape
+    T = 8
+    wt = Tr.bootstrap_weights(n, T, rng)
+    fm = Tr.feature_masks(d, T, 0.5, rng)
+    mcw = np.full(T, 5.0, np.float32)
+
+    def fit():
+        return Tr.fit_forest_chunked(
+            jnp.asarray(Xb), jnp.asarray(-y[:, None]), jnp.ones(n),
+            jnp.asarray(wt), jnp.asarray(fm), jnp.asarray(mcw),
+            max_depth=4, n_bins=16, chunk=4, frontier=16)
+
+    monkeypatch.setenv("TMOG_HIST_MATMUL", "0")
+    f0 = fit()
+    monkeypatch.setenv("TMOG_HIST_MATMUL", "1")
+    f1 = fit()
+    assert np.array_equal(np.asarray(f0.split_feat), np.asarray(f1.split_feat))
+    np.testing.assert_allclose(np.asarray(f0.leaf_val),
+                               np.asarray(f1.leaf_val), atol=1e-4)
+
+
+def test_gbt_matmul_flag_parity(monkeypatch):
+    Xb, y, rng = _fixture(seed=5)
+    n, d = Xb.shape
+    R = 6
+    rw = Tr.subsample_weights(n, R, 1.0, rng)
+    fms = Tr.feature_masks(d, R, 1.0, rng)
+
+    def fit():
+        _, F = Tr.fit_gbt(jnp.asarray(Xb), jnp.asarray(y), jnp.ones(n),
+                          jnp.asarray(rw), jnp.asarray(fms), loss="logistic",
+                          n_rounds=R, max_depth=3, n_bins=16, frontier=8,
+                          eta=0.3)
+        return np.asarray(F)
+
+    monkeypatch.setenv("TMOG_HIST_MATMUL", "0")
+    F0 = fit()
+    monkeypatch.setenv("TMOG_HIST_MATMUL", "1")
+    F1 = fit()
+    np.testing.assert_allclose(F0, F1, atol=1e-3)

@@ -47,3 +47,42 @@ class OpMultilayerPerceptronClassifier(PredictorEstimator):
         p = [(jnp.asarray(W), jnp.asarray(b)) for W, b in params["weights"]]
         z, prob, pred = M.predict_mlp(p, jnp.asarray(X, jnp.float32))
         return np.asarray(pred), np.asarray(z), np.asarray(prob)
+
+    #: grid keys the batched sweep understands; others raise -> loop fallback
+    _GRID_KEYS = ("hidden_layers", "max_iter", "step_size", "seed")
+
+    def fit_grid_folds(self, X, y, train_w, grids):
+        """Batched fold x grid MLP sweep: one vmapped launch per
+        (hidden_layers, max_iter) static group (ops/mlp.fit_mlp_grid_folds) —
+        no default-zoo model falls to the per-candidate Python loop."""
+        grids = [dict(g) for g in (grids or [{}])]
+        for g in grids:
+            for key in g:
+                if key not in self._GRID_KEYS:
+                    raise NotImplementedError(f"non-batchable MLP grid key {key}")
+        candidates = [self.copy_with_params(g) for g in grids]
+        k = max(int(np.max(y)) + 1 if len(y) else 2, 2)
+        n_folds = train_w.shape[0]
+        out = [[None] * len(grids) for _ in range(n_folds)]
+        groups: Dict[tuple, list] = {}
+        for ci, cand in enumerate(candidates):
+            hl = tuple(int(h) for h in cand.get_param("hidden_layers", (10,)))
+            groups.setdefault((hl, int(cand.get_param("max_iter", 200))),
+                              []).append(ci)
+        Xd = jnp.asarray(X, jnp.float32)
+        yd = jnp.asarray(np.asarray(y, np.float32))
+        twd = jnp.asarray(np.asarray(train_w, np.float32))
+        for (hl, mi), cis in groups.items():
+            layers = (X.shape[1],) + hl + (k,)
+            lrs = jnp.asarray([float(candidates[ci].get_param("step_size", 0.03))
+                               for ci in cis], jnp.float32)
+            seeds = jnp.asarray([int(candidates[ci].get_param("seed", 42))
+                                 for ci in cis], jnp.int32)
+            params = M.fit_mlp_grid_folds(Xd, yd, twd, lrs, seeds,
+                                          layers=layers, max_iter=mi)
+            z, prob, pred = M.predict_mlp_grid(params, Xd)
+            z, prob, pred = np.asarray(z), np.asarray(prob), np.asarray(pred)
+            for gi, ci in enumerate(cis):
+                for f in range(n_folds):
+                    out[f][ci] = (pred[f, gi], z[f, gi], prob[f, gi])
+        return out

@@ -55,3 +55,43 @@ class OpGeneralizedLinearRegression(PredictorEstimator):
                            jnp.asarray(params["intercept"], jnp.float32),
                            link=params["link"])
         return np.asarray(mu, np.float64), None, None
+
+    _GRID_KEYS = ("reg_param", "variance_power", "family", "link", "max_iter",
+                  "fit_intercept")
+
+    def fit_grid_folds(self, X, y, train_w, grids):
+        """Batched fold x grid IRLS sweep: one launch per
+        (family, link, max_iter, fit_intercept) static group
+        (ops/linear.fit_glm_grid_folds) — the reference's GLM default grid
+        varies family/link, so each family-link pair is one XLA program."""
+        grids = [dict(g) for g in (grids or [{}])]
+        for g in grids:
+            for key in g:
+                if key not in self._GRID_KEYS:
+                    raise NotImplementedError(f"non-batchable GLM grid key {key}")
+        candidates = [self.copy_with_params(g) for g in grids]
+        n_folds = train_w.shape[0]
+        out = [[None] * len(grids) for _ in range(n_folds)]
+        groups: Dict[tuple, list] = {}
+        for ci, cand in enumerate(candidates):
+            fam = cand.get_param("family", "gaussian")
+            link = cand.get_param("link") or L.GLM_DEFAULT_LINK[fam]
+            groups.setdefault(
+                (fam, link, int(cand.get_param("max_iter", 25)),
+                 bool(cand.get_param("fit_intercept", True))), []).append(ci)
+        Xd = jnp.asarray(X, jnp.float32)
+        yd = jnp.asarray(np.asarray(y, np.float32))
+        twd = jnp.asarray(np.asarray(train_w, np.float32))
+        for (fam, link, mi, fi), cis in groups.items():
+            l2s = jnp.asarray([float(candidates[ci].get_param("reg_param", 0.0))
+                               for ci in cis], jnp.float32)
+            vps = jnp.asarray([float(candidates[ci].get_param("variance_power", 1.5))
+                               for ci in cis], jnp.float32)
+            fit = L.fit_glm_grid_folds(Xd, yd, twd, l2s, vps, family=fam,
+                                       link=link, max_iter=mi, fit_intercept=fi)
+            mu = np.asarray(L.predict_glm_grid(Xd, fit.coef, fit.intercept,
+                                               link=link), np.float64)
+            for gi, ci in enumerate(cis):
+                for f in range(n_folds):
+                    out[f][ci] = (mu[f, gi], None, None)
+        return out

@@ -496,6 +496,32 @@ def predict_svc(X, coef, intercept):
     return raw, pred
 
 
+@functools.partial(jax.jit, static_argnames=("family", "link", "max_iter",
+                                             "fit_intercept"))
+def fit_glm_grid_folds(X, y, train_w, l2s, vps, family: str, link: str,
+                       max_iter: int = 25, fit_intercept: bool = True
+                       ) -> LinearFit:
+    """IRLS GLM fits for every (fold, grid) pair — one launch per
+    (family, link) static group.  l2s/vps: f32[G] regularization and tweedie
+    variance power per candidate."""
+
+    def fit(w, l2, vp):
+        return fit_glm_irls.__wrapped_jit__(
+            X, y, w, l2, family=family, link=link, max_iter=max_iter,
+            fit_intercept=fit_intercept, variance_power=vp)
+
+    over_grid = jax.vmap(fit, in_axes=(None, 0, 0))
+    over_folds = jax.vmap(over_grid, in_axes=(0, None, None))
+    return over_folds(train_w, l2s, vps)
+
+
+@functools.partial(jax.jit, static_argnames=("link",))
+def predict_glm_grid(X, coef, intercept, link: str):
+    """Batched GLM scoring: coef [F, G, d] -> mu [F, G, n]."""
+    eta = jnp.einsum("nd,fgd->fgn", X, coef) + intercept[..., :1]
+    return _GLM_LINKS[link][1](eta)
+
+
 # ---------------------------------------------------------------------------
 # FLOPs accounting (bench MFU): wrap the sweep payload kernels so every call
 # records its XLA cost_analysis when utils.flops is enabled — call sites
@@ -508,6 +534,7 @@ for _n in ("fit_logistic_grid_folds_newton", "fit_ridge_grid_folds",
            "fit_linear_grid_folds_fista", "fit_svc_grid_folds",
            "predict_binary_logistic_grid", "predict_softmax_grid",
            "fit_logistic_newton", "fit_logistic_fista", "fit_softmax",
-           "fit_ridge", "fit_linear_fista", "fit_linear_svc", "fit_glm_irls"):
+           "fit_ridge", "fit_linear_fista", "fit_linear_svc", "fit_glm_irls",
+           "fit_glm_grid_folds", "predict_glm_grid"):
     globals()[_n] = _flops.wrap(f"linear.{_n}", globals()[_n])
 del _n

@@ -76,3 +76,34 @@ def predict_mlp(params, X):
     prob = jax.nn.softmax(z, axis=-1)
     pred = jnp.argmax(z, axis=-1).astype(jnp.float32)
     return z, prob, pred
+
+
+@functools.partial(jax.jit, static_argnames=("layers", "max_iter"))
+def fit_mlp_grid_folds(X, y, train_w, lrs, seeds, layers: Tuple[int, ...],
+                       max_iter: int = 100):
+    """MLP fits for every (fold, grid) pair in ONE launch — the OpValidator
+    thread-pool analog for the MLP (one static (layers, max_iter) group per
+    launch; lrs f32[G], seeds i32[G] are the dynamic grid axes)."""
+
+    def fit(w, lr, seed):
+        return fit_mlp.__wrapped_jit__(X, y, w, layers=layers,
+                                       max_iter=max_iter, lr=lr, seed=seed)
+
+    over_grid = jax.vmap(fit, in_axes=(None, 0, 0))
+    over_folds = jax.vmap(over_grid, in_axes=(0, None, None))
+    return over_folds(train_w, lrs, seeds)
+
+
+@jax.jit
+def predict_mlp_grid(params, X):
+    """Batched scoring of [F, G]-leading MLP params: (z, prob, pred)."""
+    one = lambda p: predict_mlp.__wrapped_jit__(p, X)
+    return jax.vmap(jax.vmap(one))(params)
+
+
+# FLOPs accounting — see ops/linear.py
+from ..utils import flops as _flops  # noqa: E402
+
+for _n in ("fit_mlp", "predict_mlp", "fit_mlp_grid_folds", "predict_mlp_grid"):
+    globals()[_n] = _flops.wrap(f"mlp.{_n}", globals()[_n])
+del _n

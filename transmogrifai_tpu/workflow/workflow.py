@@ -78,16 +78,36 @@ class OpWorkflow(OpWorkflowCore):
         self.raw_feature_filter = None  # set by with_raw_feature_filter
         self._fitted_stage_map: Dict[str, PipelineStage] = {}
         self.rff_results = None
-        self.workflow_cv = False  # set by with_workflow_cv
+        #: None = AUTO (reference semantics, OpWorkflow.scala:376-455): engage
+        #: workflow-level CV whenever the DAG contains a ModelSelector —
+        #: cut_dag then decides whether label-using upstream estimators force
+        #: per-fold feature refits (firstCVTSIndex) or the selector's own
+        #: batched CV is equivalent.  True/False force either path.
+        self.workflow_cv: Optional[bool] = None
 
     def with_workflow_cv(self) -> "OpWorkflow":
-        """Enable workflow-level cross-validation (OpWorkflow.scala:376-455):
+        """Force workflow-level cross-validation (OpWorkflow.scala:376-455):
         ``train()`` cuts the DAG around the ModelSelector (cut_dag), fits the
         before-DAG once, per fold REFITS the selector's upstream feature
         estimators on the fold-train rows only (leakage-free), sweeps the
-        grid, then fits the full during+after DAG with the chosen winner."""
+        grid, then fits the full during+after DAG with the chosen winner.
+        This is already the AUTO default when a ModelSelector is present."""
         self.workflow_cv = True
         return self
+
+    def with_selector_cv(self) -> "OpWorkflow":
+        """Opt OUT of workflow-level CV: the ModelSelector runs its own
+        fold x grid sweep on the once-transformed data.  Faster, but
+        label-using feature estimators (e.g. SanityChecker) then see
+        validation rows at fit time — the leakage the reference's automatic
+        DAG cutting exists to prevent.  Explicit opt-out only."""
+        self.workflow_cv = False
+        return self
+
+    def _use_workflow_cv(self) -> bool:
+        if self.workflow_cv is not None:
+            return self.workflow_cv
+        return any(getattr(s, "is_model_selector", False) for s in self.stages)
 
     # ---- DAG setup ---------------------------------------------------------
     def set_result_features(self, *features: Feature) -> "OpWorkflow":
@@ -146,7 +166,7 @@ class OpWorkflow(OpWorkflowCore):
                 self._set_blocklist(result.dropped_features, result.dropped_map_keys)
                 data = result.clean(data)
 
-        if self.workflow_cv:
+        if self._use_workflow_cv():
             fitted = self._fit_stages_cv(data)
         else:
             fitted = dag_util.fit_and_transform_dag(
