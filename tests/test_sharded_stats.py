@@ -1,0 +1,116 @@
+"""Data-axis-sharded streaming statistics (SURVEY §2.7 axis 1, §5.7).
+
+Parity of the chunked/sharded two-pass moments + centered-Gram correlation
+against numpy on the virtual 8-device CPU mesh — the local[2] analog.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.parallel.mesh import DATA_AXIS, make_mesh
+from transmogrifai_tpu.parallel.stats import (DataShardedStats, chunked,
+                                              sharded_correlations)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0)
+    n, d = 5000, 12
+    X = rng.normal(size=(n, d)).astype(np.float32) * rng.uniform(0.5, 3, d)
+    X[:, 3] = 2.0  # zero-variance column
+    y = (X[:, 0] - X[:, 1] + rng.normal(size=n)).astype(np.float32)
+    return X, y
+
+
+@pytest.fixture(params=["nomesh", "data8"])
+def mesh(request):
+    if request.param == "nomesh":
+        return None
+    return make_mesh(n_data=8, n_model=1)
+
+
+def test_moments_match_numpy(data, mesh):
+    X, _ = data
+    acc = DataShardedStats(X.shape[1], mesh=mesh)
+    # uneven chunks force the mask/padding path
+    stats = acc.moments(chunked(X, chunk_rows=777)())
+    assert stats.count == len(X)
+    np.testing.assert_allclose(stats.mean, X.mean(axis=0), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(stats.variance, X.var(axis=0, ddof=1),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(stats.min, X.min(axis=0), rtol=1e-6)
+    np.testing.assert_allclose(stats.max, X.max(axis=0), rtol=1e-6)
+
+
+def test_correlations_match_numpy(data, mesh):
+    X, y = data
+    stats, corr_label, corr_matrix = sharded_correlations(
+        X, y, mesh=mesh, chunk_rows=777)
+    ref = np.corrcoef(np.concatenate([X, y[:, None]], axis=1), rowvar=False)
+    exp_label = ref[:-1, -1]
+    exp_mat = ref[:-1, :-1]
+    live = ~np.isnan(corr_label)
+    assert not live[3]  # zero-variance column -> NaN (Spark semantics)
+    np.testing.assert_allclose(corr_label[live], exp_label[live],
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(corr_matrix[np.ix_(live, live)],
+                               exp_mat[np.ix_(live, live)],
+                               rtol=1e-4, atol=1e-4)
+    assert np.isnan(corr_matrix[3, 0]) and np.isnan(corr_matrix[0, 3])
+
+
+def test_sharded_equals_unsharded(data):
+    X, y = data
+    s0, c0, m0 = sharded_correlations(X, y, mesh=None, chunk_rows=1024)
+    mesh = make_mesh(n_data=8, n_model=1)
+    s1, c1, m1 = sharded_correlations(X, y, mesh=mesh, chunk_rows=1024)
+    np.testing.assert_allclose(s0.mean, s1.mean, rtol=1e-5, atol=1e-7)
+    live = ~np.isnan(c0)
+    np.testing.assert_allclose(c0[live], c1[live], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(m0[np.ix_(live, live)], m1[np.ix_(live, live)],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_sanity_checker_sharded_path_equivalent():
+    """sharded_stats=True (streaming Gram over the data mesh) must produce
+    the same correlations/drops as the in-memory fused pass."""
+    import transmogrifai_tpu.types as T
+    from transmogrifai_tpu import Dataset, FeatureBuilder
+    from transmogrifai_tpu.columns import NumericColumn, VectorColumn
+    from transmogrifai_tpu.features.metadata import (VectorColumnMetadata,
+                                                     VectorMetadata)
+    from transmogrifai_tpu.impl.preparators.sanity_checker import SanityChecker
+
+    rng = np.random.default_rng(0)
+    n, d = 3000, 8
+    X = rng.normal(size=(n, d))
+    X[:, 1] = X[:, 0] * 1.0 + 1e-6 * rng.normal(size=n)  # corr ~1 -> drop
+    X[:, 2] = 0.5                                         # zero variance -> drop
+    y = (X[:, 0] > 0).astype(float)
+
+    meta = VectorMetadata("features", tuple(
+        VectorColumnMetadata((f"f{i}",), ("Real",), index=i) for i in range(d)))
+    ds = Dataset({
+        "label": NumericColumn(T.RealNN, y, np.ones(n, bool)),
+        "features": VectorColumn(T.OPVector, np.asarray(X, np.float32), meta),
+    })
+    lbl = FeatureBuilder("label", T.RealNN).extract(field="label").as_response()
+    vec = FeatureBuilder("features", T.OPVector).extract(
+        field="features").as_predictor()
+
+    def run(sharded):
+        sc = SanityChecker(sharded_stats=sharded).set_input(lbl, vec)
+        model = sc.fit(ds)
+        return model.metadata["sanity_checker_summary"], model.indices_to_keep
+
+    s_mem, keep_mem = run(False)
+    s_stream, keep_stream = run(True)
+    np.testing.assert_array_equal(keep_mem, keep_stream)
+    assert len(keep_stream) <= d - 2  # constant + leaked columns dropped
+    assert s_mem["names"] == s_stream["names"]
+    c0 = [np.nan if v is None else float(v)
+          for v in s_mem["correlationsWLabel"]["values"]]
+    c1 = [np.nan if v is None else float(v)
+          for v in s_stream["correlationsWLabel"]["values"]]
+    for a, b in zip(c0, c1):
+        if not (np.isnan(a) or np.isnan(b)):
+            assert abs(a - b) < 1e-4

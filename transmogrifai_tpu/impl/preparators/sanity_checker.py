@@ -178,6 +178,7 @@ class SanityChecker(BinaryEstimator, AllowLabelAsInput):
                  correlation_exclusion: str = "none",
                  categorical_label: Optional[bool] = None,
                  max_categorical_cardinality: int = 100,
+                 sharded_stats: Any = "auto",
                  uid: Optional[str] = None):
         super().__init__(operation_name="sanityChecker", output_type=T.OPVector, uid=uid,
                          check_sample=check_sample, sample_seed=sample_seed,
@@ -193,7 +194,8 @@ class SanityChecker(BinaryEstimator, AllowLabelAsInput):
                          feature_label_corr_only=feature_label_corr_only,
                          correlation_exclusion=correlation_exclusion,
                          categorical_label=categorical_label,
-                         max_categorical_cardinality=max_categorical_cardinality)
+                         max_categorical_cardinality=max_categorical_cardinality,
+                         sharded_stats=sharded_stats)
 
     def check_input_types(self, features) -> None:
         super().check_input_types(features)
@@ -207,7 +209,9 @@ class SanityChecker(BinaryEstimator, AllowLabelAsInput):
         label_col, vec_col = cols
         assert isinstance(label_col, NumericColumn) and isinstance(vec_col, VectorColumn)
         y = np.asarray(label_col.values, dtype=np.float64)
-        X = np.asarray(vec_col.values, dtype=np.float64)
+        X = np.asarray(vec_col.values)
+        if X.dtype != np.float64 and X.size <= (1 << 28):
+            X = X.astype(np.float64)  # keep f32 for huge data (no 2x copy)
         meta = vec_col.metadata or VectorMetadata(
             self.inputs[1].name,
             tuple(VectorColumnMetadata((self.inputs[1].name,), ("OPVector",), index=i)
@@ -224,13 +228,38 @@ class SanityChecker(BinaryEstimator, AllowLabelAsInput):
             X, y = X[idx], y[idx]
             n = target
 
-        # 2. moments + correlations (one fused pass)
+        # 2. moments + correlations (one fused pass).  Large unsampled data
+        # takes the row-sharded STREAMING path: two chunked passes over the
+        # mesh data axis with the O(p^2) correlation as a blocked centered
+        # Gram (SURVEY §2.7 axis 1 + §5.7; reference: treeAggregate under
+        # Statistics.colStats/corr, SanityChecker.scala:406-470).
+        method = str(self.get_param("correlation_type", "pearson"))
         with_corr = not bool(self.get_param("feature_label_corr_only", False))
         corr_cols = self._correlation_columns(meta)
-        stats_all, corr_label_sub, corr_matrix_sub = S.correlations_with_label(
-            X[:, corr_cols], y, method=str(self.get_param("correlation_type", "pearson")),
-            with_corr_matrix=with_corr)
-        full_stats = S.col_stats(X)
+        sharded = self.get_param("sharded_stats", "auto")
+        stream = (sharded is True) or (
+            sharded == "auto" and method == "pearson" and n > (1 << 18))
+        if stream and method == "pearson":
+            from ...parallel.mesh import data_mesh
+            from ...parallel.stats import DataShardedStats, chunked
+
+            mesh = data_mesh()
+            acc = DataShardedStats(X.shape[1], mesh=mesh)
+            full_stats = acc.moments(chunked(X)())
+            acc_c = DataShardedStats(len(corr_cols), mesh=mesh)
+            ch = 1 << 18
+
+            def xy_chunks():
+                for lo in range(0, n, ch):
+                    yield X[lo:lo + ch][:, corr_cols], y[lo:lo + ch]
+
+            corr_label_sub, corr_matrix_sub = acc_c.correlations_from(
+                xy_chunks, full_stats.mean[corr_cols], float(np.mean(y)),
+                with_corr_matrix=with_corr)
+        else:
+            _, corr_label_sub, corr_matrix_sub = S.correlations_with_label(
+                X[:, corr_cols], y, method=method, with_corr_matrix=with_corr)
+            full_stats = S.col_stats(X)
         d = X.shape[1]
         corr_label = np.full(d, np.nan)
         corr_label[corr_cols] = corr_label_sub

@@ -68,6 +68,16 @@ def auto_mesh() -> Optional[Mesh]:
     return make_mesh(n_data=1, n_model=len(devs))
 
 
+def data_mesh() -> Optional[Mesh]:
+    """All local devices on the ``data`` axis — for row-sharded statistics
+    passes (SanityChecker / RFF moments + Gram, SURVEY §2.7 axis 1).
+    None on a single device (XLA needs no collectives then anyway)."""
+    devs = jax.devices()
+    if len(devs) <= 1:
+        return None
+    return make_mesh(n_data=len(devs), n_model=1)
+
+
 def make_mesh(n_data: Optional[int] = None, n_model: int = 1,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Build a 2-D (data, model) mesh over the available devices.

@@ -1,0 +1,185 @@
+"""Row-sharded streaming statistics — SURVEY §2.7 axis 1 and §5.7.
+
+The reference computes column moments and correlations with Spark
+``Statistics.colStats`` / ``Statistics.corr`` — treeAggregate reductions over
+executor row partitions (SanityChecker.scala:406-470).  The O(p²)
+feature×feature correlation is its "long axis" (SURVEY §5.7).  TPU-native
+formulation:
+
+- rows arrive in CHUNKS (the dataset may exceed HBM: 10M x 500 f32 = 20 GB
+  vs 16 GB on a v5e chip); each chunk is placed sharded over the mesh
+  ``data`` axis and reduced on device — XLA inserts the psum collectives
+  from the sharding annotations (the scaling-book recipe),
+- pass 1 accumulates count / sum / sum-of-squares / min / max per column,
+- pass 2 accumulates the CENTERED Gram Z^T Z (+ Z^T z_y) — one MXU matmul
+  per chunk — from which the full p x p Pearson matrix and the label
+  correlations fall out.  Centering first keeps f32 accumulation accurate
+  (raw second moments over 10M rows would not be),
+- accumulators live on device replicated; one tiny d2h at finalize.
+
+Spearman over streams needs a global rank transform; the streaming path is
+Pearson-only (the reference default).  Sampled Spearman stays available via
+utils/stats.correlations_with_label.
+"""
+from __future__ import annotations
+
+from typing import Iterable, Iterator, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import DATA_AXIS
+from ..utils.stats import ColStats
+
+
+def _data_sharding(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+@jax.jit
+def _moments_step(carry, X, m):
+    """carry: (n, s1, s2, mn, mx); X f32[rows, d] (sharded over data), m
+    f32[rows] validity mask (0 for padding rows)."""
+    n, s1, s2, mn, mx = carry
+    Xm = X * m[:, None]
+    n = n + m.sum()
+    s1 = s1 + Xm.sum(axis=0)
+    s2 = s2 + (X * Xm).sum(axis=0)
+    mn = jnp.minimum(mn, jnp.where(m[:, None] > 0, X, jnp.inf).min(axis=0))
+    mx = jnp.maximum(mx, jnp.where(m[:, None] > 0, X, -jnp.inf).max(axis=0))
+    return n, s1, s2, mn, mx
+
+
+@jax.jit
+def _gram_step(carry, X, yv, m, mean, y_mean):
+    """carry: (G [d,d], gy [d], yy, n); accumulates the centered Gram."""
+    G, gy, yy, n = carry
+    Z = (X - mean[None, :]) * m[:, None]
+    zy = (yv - y_mean) * m
+    G = G + Z.T @ Z
+    gy = gy + Z.T @ zy
+    yy = yy + (zy * zy).sum()
+    n = n + m.sum()
+    return G, gy, yy, n
+
+
+class DataShardedStats:
+    """Two-pass streaming moments + correlations over row chunks.
+
+    ``mesh=None`` runs single-device (same code path; XLA elides the
+    collectives) — the Spark local-mode analog.  Chunks may be any row
+    count; they are padded to the data-shard multiple with masked rows.
+    """
+
+    def __init__(self, d: int, mesh=None):
+        self.d = d
+        self.mesh = mesh
+        self.n_shards = int(mesh.shape[DATA_AXIS]) if mesh is not None else 1
+
+    def _place(self, arr: np.ndarray):
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        return jax.device_put(jnp.asarray(arr), _data_sharding(self.mesh))
+
+    def _chunks_masked(self, chunks: Iterable[np.ndarray]
+                       ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        for X in chunks:
+            X = np.ascontiguousarray(np.asarray(X, np.float32))
+            rows = X.shape[0]
+            pad = (-rows) % self.n_shards
+            m = np.ones(rows, np.float32)
+            if pad:
+                X = np.concatenate([X, np.zeros((pad, X.shape[1]), np.float32)])
+                m = np.concatenate([m, np.zeros(pad, np.float32)])
+            yield X, m
+
+    # ---- pass 1 ------------------------------------------------------------
+    def moments(self, chunks: Iterable[np.ndarray]) -> ColStats:
+        d = self.d
+        carry = (jnp.zeros(()), jnp.zeros(d), jnp.zeros(d),
+                 jnp.full(d, jnp.inf), jnp.full(d, -jnp.inf))
+        for X, m in self._chunks_masked(chunks):
+            carry = _moments_step(carry, self._place(X), self._place(m))
+        n, s1, s2, mn, mx = (np.asarray(c, np.float64) for c in carry)
+        n = float(n)
+        mean = s1 / max(n, 1.0)
+        var = np.maximum(s2 / max(n, 1.0) - mean * mean, 0.0) * (
+            n / max(n - 1.0, 1.0))  # sample variance (Spark colStats)
+        return ColStats(count=int(n), mean=mean, variance=var, min=mn, max=mx)
+
+    # ---- pass 2 ------------------------------------------------------------
+    def correlations_from(self, chunks_factory, mean: np.ndarray, y_mean: float,
+                          with_corr_matrix: bool = True
+                          ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """``chunks_factory()`` yields (X_chunk [rows, d], y_chunk [rows])
+        pairs.  Returns (corr_with_label [d], corr_matrix [d,d] | None)."""
+        d = self.d
+        meand = jnp.asarray(mean, jnp.float32)
+        ymd = jnp.asarray(np.float32(y_mean))
+        carry = (jnp.zeros((d, d)), jnp.zeros(d), jnp.zeros(()), jnp.zeros(()))
+        for X, y in chunks_factory():
+            X = np.ascontiguousarray(np.asarray(X, np.float32))
+            y = np.asarray(y, np.float32)
+            rows = X.shape[0]
+            pad = (-rows) % self.n_shards
+            m = np.ones(rows, np.float32)
+            if pad:
+                X = np.concatenate([X, np.zeros((pad, d), np.float32)])
+                y = np.concatenate([y, np.zeros(pad, np.float32)])
+                m = np.concatenate([m, np.zeros(pad, np.float32)])
+            carry = _gram_step(carry, self._place(X), self._place(y),
+                               self._place(m), meand, ymd)
+        G, gy, yy, n = (np.asarray(c, np.float64) for c in carry)
+        diag = np.diag(G).copy()
+        zero = diag <= 0.0
+        denom = np.sqrt(np.maximum(diag, 1e-300))
+        with np.errstate(invalid="ignore", divide="ignore"):
+            corr_label = gy / (denom * np.sqrt(max(float(yy), 1e-300)))
+        corr_label[zero] = np.nan
+        corr_matrix = None
+        if with_corr_matrix:
+            corr_matrix = G / np.outer(denom, denom)
+            np.fill_diagonal(corr_matrix, 1.0)
+            corr_matrix[zero, :] = np.nan
+            corr_matrix[:, zero] = np.nan
+        return corr_label, corr_matrix
+
+
+def chunked(X: np.ndarray, y: Optional[np.ndarray] = None,
+            chunk_rows: int = 1 << 18):
+    """Row-chunk an in-memory array (factory usable for both passes)."""
+    n = X.shape[0]
+
+    def gen_x():
+        for lo in range(0, n, chunk_rows):
+            yield X[lo:lo + chunk_rows]
+
+    if y is None:
+        return gen_x
+
+    def gen_xy():
+        for lo in range(0, n, chunk_rows):
+            yield X[lo:lo + chunk_rows], y[lo:lo + chunk_rows]
+
+    return gen_xy
+
+
+def sharded_correlations(X: np.ndarray, y: np.ndarray, mesh=None,
+                         with_corr_matrix: bool = True,
+                         chunk_rows: int = 1 << 18
+                         ) -> Tuple[ColStats, np.ndarray, Optional[np.ndarray]]:
+    """Drop-in large-data Pearson path for SanityChecker: two sharded
+    streaming passes over row chunks.  Returns (col_stats, corr_with_label,
+    corr_matrix|None) matching utils/stats.correlations_with_label."""
+    acc = DataShardedStats(X.shape[1], mesh=mesh)
+    stats = acc.moments(chunked(X, chunk_rows=chunk_rows)())
+    y64 = np.asarray(y, np.float64)
+    y_mean = float(y64.mean()) if len(y64) else 0.0
+    corr_label, corr_matrix = acc.correlations_from(
+        chunked(X, y, chunk_rows=chunk_rows), stats.mean, y_mean,
+        with_corr_matrix=with_corr_matrix)
+    return stats, corr_label, corr_matrix
