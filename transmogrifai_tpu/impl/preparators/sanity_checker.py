@@ -248,10 +248,14 @@ class SanityChecker(BinaryEstimator, AllowLabelAsInput):
             full_stats = acc.moments(chunked(X)())
             acc_c = DataShardedStats(len(corr_cols), mesh=mesh)
             ch = 1 << 18
+            all_cols = len(corr_cols) == X.shape[1]
 
             def xy_chunks():
                 for lo in range(0, n, ch):
-                    yield X[lo:lo + ch][:, corr_cols], y[lo:lo + ch]
+                    Xc = X[lo:lo + ch]
+                    # avoid a per-chunk column-gather copy when nothing is
+                    # excluded (the common case at scale)
+                    yield (Xc if all_cols else Xc[:, corr_cols]), y[lo:lo + ch]
 
             corr_label_sub, corr_matrix_sub = acc_c.correlations_from(
                 xy_chunks, full_stats.mean[corr_cols], float(np.mean(y)),

@@ -112,14 +112,43 @@ class DataReader(Reader):
         if isinstance(data, Dataset):
             data = data.to_pandas()  # keeps field extraction on the vectorized path
         df = data if isinstance(data, pd.DataFrame) else None
-        records = _records_from(data)
         limit = (params or {}).get("maybeReaderParams", {}).get("limit") or (params or {}).get("limit")
+        if df is not None and self._fully_vectorizable(raw_features, df):
+            # no per-row dict materialization — critical at 10M+ rows
+            if limit:
+                df = df.head(int(limit))
+            cols = _extract_columns(raw_features, [], df)
+            return Dataset(cols, self._vectorized_keys(df))
+        records = _records_from(data)
         if limit:
             records = records[: int(limit)]
             df = df.head(int(limit)) if df is not None else None
         cols = _extract_columns(raw_features, records, df)
         keys = np.array([self._key_of(r, i) for i, r in enumerate(records)], dtype=object)
         return Dataset(cols, keys)
+
+    def _fully_vectorizable(self, raw_features: Sequence[Feature], df) -> bool:
+        """True when every raw feature takes _extract_columns' vectorized df
+        path and keys need no per-row callable."""
+        if callable(self.key):
+            return False
+        if isinstance(self.key, str) and self.key not in df.columns:
+            return False
+        for f in raw_features:
+            stage = f.origin_stage
+            ex = getattr(stage, "extract_fn", None)
+            if not (isinstance(ex, FieldExtractor) and ex.field_name in df.columns
+                    and issubclass(f.ftype, (T.OPNumeric, T.Text))):
+                return False
+        return True
+
+    def _vectorized_keys(self, df) -> np.ndarray:
+        n = len(df)
+        if isinstance(self.key, str):
+            return df[self.key].astype(str).to_numpy(dtype=object)
+        if self.key is None and KEY_FIELD in df.columns:
+            return df[KEY_FIELD].astype(str).to_numpy(dtype=object)
+        return np.arange(n).astype(str).astype(object)
 
 
 class CustomReader(DataReader):

@@ -256,6 +256,12 @@ class OpApp:
         p.add_argument("--metrics-location")
         p.add_argument("--read-location", help="overrides readerParams.path")
         p.add_argument("--collect-stage-metrics", action="store_true")
+        p.add_argument("--distributed", metavar="HOST:PORT", default=None,
+                       help="multi-host mode: coordinator address for "
+                            "jax.distributed (with --num-processes/"
+                            "--process-id or JAX_NUM_PROCESSES/JAX_PROCESS_ID)")
+        p.add_argument("--num-processes", type=int, default=None)
+        p.add_argument("--process-id", type=int, default=None)
         return p
 
     def parse_params(self, args: argparse.Namespace) -> OpParams:
@@ -273,6 +279,15 @@ class OpApp:
     def main(self, argv: Optional[List[str]] = None) -> OpWorkflowRunnerResult:
         """OpApp.main:178."""
         args = self.parser().parse_args(argv)
+        if args.distributed or (args.num_processes or 0) > 1:
+            from .parallel.distributed import initialize_distributed
+
+            info = initialize_distributed(args.distributed, args.num_processes,
+                                          args.process_id)
+            print(f"{self.app_name}: joined cluster as process "
+                  f"{info.process_id}/{info.num_processes} "
+                  f"({info.local_devices} local / {info.global_devices} "
+                  f"global devices)", file=sys.stderr)
         self.configure_runtime()
         params = self.parse_params(args)
         runner = self.runner(args)
