@@ -47,33 +47,40 @@ FOLDS = 5
 
 
 def synthesize(n: int):
-    """Synthetic frame: informative numerics, correlated pairs, categorical
-    signal, and a binary label — enough structure for the SanityChecker and
-    selector to have something real to do."""
-    import pandas as pd
+    """Synthetic COLUMNAR dataset (zero-copy into the reader's Dataset fast
+    path — no 20 GB pandas shadow): informative numerics, correlated pairs,
+    categorical signal, and a binary label — enough structure for the
+    SanityChecker and selector to have something real to do."""
+    import transmogrifai_tpu.types as T
+    from transmogrifai_tpu.columns import Dataset, NumericColumn, ObjectColumn
 
     rng = np.random.default_rng(7)
     cols = {}
+    ones = np.ones(n, bool)
     signal = rng.normal(size=n).astype(np.float32)
+    prev = None
     for j in range(N_NUM):
         noise = rng.normal(size=n).astype(np.float32)
         if j % 50 == 0:        # strongly informative
-            cols[f"num_{j}"] = signal * 0.8 + noise * 0.6
+            v = signal * np.float32(0.8) + noise * np.float32(0.6)
         elif j % 50 == 1:      # near-duplicate of the previous (corr ~0.999)
-            cols[f"num_{j}"] = cols[f"num_{j-1}"] + noise * 0.02
+            v = prev + noise * np.float32(0.02)
         elif j % 50 == 2:      # constant -> min-variance drop
-            cols[f"num_{j}"] = np.full(n, 3.14, np.float32)
+            v = np.full(n, 3.14, np.float32)
         else:
-            cols[f"num_{j}"] = noise
-    cats = np.array([f"c{k}" for k in range(8)])
+            v = noise
+        cols[f"num_{j}"] = NumericColumn(T.Real, v, ones)
+        prev = v
+    cats = np.array([f"c{k}" for k in range(8)], dtype=object)
     for j in range(N_CAT):
         idx = rng.integers(0, 8, n)
         if j % 10 == 0:  # label-associated category
             idx = np.where((signal > 0.5) & (rng.random(n) < 0.7), 0, idx)
-        cols[f"cat_{j}"] = cats[idx]
-    logits = signal * 1.5 + (cols["num_0"] * 0.5)
-    cols["label"] = (logits + rng.logistic(size=n) > 0).astype(np.float32)
-    return pd.DataFrame(cols)
+        cols[f"cat_{j}"] = ObjectColumn(T.PickList, cats[idx])
+    logits = signal * 1.5 + cols["num_0"].values * 0.5
+    y = (logits + rng.logistic(size=n) > 0).astype(np.float32)
+    cols["label"] = NumericColumn(T.RealNN, y, ones)
+    return Dataset(cols)
 
 
 def build(df):

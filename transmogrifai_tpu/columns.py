@@ -62,11 +62,14 @@ class NumericColumn(Column):
     """
 
     ftype: Type[FeatureType]
-    values: np.ndarray  # float64[n]
+    values: np.ndarray  # float64[n] (f32 preserved for huge data)
     mask: np.ndarray    # bool[n]
 
     def __post_init__(self):
-        self.values = np.asarray(self.values, dtype=np.float64)
+        # float32 sources keep their dtype (a 10M-row ingest must not 2x);
+        # everything else normalizes to float64 as before
+        v = np.asarray(self.values)
+        self.values = v if v.dtype == np.float32 else np.asarray(v, np.float64)
         self.mask = np.asarray(self.mask, dtype=bool)
         assert self.values.shape == self.mask.shape
 

@@ -228,7 +228,8 @@ class ModelSelector(BinaryEstimator, AllowLabelAsInput):
         label_col, vec_col = cols
         assert isinstance(label_col, NumericColumn) and isinstance(vec_col, VectorColumn)
         keep = label_col.mask
-        X = vec_col.values[keep]
+        # avoid a full-matrix copy when no labels are missing (10M x p data)
+        X = vec_col.values if keep.all() else vec_col.values[keep]
         y = label_col.values[keep].astype(np.float32)
         n = len(y)
 
@@ -237,7 +238,7 @@ class ModelSelector(BinaryEstimator, AllowLabelAsInput):
             train_idx, hold_idx = self.splitter.split(n, y)
         else:
             train_idx, hold_idx = np.arange(n), np.array([], dtype=np.int64)
-        Xtr, ytr = X[train_idx], y[train_idx]
+        ytr = y[train_idx]
 
         # 2. preValidationPrepare (DataBalancer.estimate etc.)
         prep_summary: Optional[SplitterSummary] = None
@@ -245,6 +246,26 @@ class ModelSelector(BinaryEstimator, AllowLabelAsInput):
         if self.splitter is not None:
             prep_summary = self.splitter.pre_validation_prepare(ytr)
             prep_w = self.splitter.prepare_weights(ytr)
+
+        # 2b. maxTrainingSample cap BEFORE materializing the sweep matrix
+        # (reference splitters downsample in preValidationPrepare /
+        # validationPrepare — DataSplitter.scala:65, DataBalancer.scala:84).
+        # Rows are drawn proportionally to the preparation weights, so the
+        # subsample IS the prepared (balanced, capped) training distribution;
+        # the sweep then runs unweighted on data that fits one chip.
+        cap = getattr(self.splitter, "max_training_sample", None) \
+            if self.splitter is not None else None
+        if cap and len(train_idx) > cap:
+            rng = np.random.default_rng(self.validator.seed)
+            p = None
+            if prep_w is not None and prep_w.sum() > 0:
+                p = np.asarray(prep_w, np.float64)
+                p = p / p.sum()
+            sub = rng.choice(len(train_idx), size=int(cap), replace=False, p=p)
+            train_idx = train_idx[np.sort(sub)]
+            ytr = y[train_idx]
+            prep_w = None  # the draw already applied the preparation weights
+        Xtr = X[train_idx]
 
         # 3. the sweep (skipped when workflow-level CV already chose a winner)
         if self.best_estimator is not None:

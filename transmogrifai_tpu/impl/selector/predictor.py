@@ -111,11 +111,26 @@ class PredictorModel(Model):
         self.predictor_class = predictor_class
         self.model_params = model_params or {}
 
+    #: score in row chunks once n*d exceeds this many elements — the full
+    #: matrix of a 10M-row dataset cannot live in one chip's HBM
+    _PREDICT_CHUNK_CELLS = 1 << 27
+
     def transform_columns(self, cols: Sequence[Column]) -> PredictionColumn:
         vec_col = cols[-1]
         assert isinstance(vec_col, VectorColumn)
-        pred, raw, prob = self.predictor_class.predict_arrays(self.model_params,
-                                                              vec_col.values)
-        return PredictionColumn(T.Prediction, np.asarray(pred, dtype=np.float64),
-                                None if raw is None else np.asarray(raw, np.float64),
-                                None if prob is None else np.asarray(prob, np.float64))
+        V = vec_col.values
+        n = V.shape[0]
+        cells = int(n) * int(V.shape[1] if V.ndim > 1 else 1)
+        if cells <= self._PREDICT_CHUNK_CELLS:
+            parts = [self.predictor_class.predict_arrays(self.model_params, V)]
+        else:
+            rows = max(self._PREDICT_CHUNK_CELLS // max(V.shape[1], 1), 1)
+            parts = [self.predictor_class.predict_arrays(self.model_params,
+                                                         V[lo:lo + rows])
+                     for lo in range(0, n, rows)]
+        pred = np.concatenate([np.asarray(p, np.float64) for p, _, _ in parts])
+        raw = None if parts[0][1] is None else np.concatenate(
+            [np.asarray(r, np.float64) for _, r, _ in parts])
+        prob = None if parts[0][2] is None else np.concatenate(
+            [np.asarray(q, np.float64) for _, _, q in parts])
+        return PredictionColumn(T.Prediction, pred, raw, prob)

@@ -46,10 +46,12 @@ def _extract_columns(raw_features: Sequence[Feature], records: List[Dict[str, An
         if df is not None and isinstance(ex, FieldExtractor) and ex.field_name in df.columns:
             series = df[ex.field_name]
             if issubclass(f.ftype, T.OPNumeric):
-                vals = pd.to_numeric(series, errors="coerce").to_numpy(dtype=np.float64,
+                # f32 sources stay f32 (no 2x blow-up at 10M+ rows)
+                dt = np.float32 if series.dtype == np.float32 else np.float64
+                vals = pd.to_numeric(series, errors="coerce").to_numpy(dtype=dt,
                                                                        na_value=np.nan)
                 mask = ~np.isnan(vals)
-                vals = np.where(mask, vals, 0.0)
+                vals = np.where(mask, vals, dt(0.0))
                 cols[f.name] = NumericColumn(f.ftype, vals, mask)
                 continue
             if issubclass(f.ftype, T.Text):
@@ -110,6 +112,12 @@ class DataReader(Reader):
 
         data = self.read(params)
         if isinstance(data, Dataset):
+            # zero-copy fast path: a columnar Dataset whose columns already
+            # match every raw feature's field extractor (and key needs) is
+            # consumed directly — no pandas round-trip, no row dicts
+            direct = self._dataset_direct(raw_features, data, params)
+            if direct is not None:
+                return direct
             data = data.to_pandas()  # keeps field extraction on the vectorized path
         df = data if isinstance(data, pd.DataFrame) else None
         limit = (params or {}).get("maybeReaderParams", {}).get("limit") or (params or {}).get("limit")
@@ -125,6 +133,32 @@ class DataReader(Reader):
             df = df.head(int(limit)) if df is not None else None
         cols = _extract_columns(raw_features, records, df)
         keys = np.array([self._key_of(r, i) for i, r in enumerate(records)], dtype=object)
+        return Dataset(cols, keys)
+
+    def _dataset_direct(self, raw_features: Sequence[Feature], data: Dataset,
+                        params) -> Optional[Dataset]:
+        limit = (params or {}).get("maybeReaderParams", {}).get("limit") \
+            or (params or {}).get("limit")
+        if limit or callable(self.key):
+            return None
+        if isinstance(self.key, str) and self.key not in data.columns:
+            return None
+        cols: Dict[str, Any] = {}
+        for f in raw_features:
+            ex = getattr(f.origin_stage, "extract_fn", None)
+            if not (isinstance(ex, FieldExtractor) and ex.field_name in data.columns):
+                return None
+            col = data[ex.field_name]
+            if not issubclass(col.ftype, f.ftype):
+                return None
+            cols[f.name] = col
+        if isinstance(self.key, str):
+            keys = np.asarray([str(v) for v in
+                               np.asarray(data[self.key].values)], dtype=object)
+        elif data.key is not None:
+            keys = data.key
+        else:
+            keys = np.arange(len(data)).astype(str).astype(object)
         return Dataset(cols, keys)
 
     def _fully_vectorizable(self, raw_features: Sequence[Feature], df) -> bool:

@@ -1,0 +1,169 @@
+"""Ben-Haim / Tom-Tov streaming histogram.
+
+Reference parity: utils/src/main/java/com/salesforce/op/utils/stats/
+StreamingHistogram.java:36 — the reference keeps a fixed number of
+(centroid, count) bins; inserting a point adds a unit bin and merges the
+closest centroid pair; two histograms merge by concatenation + repeated
+closest-pair merging; ``sum(x)`` estimates the count of points <= x by the
+paper's trapezoid interpolation (Algorithm 3, JMLR 11 (2010) 849-872).
+
+The update path here is the same algorithm with a batch fast-path: a batch
+is first exactly aggregated to unit bins (np.unique) — mathematically the
+paper's MERGE of the batch's exact histogram, identical to sequential
+insertion when no intra-batch compression triggers, and the standard
+distributed formulation otherwise (it is how the reference combines
+per-partition histograms).  Oversized batches pre-aggregate to
+``4 * max_bins`` quantile bins first.
+
+Used for score/feature distributions in streaming scoring and available to
+RawFeatureFilter as the numeric-distribution sketch.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class StreamingHistogram:
+    """Fixed-size (centroid, count) sketch with BH-2010 semantics."""
+
+    def __init__(self, max_bins: int = 100):
+        if max_bins < 2:
+            raise ValueError("max_bins must be >= 2")
+        self.max_bins = int(max_bins)
+        self.centers = np.empty(0, np.float64)
+        self.counts = np.empty(0, np.float64)
+
+    # ---- construction ------------------------------------------------------
+    def update(self, value: float) -> "StreamingHistogram":
+        """Insert ONE point (StreamingHistogram.java update): add a unit bin,
+        compress if over capacity."""
+        self._absorb(np.asarray([value], np.float64), np.ones(1))
+        return self
+
+    def update_all(self, values: Iterable[float]) -> "StreamingHistogram":
+        """Batch insert: exact unit-bin aggregation, then one merge+compress
+        (the paper's histogram MERGE of the batch's exact histogram)."""
+        vals = np.asarray(list(values) if not isinstance(values, np.ndarray)
+                          else values, np.float64).ravel()
+        vals = vals[~np.isnan(vals)]
+        if vals.size == 0:
+            return self
+        uniq, cnt = np.unique(vals, return_counts=True)
+        if uniq.size > 4 * self.max_bins:
+            # pre-aggregate a huge batch to quantile bins (bounded compress)
+            qs = np.linspace(0, 1, 4 * self.max_bins + 1)
+            edges = np.quantile(vals, qs)
+            idx = np.clip(np.searchsorted(edges, vals, side="right") - 1,
+                          0, 4 * self.max_bins - 1)
+            cnt = np.bincount(idx, minlength=4 * self.max_bins).astype(np.float64)
+            sums = np.bincount(idx, weights=vals, minlength=4 * self.max_bins)
+            keep = cnt > 0
+            uniq = sums[keep] / cnt[keep]
+            cnt = cnt[keep]
+        self._absorb(uniq, cnt.astype(np.float64))
+        return self
+
+    def merge(self, other: "StreamingHistogram") -> "StreamingHistogram":
+        """Combine two sketches (the distributed reduce)."""
+        self._absorb(other.centers, other.counts)
+        return self
+
+    def _absorb(self, centers: np.ndarray, counts: np.ndarray) -> None:
+        c = np.concatenate([self.centers, centers])
+        w = np.concatenate([self.counts, counts])
+        order = np.argsort(c, kind="stable")
+        c, w = c[order], w[order]
+        # coalesce exact duplicates
+        if c.size > 1:
+            same = np.concatenate([[False], np.diff(c) == 0.0])
+            if same.any():
+                grp = np.cumsum(~same) - 1
+                c = c[~same]
+                w = np.bincount(grp, weights=w)
+        # closest-pair merging down to capacity (paper Algorithm 1 step 5)
+        c_list: List[float] = list(c)
+        w_list: List[float] = list(w)
+        while len(c_list) > self.max_bins:
+            gaps = np.diff(np.asarray(c_list))
+            i = int(np.argmin(gaps))
+            wa, wb = w_list[i], w_list[i + 1]
+            tot = wa + wb
+            c_list[i] = (c_list[i] * wa + c_list[i + 1] * wb) / tot
+            w_list[i] = tot
+            del c_list[i + 1], w_list[i + 1]
+        self.centers = np.asarray(c_list, np.float64)
+        self.counts = np.asarray(w_list, np.float64)
+
+    # ---- queries -----------------------------------------------------------
+    @property
+    def total(self) -> float:
+        return float(self.counts.sum())
+
+    def bins(self) -> List[Tuple[float, float]]:
+        """[(centroid, count)] — the reference's getBins."""
+        return [(float(p), float(m)) for p, m in zip(self.centers, self.counts)]
+
+    def sum_upto(self, x: float) -> float:
+        """Estimated number of points <= x (paper Algorithm 3 / java sum)."""
+        c, w = self.centers, self.counts
+        if c.size == 0:
+            return 0.0
+        if x < c[0]:
+            return 0.0
+        if x >= c[-1]:
+            return float(w.sum())
+        i = int(np.searchsorted(c, x, side="right") - 1)
+        pi, pi1 = c[i], c[i + 1]
+        mi, mi1 = w[i], w[i + 1]
+        # trapezoid: m_x = mi + (mi1 - mi) * t ; area under [pi, x]
+        t = (x - pi) / (pi1 - pi)
+        mx = mi + (mi1 - mi) * t
+        s = (mi + mx) * t / 2.0
+        return float(w[:i].sum() + mi / 2.0 + s)
+
+    def cdf(self, x: float) -> float:
+        tot = self.total
+        return self.sum_upto(x) / tot if tot else 0.0
+
+    def quantile(self, q: float) -> float:
+        """Inverse of sum_upto by bisection (java uniform/quantile analog)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError("q must be in [0, 1]")
+        c = self.centers
+        if c.size == 0:
+            return float("nan")
+        lo, hi = float(c[0]), float(c[-1])
+        target = q * self.total
+        for _ in range(64):
+            mid = (lo + hi) / 2.0
+            if self.sum_upto(mid) < target:
+                lo = mid
+            else:
+                hi = mid
+        return (lo + hi) / 2.0
+
+    def uniform(self, n_bins: int) -> List[float]:
+        """n_bins-quantile boundaries (java uniform): values splitting the
+        mass into ``n_bins`` equal parts."""
+        return [self.quantile(k / n_bins) for k in range(1, n_bins)]
+
+    def density(self, edges: Sequence[float]) -> np.ndarray:
+        """Estimated counts per [edges[i], edges[i+1]) interval — the shape
+        RawFeatureFilter's FeatureDistribution consumes."""
+        sums = np.asarray([self.sum_upto(e) for e in edges])
+        return np.diff(sums)
+
+    # ---- (de)serialization -------------------------------------------------
+    def to_json(self) -> dict:
+        return {"maxBins": self.max_bins,
+                "centers": self.centers.tolist(),
+                "counts": self.counts.tolist()}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "StreamingHistogram":
+        h = cls(max_bins=int(d["maxBins"]))
+        h.centers = np.asarray(d["centers"], np.float64)
+        h.counts = np.asarray(d["counts"], np.float64)
+        return h

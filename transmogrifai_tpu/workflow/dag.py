@@ -89,19 +89,65 @@ def _maybe_time(stage, phase: str, n_rows: int):
     return listener.time_stage(stage, phase, n_rows)
 
 
+#: free dead intermediate columns once a dataset exceeds this many cells —
+#: the Spark persist/unpersist cadence analog (FitStagesUtil.scala:117,158);
+#: below it, keeping intermediates aids debugging and costs nothing
+FREE_INTERMEDIATES_CELLS = 100_000_000
+
+
+def _dead_columns(dag: List[Layer], layer_idx: int, ds: Dataset) -> List[str]:
+    """Columns no stage after ``layer_idx`` consumes and that are not
+    responses (labels feed evaluators after training)."""
+    live = set()
+    for later in dag[layer_idx + 1:]:
+        for stage in later:
+            for f in stage.inputs:
+                live.add(f.name)
+    if dag:
+        for stage in dag[-1]:
+            for f in stage.get_outputs():
+                live.add(f.name)
+    dead = []
+    for name, col in ds.columns.items():
+        if name in live:
+            continue
+        if getattr(getattr(col, "ftype", None), "__name__", "") == "Prediction":
+            continue
+        dead.append(name)
+    return dead
+
+
+def _maybe_free(dag: List[Layer], layer_idx: int, ds: Dataset,
+                responses: set) -> Dataset:
+    try:
+        n = len(ds)
+    except Exception:
+        return ds
+    total_cells = sum(n * (getattr(c, "width", None) or 1)
+                      for c in ds.columns.values())
+    if total_cells < FREE_INTERMEDIATES_CELLS:
+        return ds
+    dead = [c for c in _dead_columns(dag, layer_idx, ds) if c not in responses]
+    return ds.drop(dead) if dead else ds
+
+
 def fit_and_transform_dag(dag: List[Layer], train: Dataset,
                           test: Optional[Dataset] = None,
                           fitted_so_far: Optional[Dict[str, PipelineStage]] = None,
+                          responses: Optional[set] = None,
                           ) -> FittedDAG:
     """Fit estimators layer by layer, transforming train (+test) as we go.
 
     ``fitted_so_far`` maps stage uid -> already-fitted model — the analog of
     ``OpWorkflow.withModelStages`` warm-starting (OpWorkflow.scala:468): those
-    stages are applied, not refitted.
+    stages are applied, not refitted.  On large data, intermediate columns
+    that no later stage consumes are freed after each layer (KeepRawFeatures
+    defaults false in the reference, OpWorkflowModel.scala:458-463).
     """
     fitted_so_far = fitted_so_far or {}
+    responses = responses or set()
     fitted: List[PipelineStage] = []
-    for layer in dag:
+    for li, layer in enumerate(dag):
         transformers: List[Transformer] = []
         for stage in layer:
             if stage.uid in fitted_so_far:
@@ -119,8 +165,10 @@ def fit_and_transform_dag(dag: List[Layer], train: Dataset,
             else:
                 raise TypeError(f"Stage {stage} is neither Estimator nor Transformer")
         train = _apply_layer_transforms(train, transformers)
+        train = _maybe_free(dag, li, train, responses)
         if test is not None:
             test = _apply_layer_transforms(test, transformers)
+            test = _maybe_free(dag, li, test, responses)
     return FittedDAG(train=train, test=test, fitted_stages=fitted)
 
 

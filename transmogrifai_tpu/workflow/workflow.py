@@ -170,7 +170,8 @@ class OpWorkflow(OpWorkflowCore):
             fitted = self._fit_stages_cv(data)
         else:
             fitted = dag_util.fit_and_transform_dag(
-                self.dag, data, fitted_so_far=self._fitted_stage_map)
+                self.dag, data, fitted_so_far=self._fitted_stage_map,
+                responses=self._response_names())
 
         model = OpWorkflowModel()
         model.reader = self.reader
@@ -184,6 +185,9 @@ class OpWorkflow(OpWorkflowCore):
         model.rff_results = self.rff_results
         model.train_data = fitted.train
         return model
+
+    def _response_names(self) -> set:
+        return {f.name for f in self.raw_features if f.is_response}
 
     def _set_blocklist(self, dropped: Sequence[Feature], dropped_map_keys: Dict[str, List[str]]):
         """Blocklist propagation: drop raw features + rebuild the DAG without
@@ -216,9 +220,11 @@ class OpWorkflow(OpWorkflowCore):
         cut = dag_util.cut_dag(self.dag)
         if cut.model_selector is None:
             return dag_util.fit_and_transform_dag(
-                self.dag, data, fitted_so_far=self._fitted_stage_map)
+                self.dag, data, fitted_so_far=self._fitted_stage_map,
+                responses=self._response_names())
         before = dag_util.fit_and_transform_dag(
-            cut.before, data, fitted_so_far=self._fitted_stage_map)
+            cut.before, data, fitted_so_far=self._fitted_stage_map,
+            responses=self._response_names())
         selector = cut.model_selector
         feature_layers = [layer for layer in cut.during
                           if not (len(layer) == 1 and layer[0] is selector)]
@@ -229,7 +235,8 @@ class OpWorkflow(OpWorkflowCore):
         # firstCVTSIndex == -1 branch)
         rest = dag_util.fit_and_transform_dag(
             cut.during + cut.after, before.train,
-            fitted_so_far=self._fitted_stage_map)
+            fitted_so_far=self._fitted_stage_map,
+            responses=self._response_names())
         return dag_util.FittedDAG(
             train=rest.train, test=None,
             fitted_stages=before.fitted_stages + rest.fitted_stages)
