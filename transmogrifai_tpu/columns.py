@@ -185,6 +185,10 @@ class PredictionColumn(Column):
     prediction: np.ndarray                      # float64[n]
     raw_prediction: Optional[np.ndarray] = None  # float64[n, k]
     probability: Optional[np.ndarray] = None     # float64[n, k]
+    #: producing stage's summary metadata (the reference stores model-selector
+    #: summaries in the output column's schema metadata — SelectedModelCombiner
+    #: reads them from its input columns, SelectedModelCombiner.scala:99)
+    metadata: Optional[Dict[str, Any]] = None
 
     def __post_init__(self):
         self.prediction = np.asarray(self.prediction, dtype=np.float64)
@@ -209,6 +213,7 @@ class PredictionColumn(Column):
             self.prediction[idx],
             None if self.raw_prediction is None else self.raw_prediction[idx],
             None if self.probability is None else self.probability[idx],
+            metadata=self.metadata,
         )
 
     @staticmethod

@@ -1,0 +1,232 @@
+"""SelectedModelCombiner — ensemble the predictions of two ModelSelectors.
+
+Reference parity: core/.../impl/selector/SelectedModelCombiner.scala — an
+estimator over (label RealNN, Prediction, Prediction) that reads both
+selectors' summaries from their output-column metadata, resolves a common
+comparison metric, and produces a model combining the predictions:
+
+- ``best``     (default): all weight on the winner by the decision metric
+  (direction per ``is_larger_better``; ties resolve to selector 2, matching
+  the reference's strict ``>`` comparison),
+- ``weighted``: weights metricValue_i / (metricValue_1 + metricValue_2),
+- ``equal``:    0.5 / 0.5.
+
+Metric resolution (SelectedModelCombiner.scala:124-138): if both summaries
+used the same validation metric, compare winning validation metric values;
+otherwise look for one selector's metric inside the other's TRAIN
+evaluation; non-overlapping metrics raise.
+
+The model's transform combines raw predictions and probabilities by weight;
+the prediction is argmax of the combined probability when present, else the
+weighted prediction (SelectedCombinerModel.transformFn).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ... import types as T
+from ...columns import Column, Dataset, NumericColumn, PredictionColumn
+from ...stages.base import AllowLabelAsInput, Estimator, Model
+from .model_selector import ModelSelectorSummary
+
+STRATEGIES = ("best", "weighted", "equal")
+
+
+def _metric_value(metrics: Dict[str, Any], name: str) -> Optional[float]:
+    """First numeric entry whose key contains the metric name
+    (SelectedModelCombiner.getMetricValue)."""
+    if not metrics:
+        return None
+    for k, v in metrics.items():
+        if isinstance(v, (int, float)) and name and name.lower() in k.lower():
+            return float(v)
+    return None
+
+
+def _winning_metric(summary: ModelSelectorSummary) -> Optional[float]:
+    """The best model's validation metric value (getWinningModelMetric)."""
+    for r in summary.validation_results:
+        if r.get("modelUID", r.get("model_uid")) == summary.best_model_uid:
+            mv = r.get("metricValues", r.get("metric_values", {}))
+            if isinstance(mv, dict):
+                got = _metric_value(mv, summary.evaluation_metric)
+                if got is not None:
+                    return got
+            v = r.get("metricValue", r.get("metric_value"))
+            if isinstance(v, (int, float)):
+                return float(v)
+    return None
+
+
+class SelectedModelCombiner(Estimator, AllowLabelAsInput):
+    """(label RealNN, Prediction, Prediction) -> Prediction."""
+
+    def __init__(self, combination_strategy: str = "best",
+                 uid: Optional[str] = None, **extra):
+        if combination_strategy not in STRATEGIES:
+            raise ValueError(f"combination_strategy must be one of {STRATEGIES}")
+        super().__init__(operation_name="combineModels", output_type=T.Prediction,
+                         uid=uid, combination_strategy=combination_strategy,
+                         **extra)
+
+    def check_input_types(self, features) -> None:
+        if len(features) != 3:
+            raise ValueError("SelectedModelCombiner takes (label, pred1, pred2)")
+        _, p1, p2 = features
+        from ...features.generator import FeatureGeneratorStage
+
+        for p in (p1, p2):
+            if not issubclass(p.ftype, T.Prediction):
+                raise ValueError("Predictions must come from model selectors")
+            origin = p.origin_stage
+            # raw prediction features (FeatureGeneratorStage) pass here; fit
+            # still requires the model-selector summary on the column
+            if origin is not None and not (
+                    getattr(origin, "is_model_selector", False)
+                    or isinstance(origin, (SelectedModelCombiner,
+                                           FeatureGeneratorStage))):
+                raise ValueError(
+                    "Predictions must be from model selectors - other types "
+                    "of model are not supported at this time")
+
+    # ---- fit ---------------------------------------------------------------
+    def fit_columns(self, cols: Sequence[Column], dataset: Dataset
+                    ) -> "SelectedCombinerModel":
+        label_col, c1, c2 = cols
+        assert isinstance(c1, PredictionColumn) and isinstance(c2, PredictionColumn)
+        s1 = self._summary_of(c1, 1)
+        s2 = self._summary_of(c2, 2)
+        if s1.problem_type != s2.problem_type:
+            raise ValueError(
+                f"Cannot combine model selectors for different problem types "
+                f"found {s1.problem_type} and {s2.problem_type}")
+
+        m1, m2, metric, larger_better = self._resolve_metrics(s1, s2)
+        strategy = self.get_param("combination_strategy", "best")
+        if strategy == "best":
+            first_wins = (m1 > m2) if larger_better else (m1 < m2)
+            w1, w2 = (1.0, 0.0) if first_wins else (0.0, 1.0)
+        elif strategy == "weighted":
+            w1, w2 = m1 / (m1 + m2), m2 / (m1 + m2)
+        else:
+            w1, w2 = 0.5, 0.5
+
+        model = SelectedCombinerModel(weight1=w1, weight2=w2,
+                                      strategy=strategy, metric=metric,
+                                      operation_name=self.operation_name)
+        # metadata: winner's summary for "best"; merged summary otherwise
+        # (SelectedModelCombiner.scala:163-185)
+        if strategy == "best":
+            winner = s1 if w1 > 0.5 else s2
+            model.metadata = {"model_selector_summary": winner.to_json()}
+        else:
+            combined = model._combine(c1, c2)
+            train_eval = self._evaluate(label_col, combined, s1.problem_type)
+            merged = ModelSelectorSummary(
+                validation_type=s1.validation_type,
+                validation_parameters={
+                    **{k + "_1": v for k, v in s1.validation_parameters.items()},
+                    **{k + "_2": v for k, v in s2.validation_parameters.items()}},
+                data_prep_parameters={
+                    **{k + "_1": v for k, v in s1.data_prep_parameters.items()},
+                    **{k + "_2": v for k, v in s2.data_prep_parameters.items()}},
+                data_prep_results=s1.data_prep_results or s2.data_prep_results,
+                evaluation_metric=metric,
+                problem_type=s1.problem_type,
+                best_model_uid=f"{s1.best_model_uid} {s2.best_model_uid}",
+                best_model_name=f"{s1.best_model_name} {s2.best_model_name}",
+                best_model_type=f"{s1.best_model_type} {s2.best_model_type}",
+                best_grid={},
+                validation_results=list(s1.validation_results)
+                + list(s2.validation_results),
+                train_evaluation=train_eval,
+                holdout_evaluation=None)
+            model.metadata = {"model_selector_summary": merged.to_json()}
+        return model
+
+    def _summary_of(self, col: PredictionColumn, pos: int) -> ModelSelectorSummary:
+        md = col.metadata or {}
+        d = md.get("model_selector_summary")
+        if d is None:
+            raise ValueError(
+                f"Prediction input {pos} carries no model-selector summary — "
+                "predictions must be produced by a fitted ModelSelector")
+        return ModelSelectorSummary.from_json(d)
+
+    def _resolve_metrics(self, s1: ModelSelectorSummary, s2: ModelSelectorSummary
+                         ) -> Tuple[float, float, str, bool]:
+        e1, e2 = s1.evaluation_metric, s2.evaluation_metric
+        if e1 == e2:
+            m1, m2 = _winning_metric(s1), _winning_metric(s2)
+            metric = e1
+        else:
+            m2 = _metric_value(s2.train_evaluation, e1)
+            if m2 is not None:
+                m1, metric = _metric_value(s1.train_evaluation, e1), e1
+            else:
+                m1 = _metric_value(s1.train_evaluation, e2)
+                m2, metric = _metric_value(s2.train_evaluation, e2), e2
+        if m1 is None or m2 is None:
+            raise ValueError(
+                "Evaluation metrics for two model selectors are non-overlapping")
+        return float(m1), float(m2), metric, _is_larger_better(metric)
+
+    def _evaluate(self, label_col: NumericColumn, pred: PredictionColumn,
+                  problem_type: str) -> Dict[str, Any]:
+        from ...evaluators import (OpBinaryClassificationEvaluator,
+                                   OpMultiClassificationEvaluator,
+                                   OpRegressionEvaluator)
+
+        ev = {"BinaryClassification": OpBinaryClassificationEvaluator,
+              "MultiClassification": OpMultiClassificationEvaluator,
+              }.get(problem_type, OpRegressionEvaluator)()
+        y = np.asarray(label_col.values, np.float64)
+        return ev.evaluate_arrays(y, pred.prediction, pred.probability)
+
+
+def _is_larger_better(metric: str) -> bool:
+    m = (metric or "").lower()
+    smaller = ("error", "rmse", "mse", "mae", "logloss", "log loss", "smape",
+               "mase", "loss")
+    return not any(s in m for s in smaller)
+
+
+class SelectedCombinerModel(Model):
+    """Weighted prediction combiner (SelectedCombinerModel.transformFn)."""
+
+    def __init__(self, weight1: float = 1.0, weight2: float = 0.0,
+                 strategy: str = "best", metric: str = "",
+                 operation_name: str = "combineModels",
+                 uid: Optional[str] = None, **kw):
+        super().__init__(operation_name, T.Prediction, uid=uid,
+                         weight1=weight1, weight2=weight2, strategy=strategy,
+                         metric=metric, **kw)
+        self.weight1 = float(weight1)
+        self.weight2 = float(weight2)
+        self.strategy = strategy
+        self.metric = metric
+
+    def _combine(self, c1: PredictionColumn, c2: PredictionColumn
+                 ) -> PredictionColumn:
+        w1, w2 = self.weight1, self.weight2
+
+        def mix(a, b):
+            if a is None or b is None:
+                return None
+            return a * w1 + b * w2
+
+        raw = mix(c1.raw_prediction, c2.raw_prediction)
+        prob = mix(c1.probability, c2.probability)
+        if prob is not None and prob.size:
+            pred = prob.argmax(axis=1).astype(np.float64)
+        else:
+            pred = c1.prediction * w1 + c2.prediction * w2
+        return PredictionColumn(T.Prediction, pred, raw, prob,
+                                metadata=dict(self.metadata) or None)
+
+    def transform_columns(self, cols: Sequence[Column]) -> PredictionColumn:
+        _, c1, c2 = cols
+        assert isinstance(c1, PredictionColumn) and isinstance(c2, PredictionColumn)
+        return self._combine(c1, c2)

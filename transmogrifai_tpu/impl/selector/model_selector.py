@@ -338,3 +338,11 @@ class SelectedModel(PredictorModel):
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
         self.summary: Optional[ModelSelectorSummary] = None
+
+    def transform_columns(self, cols):
+        out = super().transform_columns(cols)
+        # summary travels on the output column (reference: summary metadata in
+        # the output column schema) so SelectedModelCombiner can read it
+        if self.summary is not None:
+            out.metadata = {"model_selector_summary": self.summary.to_json()}
+        return out

@@ -107,7 +107,11 @@ class OpWorkflow(OpWorkflowCore):
     def _use_workflow_cv(self) -> bool:
         if self.workflow_cv is not None:
             return self.workflow_cv
-        return any(getattr(s, "is_model_selector", False) for s in self.stages)
+        # auto: exactly one selector (cut_dag's requirement; two selectors —
+        # the SelectedModelCombiner shape — fit on the plain path, matching
+        # the reference where cutDAG throws on >1, FitStagesUtil.scala:310)
+        return sum(1 for s in self.stages
+                   if getattr(s, "is_model_selector", False)) == 1
 
     # ---- DAG setup ---------------------------------------------------------
     def set_result_features(self, *features: Feature) -> "OpWorkflow":
@@ -135,9 +139,9 @@ class OpWorkflow(OpWorkflowCore):
             if s.uid in seen and seen[s.uid] is not s:
                 raise ValueError(f"Duplicate stage uid {s.uid!r} on distinct stages")
             seen[s.uid] = s
-        n_selectors = sum(1 for s in self.stages if getattr(s, "is_model_selector", False))
-        if n_selectors > 1:
-            raise ValueError("At most one ModelSelector is supported per workflow")
+        # >1 ModelSelector is allowed (SelectedModelCombiner ensembles two);
+        # only the workflow-CV path restricts to one (cut_dag raises there,
+        # matching FitStagesUtil.cutDAG:310)
 
     # ---- raw feature filter (OpWorkflow.scala:544 withRawFeatureFilter) ----
     def with_raw_feature_filter(self, train_reader: Optional[Reader] = None,
