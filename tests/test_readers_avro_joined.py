@@ -1,0 +1,172 @@
+"""Reader-tier completion tests (round-2 VERDICT #7): vendored Avro codec,
+AvroReader through the DataReaders factory, CSVToAvro, post-join time-based
+aggregation, and multi-batch streaming scoring.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu import Dataset, FeatureBuilder, OpWorkflow
+from transmogrifai_tpu.readers import DataReaders
+from transmogrifai_tpu.readers.avro_io import (csv_to_avro, infer_schema,
+                                               read_avro, write_avro)
+
+
+SCHEMA = {"type": "record", "name": "Passenger", "fields": [
+    {"name": "id", "type": "long"},
+    {"name": "name", "type": ["null", "string"]},
+    {"name": "age", "type": ["null", "double"]},
+    {"name": "survived", "type": "boolean"},
+    {"name": "tags", "type": {"type": "array", "items": "string"}},
+    {"name": "scores", "type": {"type": "map", "values": "double"}},
+]}
+
+RECORDS = [
+    {"id": 1, "name": "a", "age": 30.5, "survived": True,
+     "tags": ["x", "y"], "scores": {"m": 1.5}},
+    {"id": 2, "name": None, "age": None, "survived": False,
+     "tags": [], "scores": {}},
+    {"id": 3, "name": "c", "age": 19.0, "survived": True,
+     "tags": ["z"], "scores": {"m": -2.0, "n": 0.25}},
+]
+
+
+class TestAvroCodec:
+    @pytest.mark.parametrize("codec", ["null", "deflate"])
+    def test_roundtrip(self, tmp_path, codec):
+        p = str(tmp_path / "data.avro")
+        write_avro(p, SCHEMA, RECORDS, codec=codec)
+        schema, records = read_avro(p)
+        assert schema["name"] == "Passenger"
+        assert records == RECORDS
+
+    def test_multi_block(self, tmp_path):
+        p = str(tmp_path / "blocks.avro")
+        many = [{"id": i, "name": f"n{i}", "age": float(i), "survived": i % 2 == 0,
+                 "tags": [], "scores": {}} for i in range(1000)]
+        write_avro(p, SCHEMA, many, block_records=128)
+        _, records = read_avro(p)
+        assert len(records) == 1000 and records[500]["id"] == 500
+
+    def test_avro_reader_factory(self, tmp_path):
+        p = str(tmp_path / "data.avro")
+        write_avro(p, SCHEMA, RECORDS)
+        reader = DataReaders.Simple.avro(p, key="id")
+        age = FeatureBuilder("age", T.Real).extract(field="age").as_predictor()
+        surv = FeatureBuilder("survived", T.Binary).extract(
+            field="survived").as_predictor()
+        ds = reader.generate_dataset([age, surv], {})
+        assert len(ds) == 3
+        col = ds["age"]
+        assert not col.mask[list(ds.key).index("2")]  # null age -> missing
+
+    def test_csv_to_avro(self, tmp_path):
+        csv = tmp_path / "in.csv"
+        pd.DataFrame({"id": [1, 2], "name": ["a", None],
+                      "x": [0.5, 1.5]}).to_csv(csv, index=False)
+        avro = str(tmp_path / "out.avro")
+        schema = csv_to_avro(str(csv), avro)
+        assert {f["name"] for f in schema["fields"]} == {"id", "name", "x"}
+        _, records = read_avro(avro)
+        assert records[0]["id"] == 1 and records[0]["x"] == 0.5
+        assert records[1]["name"] is None
+
+    def test_infer_schema_types(self):
+        df = pd.DataFrame({"i": [1], "f": [1.5], "b": [True], "s": ["x"]})
+        sch = infer_schema(df)
+        types = {f["name"]: f["type"][1] for f in sch["fields"]}
+        assert types == {"i": "long", "f": "double", "b": "boolean", "s": "string"}
+
+
+class TestPostJoinAggregation:
+    def _readers(self):
+        left = pd.DataFrame({"id": [1, 2, 3], "label": [0.0, 1.0, 0.0]})
+        # right: EVENTS, many per key, with timestamps
+        right = pd.DataFrame({
+            "id":     [1,    1,    1,    2,    3],
+            "amount": [10.0, 20.0, 40.0, 5.0,  7.0],
+            "t":      [100,  200,  900,  150,  950],
+        })
+        lr = DataReaders.Simple.custom(left, key="id")
+        rr = DataReaders.Simple.custom(right, key="id")
+        return lr, rr
+
+    def test_aggregates_right_side_events(self):
+        from transmogrifai_tpu.features.aggregators import SumNumeric
+        from transmogrifai_tpu.readers.joined import TimeBasedFilter
+
+        lr, rr = self._readers()
+        joined = lr.inner_join(rr).with_secondary_aggregation(
+            TimeBasedFilter(time_fn=lambda r: r["t"], cutoff_time_ms=500))
+        label = FeatureBuilder("label", T.RealNN).extract(field="label").as_response()
+        amount = FeatureBuilder("amount", T.Real).extract(
+            field="amount").aggregate(SumNumeric()).as_predictor()
+        ds = joined.generate_dataset([label, amount], {})
+        by_key = dict(zip(ds.key, ds["amount"].values))
+        # key 1: events at t=100,200 are before the 500 cutoff -> 10+20;
+        # t=900 is after the cutoff and excluded for a predictor
+        assert by_key["1"] == pytest.approx(30.0)
+        assert by_key["2"] == pytest.approx(5.0)
+        # key 3's only event is after the cutoff -> empty aggregate
+        assert not ds["amount"].mask[list(ds.key).index("3")]
+
+    def test_window_filters_old_events(self):
+        from transmogrifai_tpu.readers.joined import TimeBasedFilter
+
+        lr, rr = self._readers()
+        from transmogrifai_tpu.features.aggregators import SumNumeric
+
+        joined = lr.inner_join(rr).with_secondary_aggregation(
+            TimeBasedFilter(time_fn=lambda r: r["t"], cutoff_time_ms=500,
+                            window_ms=350))
+        amount = FeatureBuilder("amount", T.Real).extract(
+            field="amount").aggregate(SumNumeric()).as_predictor()
+        ds = joined.generate_dataset([amount], {})
+        by_key = dict(zip(ds.key, ds["amount"].values))
+        # window [150, 500): the t=100 event for key 1 drops, t=200 stays
+        assert by_key["1"] == pytest.approx(20.0)
+
+
+class TestMultiBatchStreaming:
+    def test_streaming_score_three_batches(self, tmp_path):
+        from transmogrifai_tpu import OpWorkflowRunner
+        from transmogrifai_tpu.readers import StreamingReader
+        from transmogrifai_tpu.runner import OpWorkflowRunType
+        from transmogrifai_tpu.impl.selector.factories import (
+            BinaryClassificationModelSelector)
+
+        rng = np.random.default_rng(0)
+        n = 240
+        df = pd.DataFrame({"id": np.arange(n),
+                           "x1": rng.normal(size=n),
+                           "x2": rng.normal(size=n)})
+        df["label"] = (df.x1 > 0).astype(float)
+        label = FeatureBuilder("label", T.RealNN).extract(field="label").as_response()
+        x1 = FeatureBuilder("x1", T.Real).extract(field="x1").as_predictor()
+        x2 = FeatureBuilder("x2", T.Real).extract(field="x2").as_predictor()
+        from transmogrifai_tpu.dsl import vectorize  # noqa: F401
+
+        vec = x1.vectorize(x2, label=label)
+        pred = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=2, seed=0, model_types=["OpLogisticRegression"]
+        ).set_input(label, vec).get_output()
+        wf = OpWorkflow().set_result_features(pred)
+
+        batches = [df.iloc[0:80], df.iloc[80:160], df.iloc[160:240]]
+        runner = OpWorkflowRunner(
+            wf, train_reader=DataReaders.Simple.custom(df, key="id"),
+            streaming_reader=StreamingReader(batches, key="id"))
+        runner.run(OpWorkflowRunType.Train,
+                   _params(tmp_path))
+        result = runner.run(OpWorkflowRunType.StreamingScore, _params(tmp_path))
+        assert result.n_scored == 240  # all three micro-batches scored
+
+
+def _params(tmp_path):
+    from transmogrifai_tpu.workflow.params import OpParams
+
+    p = OpParams()
+    p.model_location = str(tmp_path / "model")
+    p.write_location = str(tmp_path / "scores")
+    return p

@@ -1,0 +1,322 @@
+"""Pure-Python Avro Object Container File codec (no external deps).
+
+Reference parity: AvroReaders.scala:55 reads Avro records via spark-avro;
+utils/.../io/{AvroInOut,CSVToAvro} convert CSV to Avro.  fastavro is not in
+this image, so the container format (Avro 1.11 spec) is implemented here:
+
+    header:  "Obj\\x01" | metadata map (avro.schema JSON, avro.codec) | sync16
+    blocks:  count(varint-zigzag long) | byte-size(long) | payload | sync16
+
+Supported schema: records of primitives (null/boolean/int/long/float/double/
+bytes/string), nullable unions, arrays, maps, enums, fixed — the subset the
+reference's test data and CSVToAvro produce.  Codecs: null and deflate.
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import zlib
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+MAGIC = b"Obj\x01"
+SYNC_SIZE = 16
+
+
+# ---------------------------------------------------------------------------
+# Primitive binary encoding (Avro spec §"Binary encoding")
+# ---------------------------------------------------------------------------
+def _read_long(buf: io.BytesIO) -> int:
+    shift = 0
+    acc = 0
+    while True:
+        b = buf.read(1)
+        if not b:
+            raise EOFError("truncated varint")
+        byte = b[0]
+        acc |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            break
+        shift += 7
+    return (acc >> 1) ^ -(acc & 1)  # zigzag decode
+
+
+def _write_long(out: io.BytesIO, n: int) -> None:
+    n = (n << 1) ^ (n >> 63)  # zigzag encode
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.write(bytes([b | 0x80]))
+        else:
+            out.write(bytes([b]))
+            break
+
+
+def _read_value(buf: io.BytesIO, schema: Any) -> Any:
+    if isinstance(schema, list):  # union
+        idx = _read_long(buf)
+        return _read_value(buf, schema[idx])
+    if isinstance(schema, dict):
+        t = schema["type"]
+        if t == "record":
+            return {f["name"]: _read_value(buf, f["type"])
+                    for f in schema["fields"]}
+        if t == "array":
+            out = []
+            while True:
+                count = _read_long(buf)
+                if count == 0:
+                    break
+                if count < 0:
+                    _read_long(buf)  # block byte size, unused
+                    count = -count
+                for _ in range(count):
+                    out.append(_read_value(buf, schema["items"]))
+            return out
+        if t == "map":
+            out = {}
+            while True:
+                count = _read_long(buf)
+                if count == 0:
+                    break
+                if count < 0:
+                    _read_long(buf)
+                    count = -count
+                for _ in range(count):
+                    k = _read_value(buf, "string")
+                    out[k] = _read_value(buf, schema["values"])
+            return out
+        if t == "enum":
+            return schema["symbols"][_read_long(buf)]
+        if t == "fixed":
+            return buf.read(schema["size"])
+        return _read_value(buf, t)  # {"type": "string"} style
+    if schema == "null":
+        return None
+    if schema == "boolean":
+        return buf.read(1)[0] != 0
+    if schema in ("int", "long"):
+        return _read_long(buf)
+    if schema == "float":
+        return struct.unpack("<f", buf.read(4))[0]
+    if schema == "double":
+        return struct.unpack("<d", buf.read(8))[0]
+    if schema in ("bytes", "string"):
+        n = _read_long(buf)
+        raw = buf.read(n)
+        return raw.decode("utf-8") if schema == "string" else raw
+    raise ValueError(f"unsupported avro type {schema!r}")
+
+
+def _write_value(out: io.BytesIO, schema: Any, v: Any) -> None:
+    if isinstance(schema, list):  # union: pick first matching branch
+        for i, branch in enumerate(schema):
+            if _matches(branch, v):
+                _write_long(out, i)
+                _write_value(out, branch, v)
+                return
+        raise ValueError(f"value {v!r} matches no union branch {schema}")
+    if isinstance(schema, dict):
+        t = schema["type"]
+        if t == "record":
+            for f in schema["fields"]:
+                _write_value(out, f["type"], (v or {}).get(f["name"]))
+            return
+        if t == "array":
+            if v:
+                _write_long(out, len(v))
+                for item in v:
+                    _write_value(out, schema["items"], item)
+            _write_long(out, 0)
+            return
+        if t == "map":
+            if v:
+                _write_long(out, len(v))
+                for k, item in v.items():
+                    _write_value(out, "string", k)
+                    _write_value(out, schema["values"], item)
+            _write_long(out, 0)
+            return
+        if t == "enum":
+            _write_long(out, schema["symbols"].index(v))
+            return
+        if t == "fixed":
+            out.write(v)
+            return
+        _write_value(out, t, v)
+        return
+    if schema == "null":
+        return
+    if schema == "boolean":
+        out.write(b"\x01" if v else b"\x00")
+        return
+    if schema in ("int", "long"):
+        _write_long(out, int(v))
+        return
+    if schema == "float":
+        out.write(struct.pack("<f", float(v)))
+        return
+    if schema == "double":
+        out.write(struct.pack("<d", float(v)))
+        return
+    if schema in ("bytes", "string"):
+        raw = v.encode("utf-8") if isinstance(v, str) else bytes(v)
+        _write_long(out, len(raw))
+        out.write(raw)
+        return
+    raise ValueError(f"unsupported avro type {schema!r}")
+
+
+def _matches(schema: Any, v: Any) -> bool:
+    if schema == "null":
+        return v is None
+    if v is None:
+        return False
+    if schema == "boolean":
+        return isinstance(v, bool)
+    if schema in ("int", "long"):
+        return isinstance(v, int) and not isinstance(v, bool)
+    if schema in ("float", "double"):
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+    if schema == "string":
+        return isinstance(v, str)
+    if schema == "bytes":
+        return isinstance(v, (bytes, bytearray))
+    if isinstance(schema, dict):
+        t = schema["type"]
+        if t == "array":
+            return isinstance(v, list)
+        if t == "map" or t == "record":
+            return isinstance(v, dict)
+        if t == "enum":
+            return isinstance(v, str)
+        if t == "fixed":
+            return isinstance(v, (bytes, bytearray))
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Container files
+# ---------------------------------------------------------------------------
+def read_avro(path: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
+    """Read an Object Container File -> (schema, records)."""
+    with open(path, "rb") as fh:
+        buf = io.BytesIO(fh.read())
+    if buf.read(4) != MAGIC:
+        raise ValueError(f"{path} is not an Avro object container file")
+    meta = _read_value(buf, {"type": "map", "values": "bytes"})
+    schema = json.loads(meta["avro.schema"])
+    codec = meta.get("avro.codec", b"null").decode() or "null"
+    if codec not in ("null", "deflate"):
+        raise ValueError(f"unsupported avro codec {codec!r}")
+    sync = buf.read(SYNC_SIZE)
+    records: List[Dict[str, Any]] = []
+    while True:
+        head = buf.read(1)
+        if not head:
+            break
+        buf.seek(-1, io.SEEK_CUR)
+        count = _read_long(buf)
+        size = _read_long(buf)
+        payload = buf.read(size)
+        if codec == "deflate":
+            payload = zlib.decompress(payload, -15)
+        block = io.BytesIO(payload)
+        for _ in range(count):
+            records.append(_read_value(block, schema))
+        if buf.read(SYNC_SIZE) != sync:
+            raise ValueError("sync marker mismatch (corrupt block)")
+    return schema, records
+
+
+def write_avro(path: str, schema: Dict[str, Any],
+               records: Iterable[Dict[str, Any]], codec: str = "null",
+               block_records: int = 4096) -> None:
+    """Write records as an Object Container File (AvroInOut analog)."""
+    if codec not in ("null", "deflate"):
+        raise ValueError(f"unsupported avro codec {codec!r}")
+    sync = os.urandom(SYNC_SIZE)
+    with open(path, "wb") as fh:
+        head = io.BytesIO()
+        head.write(MAGIC)
+        _write_value(head, {"type": "map", "values": "bytes"},
+                     {"avro.schema": json.dumps(schema).encode(),
+                      "avro.codec": codec.encode()})
+        head.write(sync)
+        fh.write(head.getvalue())
+        batch: List[Dict[str, Any]] = []
+
+        def flush():
+            if not batch:
+                return
+            body = io.BytesIO()
+            for r in batch:
+                _write_value(body, schema, r)
+            payload = body.getvalue()
+            if codec == "deflate":
+                co = zlib.compressobj(9, zlib.DEFLATED, -15)
+                payload = co.compress(payload) + co.flush()
+            blk = io.BytesIO()
+            _write_long(blk, len(batch))
+            _write_long(blk, len(payload))
+            blk.write(payload)
+            blk.write(sync)
+            fh.write(blk.getvalue())
+            batch.clear()
+
+        for r in records:
+            batch.append(r)
+            if len(batch) >= block_records:
+                flush()
+        flush()
+
+
+# ---------------------------------------------------------------------------
+# CSV -> Avro (utils/.../io/CSVToAvro analog)
+# ---------------------------------------------------------------------------
+def infer_schema(df, name: str = "Record") -> Dict[str, Any]:
+    """Nullable-union record schema from a pandas frame's dtypes."""
+    import numpy as np
+
+    fields = []
+    for col in df.columns:
+        kind = getattr(df[col].dtype, "kind", "O")
+        t: Any = {"b": "boolean", "i": "long", "u": "long",
+                  "f": "double"}.get(kind, "string")
+        fields.append({"name": str(col), "type": ["null", t]})
+    return {"type": "record", "name": name, "fields": fields}
+
+
+def csv_to_avro(csv_path: str, avro_path: str,
+                schema: Optional[Dict[str, Any]] = None,
+                codec: str = "null", **read_csv_kwargs) -> Dict[str, Any]:
+    """Convert a CSV file to an Avro container file; returns the schema."""
+    import numpy as np
+    import pandas as pd
+
+    df = pd.read_csv(csv_path, **read_csv_kwargs)
+    schema = schema or infer_schema(df, name=os.path.splitext(
+        os.path.basename(avro_path))[0] or "Record")
+    types = {f["name"]: f["type"] for f in schema["fields"]}
+
+    def clean(col, v):
+        if v is None or (isinstance(v, float) and v != v):
+            return None
+        t = types[col]
+        base = [b for b in t if b != "null"][0] if isinstance(t, list) else t
+        if base == "long":
+            return int(v)
+        if base == "double":
+            return float(v)
+        if base == "boolean":
+            return bool(v)
+        if base == "string":
+            return str(v)
+        return v
+
+    records = ({c: clean(c, v) for c, v in row.items()}
+               for row in df.to_dict("records"))
+    write_avro(avro_path, schema, records, codec=codec)
+    return schema

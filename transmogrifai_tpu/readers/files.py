@@ -69,7 +69,9 @@ ParquetProductReader = ParquetReader
 
 
 class AvroReader(DataReader):
-    """Avro records (AvroReaders.scala:55) — requires an avro codec library."""
+    """Avro records (AvroReaders.scala:55) via the vendored pure-Python
+    Object Container File codec (readers/avro_io.py) — fastavro is used only
+    if present."""
 
     def __init__(self, path: str, key: Union[str, Callable, None] = None):
         super().__init__(key=key)
@@ -78,14 +80,15 @@ class AvroReader(DataReader):
     def read(self, params: Optional[Dict[str, Any]] = None):
         path = (params or {}).get("path", self.path)
         try:
-            import fastavro  # noqa: F401
-        except ImportError as e:
-            raise ImportError(
-                "AvroReader requires the 'fastavro' package, which is not "
-                "installed in this environment. Convert the data to CSV/Parquet "
-                "or install fastavro.") from e
-        with open(path, "rb") as fh:
-            return list(fastavro.reader(fh))
+            import fastavro
+
+            with open(path, "rb") as fh:
+                return list(fastavro.reader(fh))
+        except ImportError:
+            from .avro_io import read_avro
+
+            _, records = read_avro(path)
+            return records
 
 
 def _with_aggregate(reader_cls):

@@ -71,6 +71,98 @@ class JoinedReader(Reader):
             return set(data[0])
         return None
 
+    def with_secondary_aggregation(self, time_filter: "TimeBasedFilter"
+                                   ) -> "JoinedAggregateReader":
+        """Post-join time-based aggregation of the secondary (right) side
+        (JoinedDataReader.withSecondaryAggregation, JoinedDataReader.scala:251):
+        right-side EVENTS are monoid-aggregated per key within the filter's
+        time window; left-side rows keep one copy per key (the reference's
+        dummy aggregators)."""
+        return JoinedAggregateReader(self.left, self.right, how=self.how,
+                                     on=self.on, time_filter=time_filter)
+
+
+class TimeBasedFilter:
+    """Time window for post-join aggregation (reference TimeBasedFilter):
+    keep right-side events with ``cutoff - window <= t < cutoff`` for
+    predictors; responses aggregate from the cutoff forward."""
+
+    def __init__(self, time_fn: Callable[[Dict[str, Any]], int],
+                 cutoff_time_ms: int, window_ms: Optional[int] = None):
+        self.time_fn = time_fn
+        self.cutoff_time_ms = int(cutoff_time_ms)
+        self.window_ms = None if window_ms is None else int(window_ms)
+
+
+class JoinedAggregateReader(JoinedReader):
+    """JoinedAggregateDataReader analog (JoinedDataReader.scala:251,384):
+    one-to-many joins resolve by aggregating the many side per key."""
+
+    def __init__(self, left: Reader, right: Reader, how: str = "inner",
+                 on: str = KEY_FIELD, time_filter: Optional[TimeBasedFilter] = None):
+        super().__init__(left, right, how=how, on=on)
+        if time_filter is None:
+            raise ValueError("JoinedAggregateReader needs a TimeBasedFilter")
+        self.time_filter = time_filter
+
+    def generate_dataset(self, raw_features: Sequence[Feature],
+                         params: Optional[Dict[str, Any]] = None) -> Dataset:
+        from .base import _records_from
+        from ..columns import column_from_scalars
+        from ..features.generator import Event, FeatureGeneratorStage
+
+        left_feats, right_feats = [], []
+        left_cols = self._side_columns(self.left)
+        for f in raw_features:
+            field = getattr(f.origin_stage.extract_fn, "field_name", None)
+            if left_cols is not None and field is not None:
+                (left_feats if field in left_cols else right_feats).append(f)
+            else:
+                left_feats.append(f)
+        lds = self.left.generate_dataset(left_feats, params)
+
+        tf = self.time_filter
+        records = _records_from(self.right.read(params))
+        by_key: Dict[str, List[Dict[str, Any]]] = {}
+        for i, r in enumerate(records):
+            by_key.setdefault(self.right._key_of(r, i), []).append(r)
+        keys = sorted(by_key)
+        cols: Dict[str, Any] = {}
+        for f in right_feats:
+            stage: FeatureGeneratorStage = f.origin_stage  # type: ignore[assignment]
+            vals = []
+            for k in keys:
+                events = []
+                for r in by_key[k]:
+                    t = int(tf.time_fn(r))
+                    if tf.window_ms is not None and not f.is_response \
+                            and t < tf.cutoff_time_ms - tf.window_ms:
+                        continue  # outside the aggregation window
+                    events.append(Event(stage.extract(r), t))
+                events.sort(key=lambda e: e.time)
+                vals.append(stage.aggregate(events, cutoff_ms=tf.cutoff_time_ms,
+                                            responses_after_cutoff=f.is_response))
+            cols[f.name] = column_from_scalars(f.ftype, vals)
+        rds = Dataset(cols, np.array([str(k) for k in keys], dtype=object))
+
+        # join the aggregated right side 1:1 (same semantics as the base)
+        lkey = {k: i for i, k in enumerate(lds.key)}
+        rkey = {k: i for i, k in enumerate(rds.key)}
+        if self.how == "inner":
+            out_keys = [k for k in lds.key if k in rkey]
+        elif self.how == "left":
+            out_keys = list(lds.key)
+        else:
+            out_keys = list(lds.key) + [k for k in rds.key if k not in lkey]
+        li = np.array([lkey.get(k, -1) for k in out_keys])
+        ri = np.array([rkey.get(k, -1) for k in out_keys])
+        out_cols: Dict[str, Any] = {}
+        for name, col in lds.columns.items():
+            out_cols[name] = _take_with_missing(col, li)
+        for name, col in rds.columns.items():
+            out_cols[name] = _take_with_missing(col, ri)
+        return Dataset(out_cols, np.array([str(k) for k in out_keys], dtype=object))
+
 
 def _take_with_missing(col, idx: np.ndarray):
     """take() where idx == -1 produces a missing value."""
