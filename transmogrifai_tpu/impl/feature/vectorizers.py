@@ -87,6 +87,30 @@ class RealVectorizerModel(Model):
         self.metadata["vector_metadata"] = vm
         return VectorColumn(T.OPVector, out, vm)
 
+    # ---- fused-layer protocol (workflow/dag._apply_layer_transforms): the
+    # same fill/null-track math as transform_columns, traceable ------------
+    def jax_transform(self, *args):
+        import jax.numpy as jnp
+
+        blocks = []
+        for i, fill in enumerate(np.asarray(self.fills, np.float32)):
+            v, m = args[2 * i], args[2 * i + 1]
+            blocks.append(jnp.where(m, v, fill).astype(jnp.float32)[:, None])
+            if self.track_nulls:
+                blocks.append((~m).astype(jnp.float32)[:, None])
+        return jnp.concatenate(blocks, axis=1)
+
+    def jax_out_metadata(self, cols):
+        meta = []
+        for f in self.inputs:
+            meta.append(VectorColumnMetadata((f.name,), (f.ftype.__name__,)))
+            if self.track_nulls:
+                meta.append(VectorColumnMetadata((f.name,), (f.ftype.__name__,),
+                                                 indicator_value=NULL_INDICATOR))
+        vm = _vector_meta(self, meta)
+        self.metadata["vector_metadata"] = vm
+        return vm
+
 
 class IntegralVectorizer(RealVectorizer):
     """Integral features -> OPVector with mode/constant fill + null tracking."""
@@ -184,6 +208,26 @@ class OneHotVectorizer(SequenceEstimator):
         assert isinstance(col, NumericColumn)
         return [str(col.values[i])] if col.mask[i] else []
 
+    @staticmethod
+    def _scalar_codes(col: Column, f=None):
+        """Vectorized (labels, codes, present) for SCALAR categorical columns
+        — no per-row Python at 10M rows.  Returns None for collection-typed
+        columns (sets/lists pivot through the per-row path)."""
+        import pandas as pd
+
+        if isinstance(col, NumericColumn):
+            uniq, inv = np.unique(col.values, return_inverse=True)
+            return [str(u) for u in uniq], inv, col.mask.copy()
+        assert isinstance(col, ObjectColumn)
+        vals = col.values
+        present = ~pd.isnull(vals)
+        if any(isinstance(v, (set, frozenset, list, tuple))
+               for v in vals[present][:64]):
+            return None
+        filled = np.where(present, vals, "")
+        uniq, inv = np.unique(filled.astype(str), return_inverse=True)
+        return list(uniq), inv, present
+
     def fit_columns(self, cols: Sequence[Column], dataset: Dataset) -> "OneHotVectorizerModel":
         top_k = int(self.get_param("top_k"))
         min_support = int(self.get_param("min_support"))
@@ -191,9 +235,15 @@ class OneHotVectorizer(SequenceEstimator):
         categories: List[List[str]] = []
         for col in cols:
             n = len(col)
-            counts: Counter = Counter()
-            for i in range(n):
-                counts.update(self._values_of(col, i))
+            coded = self._scalar_codes(col)
+            if coded is not None:
+                labels, inv, present = coded
+                cnt = np.bincount(inv[present], minlength=len(labels))
+                counts = Counter({lab: int(c) for lab, c in zip(labels, cnt) if c})
+            else:
+                counts = Counter()
+                for i in range(n):
+                    counts.update(self._values_of(col, i))
             if n > 0 and len(counts) > max_pct * n:
                 categories.append([])
                 continue
@@ -222,19 +272,34 @@ class OneHotVectorizerModel(Model):
         for f, col, cats in zip(self.inputs, cols, self.categories):
             index = {c: j for j, c in enumerate(cats)}
             k = len(cats)
-            block = np.zeros((n, k + (2 if self.track_nulls else 1)), dtype=np.float32)
-            for i in range(n):
-                vals = OneHotVectorizer._values_of(col, i)
-                if not vals:
-                    if self.track_nulls:
-                        block[i, k + 1] = 1.0
-                    continue
-                for v in vals:
-                    j = index.get(v)
-                    if j is None:
-                        block[i, k] = 1.0  # OTHER
-                    else:
-                        block[i, j] = 1.0
+            width = k + (2 if self.track_nulls else 1)
+            coded = OneHotVectorizer._scalar_codes(col)
+            if coded is not None:  # vectorized scalar path (no per-row Python)
+                labels, inv, present = coded
+                # unique label -> output column (k = OTHER; k+1 = null)
+                lab_target = np.array([index.get(lab, k) for lab in labels],
+                                      dtype=np.int64)
+                target = np.where(present, lab_target[inv],
+                                  k + 1 if self.track_nulls else -1)
+                block = np.zeros((n, width + 1), dtype=np.float32)
+                rows = np.arange(n)
+                ok = target >= 0
+                block[rows[ok], target[ok]] = 1.0
+                block = block[:, :width]
+            else:
+                block = np.zeros((n, width), dtype=np.float32)
+                for i in range(n):
+                    vals = OneHotVectorizer._values_of(col, i)
+                    if not vals:
+                        if self.track_nulls:
+                            block[i, k + 1] = 1.0
+                        continue
+                    for v in vals:
+                        j = index.get(v)
+                        if j is None:
+                            block[i, k] = 1.0  # OTHER
+                        else:
+                            block[i, j] = 1.0
             blocks.append(block)
             ind = list(cats) + [self.unseen_name] + ([NULL_INDICATOR] if self.track_nulls else [])
             for v in ind:
@@ -275,6 +340,25 @@ class VectorsCombiner(SequenceTransformer):
         self.metadata["vector_metadata"] = vm
         return VectorColumn(T.OPVector, out, vm)
 
+    # ---- fused-layer protocol ---------------------------------------------
+    def jax_transform(self, *args):
+        import jax.numpy as jnp
+
+        return jnp.concatenate([a.astype(jnp.float32) for a in args], axis=1)
+
+    def jax_out_metadata(self, cols):
+        metas = []
+        for f, col in zip(self.inputs, cols):
+            if col.metadata is not None:
+                metas.append(col.metadata)
+            else:
+                metas.append(VectorMetadata(f.name, tuple(
+                    VectorColumnMetadata((f.name,), (f.ftype.__name__,), index=i)
+                    for i in range(col.width))))
+        vm = VectorMetadata.flatten(self.get_outputs()[0].name, metas)
+        self.metadata["vector_metadata"] = vm
+        return vm
+
 
 class StandardScalerVectorizer(UnaryEstimator):
     """Standardize an OPVector column (z-score); the OpScalarStandardScaler /
@@ -309,8 +393,18 @@ class StandardScalerModel(Model):
         col = cols[0]
         assert isinstance(col, VectorColumn)
         out = (col.values - self.mean) / self.std
-        vm = col.metadata
+        return VectorColumn(T.OPVector, out.astype(np.float32),
+                            self.jax_out_metadata(cols))
+
+    # ---- fused-layer protocol ---------------------------------------------
+    def jax_transform(self, *args):
+        import jax.numpy as jnp
+
+        return ((args[0] - self.mean) / self.std).astype(jnp.float32)
+
+    def jax_out_metadata(self, cols):
+        vm = cols[0].metadata
         if vm is not None:
             vm = VectorMetadata(self.get_outputs()[0].name, vm.columns)
             self.metadata["vector_metadata"] = vm
-        return VectorColumn(T.OPVector, out.astype(np.float32), vm)
+        return vm

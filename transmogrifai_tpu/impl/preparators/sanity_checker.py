@@ -467,6 +467,15 @@ class SanityCheckerModel(Model):
         return VectorColumn(T.OPVector, vec.values[:, self.indices_to_keep],
                             self.out_metadata)
 
+    # ---- fused-layer protocol (workflow/dag._apply_layer_transforms) -------
+    def jax_transform(self, *args):
+        import jax.numpy as jnp
+
+        return jnp.take(args[-1], jnp.asarray(self.indices_to_keep), axis=1)
+
+    def jax_out_metadata(self, cols) -> Optional[VectorMetadata]:
+        return self.out_metadata
+
 
 # ---------------------------------------------------------------------------
 # MinVarianceFilter — label-free variant (MinVarianceFilter.scala:58)

@@ -16,7 +16,9 @@ Reference parity: core/.../utils/stages/FitStagesUtil.scala:51 —
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
 
 from ..columns import Dataset
 from ..features.feature import Feature
@@ -61,11 +63,83 @@ class FittedDAG:
     fitted_stages: List[PipelineStage]
 
 
-def _apply_layer_transforms(ds: Dataset, transformers: Sequence[Transformer]) -> Dataset:
-    """Fused layer transform: all outputs computed off the same input batch,
-    then appended at once (applyOpTransformations analog)."""
+#: jitted fused-layer programs keyed by the participating model objects
+_FUSED_JIT: Dict[Tuple[int, ...], Tuple[object, list]] = {}
+
+
+def _fusable(t, ds: Dataset) -> bool:
+    from ..columns import NumericColumn, VectorColumn
+
+    return (hasattr(t, "jax_transform") and t.n_outputs == 1
+            and all(f.name in ds
+                    and isinstance(ds[f.name], (NumericColumn, VectorColumn))
+                    for f in t.inputs))
+
+
+def _fused_layer(ds: Dataset, fusables: Sequence[Transformer]) -> Dict[str, Any]:
+    """Compile a whole layer's transforms into ONE jitted XLA computation
+    (SURVEY §7: the applyOpTransformations fused-pass analog, one launch per
+    layer instead of one per stage).  Metadata is built host-side per stage."""
+    import jax
+    import jax.numpy as jnp
+
+    from .. import types as T
+    from ..columns import NumericColumn, VectorColumn
+
+    flat = []
+    sizes = []
+    for t in fusables:
+        k = 0
+        for f in t.inputs:
+            col = ds[f.name]
+            if isinstance(col, NumericColumn):
+                flat += [jnp.asarray(col.values, jnp.float32),
+                         jnp.asarray(col.mask)]
+                k += 2
+            else:
+                flat.append(jnp.asarray(col.values, jnp.float32))
+                k += 1
+        sizes.append(k)
+    key = tuple(id(t) for t in fusables)
+    cached = _FUSED_JIT.get(key)
+    if cached is None:
+        ts = list(fusables)
+        szs = tuple(sizes)
+
+        def fused(args):
+            outs = []
+            i = 0
+            for t, k in zip(ts, szs):
+                outs.append(t.jax_transform(*args[i:i + k]))
+                i += k
+            return outs
+
+        cached = (jax.jit(fused), ts)  # ts ref pins ids against gc reuse
+        _FUSED_JIT[key] = cached
+    outs = cached[0](flat)
     new_cols = {}
-    for t in transformers:
+    for t, out in zip(fusables, outs):
+        vm = t.jax_out_metadata([ds[f.name] for f in t.inputs])
+        new_cols[t.get_outputs()[0].name] = VectorColumn(
+            T.OPVector, np.asarray(out), vm)
+    return new_cols
+
+
+def _apply_layer_transforms(ds: Dataset, transformers: Sequence[Transformer]) -> Dataset:
+    """Fused layer transform (applyOpTransformations analog,
+    FitStagesUtil.scala:96): transformers implementing the ``jax_transform``
+    protocol compile into ONE jitted computation per layer; the rest apply
+    per stage off the same input batch."""
+    new_cols = {}
+    fusables = [t for t in transformers if _fusable(t, ds)]
+    rest = [t for t in transformers if t not in fusables]
+    if len(fusables) == 1:  # no fusion win; avoid a second jit cache entry
+        rest = list(transformers)
+        fusables = []
+    if fusables:
+        with _maybe_time(_FusedLabel(fusables), "transform", len(ds)):
+            new_cols.update(_fused_layer(ds, fusables))
+    for t in rest:
         out_feats = t.get_outputs()
         with _maybe_time(t, "transform", len(ds)):
             col = t.transform_dataset(ds)
@@ -75,6 +149,15 @@ def _apply_layer_transforms(ds: Dataset, transformers: Sequence[Transformer]) ->
             for f, c in zip(out_feats, col):
                 new_cols[f.name] = c
     return ds.with_columns(new_cols)
+
+
+class _FusedLabel:
+    """Listener label for a fused layer launch."""
+
+    def __init__(self, ts):
+        self.operation_name = "fused[" + "+".join(
+            getattr(t, "operation_name", "?") for t in ts) + "]"
+        self.uid = "fused:" + ",".join(getattr(t, "uid", "?") for t in ts)
 
 
 def _maybe_time(stage, phase: str, n_rows: int):
