@@ -31,12 +31,12 @@ of arithmetic throughput, not MXU duty cycle — on a tabular 891-row problem
 the sweep is latency/bandwidth-bound by nature, which is exactly why
 batching all 84 fits into a handful of launches wins.
 
-Baseline constant: the reference publishes no wall-clock numbers
-(BASELINE.md) and Spark is not installed in this image, so ``vs_baseline``
-divides by a DELIBERATELY GENEROUS estimate of Spark-local throughput: 8
-concurrent JVM threads (ValidatorParamDefaults.Parallelism=8) each
-completing a Titanic-scale MLlib fit every 2 s including job-scheduling
-overhead => 4 models/s.  Treat the ratio as an order-of-magnitude indicator.
+Baseline: MEASURED, not invented (round-3 VERDICT #4).  ``baseline_proxy.py``
+times the identical 28-grid x 3-fold sweep shape with scikit-learn on this
+host's CPU and extrapolates perfect 8-thread scaling (the reference's JVM
+pool width) — see BASELINE_MEASURED.json; ``vs_baseline`` divides by that
+number.  Falls back to the old 4 models/s estimate only if the measured file
+is absent.
 
 Tunnel caveat: the axon device tunnel memoizes identical (executable, args)
 executions, so every rep uses a DIFFERENT fold seed — new fold weights →
@@ -52,8 +52,19 @@ import time
 
 import numpy as np
 
-BASELINE_MODELS_PER_SEC = 4.0  # generous Spark-local 8-thread estimate (above)
 TITANIC = "/root/reference/test-data/PassengerDataAllWithHeader.csv"
+
+
+def baseline_models_per_sec():
+    """Measured sklearn-proxy baseline (baseline_proxy.py), with provenance."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE_MEASURED.json")
+    try:
+        with open(path) as f:
+            m = json.load(f)
+        return float(m["models_per_sec_8thread_linear"]), "measured-sklearn-8t"
+    except Exception:
+        return 4.0, "estimate"  # pre-round-4 fallback constant
 
 #: peak dense arithmetic throughput per chip, FLOP/s (bf16 MXU peak; our
 #: kernels run f32, so utilization vs this figure is conservative)
@@ -174,11 +185,14 @@ def main():
     flops.disable()
 
     models_per_sec = n_models / dt
+    base, base_src = baseline_models_per_sec()
     out = {
         "metric": "selector_sweep_models_per_sec",
         "value": round(models_per_sec, 2),
         "unit": "models/s",
-        "vs_baseline": round(models_per_sec / BASELINE_MODELS_PER_SEC, 2),
+        "vs_baseline": round(models_per_sec / base, 2),
+        "baseline_models_per_sec": base,
+        "baseline_source": base_src,
         "platform": platform,
         "device_kind": device_kind,
         "sweep": f"{n_grids} grids x {sel.validator.num_folds} folds "

@@ -46,11 +46,13 @@ def test_matmul_histograms_match_segment_sum(monkeypatch):
     monkeypatch.setenv("TMOG_HIST_MATMUL", "0")
     t0 = _grow(Xb, y, wt, fm)
     # grow directly with the shared one-hot (exactly what the TPU path does)
-    Obin = Tr.bin_onehot(jnp.asarray(Xb), 16)
-    t1 = Tr.grow_tree(jnp.asarray(Xb), jnp.asarray(-y[:, None]),
+    g = jnp.asarray(-y[:, None])
+    Og = Tr.grad_onehot(jnp.asarray(Xb),
+                        jnp.concatenate([g, jnp.ones((n, 1))], axis=1), 16)
+    t1 = Tr.grow_tree(jnp.asarray(Xb), g,
                       jnp.ones(n), jnp.asarray(wt), jnp.asarray(fm),
                       max_depth=5, n_bins=16, frontier=16,
-                      min_child_weight=5.0, Obin=Obin)
+                      min_child_weight=5.0, Og=Og)
     assert np.array_equal(np.asarray(t0.split_feat), np.asarray(t1.split_feat))
     assert np.array_equal(np.asarray(t0.split_bin), np.asarray(t1.split_bin))
     np.testing.assert_allclose(np.asarray(t0.leaf_val),

@@ -53,11 +53,19 @@ class OpScalarStandardScalerModel(Model):
         self.mean = float(mean)
         self.std = float(std)
 
+    jax_output = "numeric"  # fused-layer protocol
+
     def transform_columns(self, cols: Sequence[Column]) -> NumericColumn:
         col = cols[0]
         assert isinstance(col, NumericColumn)
         vals = (np.where(col.mask, col.values, self.mean) - self.mean) / self.std
         return NumericColumn(T.RealNN, vals, np.ones_like(col.mask))
+
+    def jax_transform(self, v, m):
+        import jax.numpy as jnp
+
+        vals = (jnp.where(m, v, self.mean) - self.mean) / self.std
+        return vals, jnp.ones_like(m)
 
 
 class ScalingType(str, enum.Enum):
@@ -79,18 +87,29 @@ class ScalerTransformer(UnaryTransformer):
         self.metadata["scaler"] = {"type": self.get_param("scaling_type"),
                                    "slope": float(slope), "intercept": float(intercept)}
 
+    jax_output = "numeric"  # fused-layer protocol
+
+    def _compute(self, xp, v, m):
+        st = ScalingType(self.get_param("scaling_type"))
+        if st is ScalingType.Linear:
+            vals = self.get_param("slope") * v + self.get_param("intercept")
+            mask = m
+        else:
+            vals = xp.log(v)
+            mask = m & xp.isfinite(vals)
+        return xp.where(mask, vals, 0.0), mask
+
     def transform_columns(self, cols: Sequence[Column]) -> NumericColumn:
         col = cols[0]
         assert isinstance(col, NumericColumn)
-        st = ScalingType(self.get_param("scaling_type"))
-        if st is ScalingType.Linear:
-            vals = self.get_param("slope") * col.values + self.get_param("intercept")
-            mask = col.mask
-        else:
-            with np.errstate(divide="ignore", invalid="ignore"):
-                vals = np.log(col.values)
-            mask = col.mask & np.isfinite(vals)
-        return NumericColumn(T.Real, np.where(mask, vals, 0.0), mask)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            vals, mask = self._compute(np, col.values, col.mask)
+        return NumericColumn(T.Real, vals, mask)
+
+    def jax_transform(self, v, m):
+        import jax.numpy as jnp
+
+        return self._compute(jnp, v, m)
 
 
 class DescalerTransformer(BinaryTransformer):
@@ -108,17 +127,26 @@ class DescalerTransformer(BinaryTransformer):
             raise ValueError("Descaler input 2 must descend from a ScalerTransformer")
         return info
 
+    jax_output = "numeric"  # fused-layer protocol
+
+    def _compute(self, xp, v, m):
+        info = self._scaler_args()
+        if info["type"] == ScalingType.Linear.value:
+            vals = (v - info["intercept"]) / info["slope"]
+        else:
+            vals = xp.exp(v)
+        return xp.where(m, vals, 0.0), m
+
     def transform_columns(self, cols: Sequence[Column]) -> NumericColumn:
         col = cols[0]
         assert isinstance(col, NumericColumn)
-        info = self._scaler_args()
-        if info["type"] == ScalingType.Linear.value:
-            vals = (col.values - info["intercept"]) / info["slope"]
-            mask = col.mask
-        else:
-            vals = np.exp(col.values)
-            mask = col.mask
-        return NumericColumn(T.Real, np.where(mask, vals, 0.0), mask)
+        vals, mask = self._compute(np, col.values, col.mask)
+        return NumericColumn(T.Real, vals, mask)
+
+    def jax_transform(self, v, m, v2, m2):
+        import jax.numpy as jnp
+
+        return self._compute(jnp, v, m)
 
 
 class PercentileCalibrator(UnaryEstimator):
@@ -146,6 +174,8 @@ class PercentileCalibratorModel(Model):
         super().__init__(operation_name, output_type, uid=uid, **kw)
         self.splits = np.asarray(splits, dtype=np.float64)
 
+    jax_output = "numeric"  # fused-layer protocol
+
     def transform_columns(self, cols: Sequence[Column]) -> NumericColumn:
         col = cols[0]
         assert isinstance(col, NumericColumn)
@@ -153,6 +183,14 @@ class PercentileCalibratorModel(Model):
         idx = np.clip(np.searchsorted(self.splits[1:-1], col.values, side="right"),
                       0, b - 1).astype(np.float64)
         return NumericColumn(T.RealNN, idx, np.ones_like(col.mask))
+
+    def jax_transform(self, v, m):
+        import jax.numpy as jnp
+
+        b = len(self.splits) - 1
+        idx = jnp.clip(jnp.searchsorted(jnp.asarray(self.splits[1:-1]), v,
+                                        side="right"), 0, b - 1)
+        return idx.astype(jnp.float32), jnp.ones_like(m)
 
 
 def pav_fit(x: np.ndarray, y: np.ndarray) -> tuple:

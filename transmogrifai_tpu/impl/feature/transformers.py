@@ -37,6 +37,7 @@ class _NumericBinaryOp(BinaryTransformer):
     treated as absent, not zero-poisoning), both required for * and /."""
 
     op: str = "?"
+    jax_output = "numeric"  # fused-layer protocol: returns (values, mask)
 
     def __init__(self, uid: Optional[str] = None):
         super().__init__(operation_name=self.op, output_type=T.Real, uid=uid)
@@ -44,22 +45,30 @@ class _NumericBinaryOp(BinaryTransformer):
     def _apply(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
         raise NotImplementedError
 
+    def _compute(self, xp, av, am, bv, bm):
+        """Backend-generic body shared by the numpy and jitted paths."""
+        vals = self._apply(av, bv)
+        if self.op in ("plus", "minus"):
+            only_a = am & ~bm
+            only_b = bm & ~am
+            vals = xp.where(only_a, av, vals)
+            vals = xp.where(only_b, bv if self.op == "plus" else -bv, vals)
+            mask = am | bm
+        else:
+            mask = am & bm & xp.isfinite(vals)
+        return xp.where(mask, vals, 0.0), mask
+
     def transform_columns(self, cols: Sequence[Column]) -> NumericColumn:
         a, b = cols
         assert isinstance(a, NumericColumn) and isinstance(b, NumericColumn)
-        both = a.mask & b.mask
         with np.errstate(divide="ignore", invalid="ignore"):
-            vals = self._apply(a.values, b.values)
-        if self.op in ("plus", "minus"):
-            only_a = a.mask & ~b.mask
-            only_b = b.mask & ~a.mask
-            vals = np.where(only_a, a.values, vals)
-            vals = np.where(only_b, b.values if self.op == "plus" else -b.values, vals)
-            mask = a.mask | b.mask
-        else:
-            mask = both & np.isfinite(vals)
-        vals = np.where(mask, vals, 0.0)
+            vals, mask = self._compute(np, a.values, a.mask, b.values, b.mask)
         return NumericColumn(T.Real, vals, mask)
+
+    def jax_transform(self, av, am, bv, bm):
+        import jax.numpy as jnp
+
+        return self._compute(jnp, av, am, bv, bm)
 
 
 class AddTransformer(_NumericBinaryOp):
@@ -93,28 +102,38 @@ class DivideTransformer(_NumericBinaryOp):
 class ScalarMathTransformer(UnaryTransformer):
     """feature <op> scalar (MathTransformers' scalar variants)."""
 
+    jax_output = "numeric"  # fused-layer protocol: returns (values, mask)
+
     def __init__(self, op: str, scalar: float, uid: Optional[str] = None):
         assert op in ("plus", "minus", "multiply", "divide", "power", "abs",
                       "log", "exp", "sqrt", "rminus", "rdivide")
         super().__init__(operation_name=f"{op}Scalar", input_type=T.Real,
                          output_type=T.Real, uid=uid, op=op, scalar=float(scalar))
 
+    def _compute(self, xp, v, m):
+        op, s = self.get_param("op"), float(self.get_param("scalar"))
+        vals = {
+            "plus": lambda: v + s, "minus": lambda: v - s,
+            "multiply": lambda: v * s, "divide": lambda: v / s,
+            "power": lambda: v ** s, "abs": lambda: xp.abs(v),
+            "log": lambda: xp.log(v), "exp": lambda: xp.exp(v),
+            "sqrt": lambda: xp.sqrt(v),
+            "rminus": lambda: s - v, "rdivide": lambda: s / v,
+        }[op]()
+        mask = m & xp.isfinite(vals)
+        return xp.where(mask, vals, 0.0), mask
+
     def transform_columns(self, cols: Sequence[Column]) -> NumericColumn:
         col = cols[0]
         assert isinstance(col, NumericColumn)
-        op, s = self.get_param("op"), float(self.get_param("scalar"))
-        v = col.values
         with np.errstate(divide="ignore", invalid="ignore"):
-            vals = {
-                "plus": lambda: v + s, "minus": lambda: v - s,
-                "multiply": lambda: v * s, "divide": lambda: v / s,
-                "power": lambda: v ** s, "abs": lambda: np.abs(v),
-                "log": lambda: np.log(v), "exp": lambda: np.exp(v),
-                "sqrt": lambda: np.sqrt(v),
-                "rminus": lambda: s - v, "rdivide": lambda: s / v,
-            }[op]()
-        mask = col.mask & np.isfinite(vals)
-        return NumericColumn(T.Real, np.where(mask, vals, 0.0), mask)
+            vals, mask = self._compute(np, col.values, col.mask)
+        return NumericColumn(T.Real, vals, mask)
+
+    def jax_transform(self, v, m):
+        import jax.numpy as jnp
+
+        return self._compute(jnp, v, m)
 
 
 # ---------------------------------------------------------------------------
@@ -252,6 +271,8 @@ class FillMissingWithMean(UnaryEstimator):
 
 
 class FillMissingWithMeanModel(Model):
+    jax_output = "numeric"  # fused-layer protocol
+
     def __init__(self, mean: float, operation_name: str = "fillWithMean",
                  output_type=T.RealNN, uid: Optional[str] = None, **kw):
         super().__init__(operation_name, output_type, uid=uid, **kw)
@@ -262,6 +283,11 @@ class FillMissingWithMeanModel(Model):
         assert isinstance(col, NumericColumn)
         vals = np.where(col.mask, col.values, self.mean)
         return NumericColumn(T.RealNN, vals, np.ones_like(col.mask))
+
+    def jax_transform(self, v, m):
+        import jax.numpy as jnp
+
+        return jnp.where(m, v, self.mean), jnp.ones_like(m)
 
 
 class DropIndicesByTransformer(UnaryTransformer):
@@ -285,6 +311,35 @@ class DropIndicesByTransformer(UnaryTransformer):
         vm = type(vm)(self.get_outputs()[0].name, vm.columns)
         self.metadata["vector_metadata"] = vm
         return VectorColumn(T.OPVector, out, vm)
+
+    # fused-layer protocol: the keep-set depends only on metadata, so the
+    # slice happens host-side in jax_host_prep (NOT as a trace-time constant
+    # — the fused jit is cached per stage identity, and a baked-in keep list
+    # would go stale if the same stage later saw different metadata)
+    def _keep(self, col):
+        if col.metadata is None:
+            return None
+        return [i for i, c in enumerate(col.metadata.columns)
+                if not self.predicate.fn(c)]
+
+    def jax_host_prep(self, cols):
+        col = cols[0]
+        keep = self._keep(col)
+        v = np.asarray(col.values, np.float32)
+        return [v if keep is None else v[:, keep]]
+
+    def jax_transform(self, v):
+        return v
+
+    def jax_out_metadata(self, cols):
+        col = cols[0]
+        keep = self._keep(col)
+        if col.metadata is None:
+            return None
+        vm = col.metadata.select(keep)
+        vm = type(vm)(self.get_outputs()[0].name, vm.columns)
+        self.metadata["vector_metadata"] = vm
+        return vm
 
 
 class PredictionDeIndexer(UnaryTransformer):

@@ -29,6 +29,12 @@ from ...features.metadata import (NULL_INDICATOR, OTHER_INDICATOR, VectorColumnM
 from ...stages.base import Model, SequenceEstimator, SequenceTransformer, UnaryEstimator
 
 
+#: pandas infer_dtype kinds treated as SCALAR categoricals — shared by the
+#: vectorized pivot path and the fused-layer gate so they can never diverge
+SCALAR_DTYPE_KINDS = ("string", "unicode", "integer", "floating", "boolean",
+                      "decimal", "empty", "categorical", "mixed-integer-float")
+
+
 def _vector_meta(stage, cols_meta: List[VectorColumnMetadata]) -> VectorMetadata:
     name = stage.get_outputs()[0].name
     cols = [VectorColumnMetadata(c.parent_feature_name, c.parent_feature_type, c.grouping,
@@ -162,6 +168,31 @@ class BinaryVectorizer(SequenceTransformer):
         self.metadata["vector_metadata"] = vm
         return VectorColumn(T.OPVector, out, vm)
 
+    # ---- fused-layer protocol ---------------------------------------------
+    def jax_transform(self, *args):
+        import jax.numpy as jnp
+
+        fill = float(self.get_param("fill_value", False))
+        track = self.get_param("track_nulls", True)
+        blocks = []
+        for i in range(len(args) // 2):
+            v, m = args[2 * i], args[2 * i + 1]
+            blocks.append(jnp.where(m, v, fill).astype(jnp.float32)[:, None])
+            if track:
+                blocks.append((~m).astype(jnp.float32)[:, None])
+        return jnp.concatenate(blocks, axis=1)
+
+    def jax_out_metadata(self, cols):
+        meta = []
+        for f in self.inputs:
+            meta.append(VectorColumnMetadata((f.name,), (f.ftype.__name__,)))
+            if self.get_param("track_nulls", True):
+                meta.append(VectorColumnMetadata((f.name,), (f.ftype.__name__,),
+                                                 indicator_value=NULL_INDICATOR))
+        vm = _vector_meta(self, meta)
+        self.metadata["vector_metadata"] = vm
+        return vm
+
 
 class RealNNVectorizer(SequenceTransformer):
     """Non-nullable reals -> OPVector (no fill, no null tracking)."""
@@ -175,6 +206,20 @@ class RealNNVectorizer(SequenceTransformer):
         vm = _vector_meta(self, meta)
         self.metadata["vector_metadata"] = vm
         return VectorColumn(T.OPVector, np.concatenate(blocks, axis=1), vm)
+
+    # ---- fused-layer protocol ---------------------------------------------
+    def jax_transform(self, *args):
+        import jax.numpy as jnp
+
+        vals = [args[2 * i] for i in range(len(args) // 2)]
+        return jnp.stack(vals, axis=1).astype(jnp.float32)
+
+    def jax_out_metadata(self, cols):
+        meta = [VectorColumnMetadata((f.name,), (f.ftype.__name__,))
+                for f in self.inputs]
+        vm = _vector_meta(self, meta)
+        self.metadata["vector_metadata"] = vm
+        return vm
 
 
 # ---------------------------------------------------------------------------
@@ -221,8 +266,12 @@ class OneHotVectorizer(SequenceEstimator):
         assert isinstance(col, ObjectColumn)
         vals = col.values
         present = ~pd.isnull(vals)
-        if any(isinstance(v, (set, frozenset, list, tuple))
-               for v in vals[present][:64]):
+        # collection detection must cover the WHOLE column (a mixed column
+        # whose first rows are scalars would otherwise stringify later sets
+        # into bogus categories like "{'a'}"); pandas' C-level dtype
+        # inference keeps this O(n) scan off the Python interpreter
+        kind = pd.api.types.infer_dtype(vals[present], skipna=False)
+        if kind not in SCALAR_DTYPE_KINDS:
             return None
         filled = np.where(present, vals, "")
         uniq, inv = np.unique(filled.astype(str), return_inverse=True)
@@ -309,6 +358,60 @@ class OneHotVectorizerModel(Model):
         vm = _vector_meta(self, meta)
         self.metadata["vector_metadata"] = vm
         return VectorColumn(T.OPVector, out, vm)
+
+    # ---- fused-layer protocol: the string -> code lookup stays host-side
+    # (jax_host_prep), the one-hot expansion + null/OTHER columns run in the
+    # fused XLA launch — at 10M rows the expansion is the expensive part ----
+    def jax_host_ready(self, cols) -> bool:
+        import pandas as pd
+
+        for col in cols:
+            if isinstance(col, NumericColumn):
+                continue
+            if not isinstance(col, ObjectColumn):
+                return False
+            kind = pd.api.types.infer_dtype(col.values, skipna=True)
+            if kind not in SCALAR_DTYPE_KINDS:
+                return False  # collection values pivot through the host path
+        return True
+
+    def jax_host_prep(self, cols):
+        """i32 target column per input: [0,k) category, k OTHER, k+1 null,
+        -1 no output (null with track_nulls off)."""
+        outs = []
+        for col, cats in zip(cols, self.categories):
+            index = {c: j for j, c in enumerate(cats)}
+            k = len(cats)
+            labels, inv, present = OneHotVectorizer._scalar_codes(col)
+            lab_target = np.array([index.get(lab, k) for lab in labels]
+                                  or [0], dtype=np.int32)
+            target = np.where(present, lab_target[inv],
+                              k + 1 if self.track_nulls else -1)
+            outs.append(target.astype(np.int32))
+        return outs
+
+    def jax_transform(self, *targets):
+        import jax
+        import jax.numpy as jnp
+
+        blocks = []
+        for tgt, cats in zip(targets, self.categories):
+            k = len(cats)
+            width = k + (2 if self.track_nulls else 1)
+            blocks.append(jax.nn.one_hot(tgt, width, dtype=jnp.float32))
+        return jnp.concatenate(blocks, axis=1)
+
+    def jax_out_metadata(self, cols):
+        meta = []
+        for f, cats in zip(self.inputs, self.categories):
+            ind = list(cats) + [self.unseen_name] \
+                + ([NULL_INDICATOR] if self.track_nulls else [])
+            for v in ind:
+                meta.append(VectorColumnMetadata((f.name,), (f.ftype.__name__,),
+                                                 grouping=None, indicator_value=v))
+        vm = _vector_meta(self, meta)
+        self.metadata["vector_metadata"] = vm
+        return vm
 
 
 OpOneHotVectorizer = OneHotVectorizer

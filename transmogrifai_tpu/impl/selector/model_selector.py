@@ -250,21 +250,22 @@ class ModelSelector(BinaryEstimator, AllowLabelAsInput):
         # 2b. maxTrainingSample cap BEFORE materializing the sweep matrix
         # (reference splitters downsample in preValidationPrepare /
         # validationPrepare — DataSplitter.scala:65, DataBalancer.scala:84).
-        # Rows are drawn proportionally to the preparation weights, so the
-        # subsample IS the prepared (balanced, capped) training distribution;
-        # the sweep then runs unweighted on data that fits one chip.
+        # Rows are drawn UNIFORMLY without replacement and the preparation
+        # weights are kept on the survivors, so the sweep still trains on the
+        # splitter's balanced distribution (a weighted without-replacement
+        # draw cannot upsample the minority and flattens the weights as the
+        # pool shrinks — it would neither match the balancer nor the raw
+        # distribution).
         cap = getattr(self.splitter, "max_training_sample", None) \
             if self.splitter is not None else None
         if cap and len(train_idx) > cap:
             rng = np.random.default_rng(self.validator.seed)
-            p = None
-            if prep_w is not None and prep_w.sum() > 0:
-                p = np.asarray(prep_w, np.float64)
-                p = p / p.sum()
-            sub = rng.choice(len(train_idx), size=int(cap), replace=False, p=p)
-            train_idx = train_idx[np.sort(sub)]
+            sub = np.sort(rng.choice(len(train_idx), size=int(cap),
+                                     replace=False))
+            train_idx = train_idx[sub]
             ytr = y[train_idx]
-            prep_w = None  # the draw already applied the preparation weights
+            if prep_w is not None:
+                prep_w = prep_w[sub]
         Xtr = X[train_idx]
 
         # 3. the sweep (skipped when workflow-level CV already chose a winner)

@@ -142,6 +142,7 @@ def boosted_grid_folds(est, X, y, train_w, grids, loss: str, n_classes: int,
             n, max_depth, mcw_min, h_max=h_max,
             max_frontier=int(est.get_param("max_frontier",
                                            DEFAULT_MAX_FRONTIER_BOOSTED)))
+        exact_cap = Tr.frontier_is_exact(n, max_depth, mcw_min, h_max, frontier)
         B = n_folds * len(cis)
         w_batch = np.empty((B, n), np.float32)
         eta_b = np.empty(B, np.float32)
@@ -181,7 +182,7 @@ def boosted_grid_folds(est, X, y, train_w, grids, loss: str, n_classes: int,
             eta_b=eta_dev, reg_lambda_b=lam_dev,
             gamma_b=gam_dev, min_child_weight_b=mcw_dev,
             base_score_b=base_dev, n_classes=n_classes,
-            min_info_gain_b=mig_dev)
+            min_info_gain_b=mig_dev, exact_cap=exact_cap)
         F = np.asarray(F)[:B]
         for bi, (f, ci) in enumerate((f, ci) for f in range(n_folds) for ci in cis):
             out[f][ci] = convert(F[bi])
@@ -241,6 +242,7 @@ def forest_grid_folds(est, X, y, train_w, grids, n_classes: int, convert) -> lis
         frontier = Tr.frontier_cap(
             n, max_depth, mcw_min, h_max=1.0,
             max_frontier=int(est.get_param("max_frontier", DEFAULT_MAX_FRONTIER)))
+        exact_cap = Tr.frontier_is_exact(n, max_depth, mcw_min, 1.0, frontier)
         pairs = [(f, ci) for f in range(n_folds) for ci in cis]
         TT = len(pairs) * n_trees
         w_trees = np.empty((TT, n), np.float32)
@@ -280,14 +282,15 @@ def forest_grid_folds(est, X, y, train_w, grids, n_classes: int, convert) -> lis
                 active_mesh(), MODEL_AXIS, jnp.asarray(Xb), jnp.asarray(G),
                 jnp.asarray(H), jnp.asarray(w_trees), jnp.asarray(fms),
                 jnp.asarray(mcw), max_depth=max_depth, n_bins=n_bins,
-                chunk=chunk, frontier=frontier, mig_trees=jnp.asarray(mig))
+                chunk=chunk, frontier=frontier, mig_trees=jnp.asarray(mig),
+                exact_cap=exact_cap)
             forest = jax.tree.map(lambda a: jnp.asarray(np.asarray(a)), forest)
         else:
             forest = Tr.fit_forest_chunked(
                 jnp.asarray(Xb), jnp.asarray(G), jnp.asarray(H), jnp.asarray(w_trees),
                 jnp.asarray(fms), jnp.asarray(mcw), max_depth=max_depth,
                 n_bins=n_bins, chunk=chunk, frontier=frontier,
-                mig_trees=jnp.asarray(mig))
+                mig_trees=jnp.asarray(mig), exact_cap=exact_cap)
         if pad:
             forest = jax.tree.map(lambda a: a[:TT], forest)
         dist = np.asarray(Tr.predict_forest_groups(jnp.asarray(Xb), forest,

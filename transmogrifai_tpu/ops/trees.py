@@ -144,7 +144,14 @@ def frontier_cap(n: int, max_depth: int, min_child_weight: float = 1.0,
 
 
 def _pool_size(max_depth: int, frontier: int) -> int:
-    """Node-pool capacity: exact heap for unrolled levels + M per loop level."""
+    """Node-pool capacity: exact heap for unrolled levels + M per loop level.
+
+    Pool layout is STATIC: level t < log2(M) occupies [2^t - 1, 2^(t+1) - 1);
+    loop level t >= log2(M) occupies [M - 1 + (t - L)*M, ...+M).  Every level
+    claims its full block whether or not all slots split — offsets are then
+    independent of the tree, so the batched node/leaf writes stay single
+    vectorized ops under vmap instead of serializing per tree.
+    """
     if max_depth <= 0:
         return 1
     L = frontier.bit_length() - 1  # log2(M)
@@ -152,18 +159,47 @@ def _pool_size(max_depth: int, frontier: int) -> int:
     return (1 << (u + 1)) - 1 + max(max_depth - L, 0) * frontier
 
 
+def frontier_is_exact(n: int, max_depth: int, min_child_weight: float,
+                      h_max: float, frontier: int) -> bool:
+    """True when ``frontier`` provably cannot overflow (no beam truncation):
+    a level's children are bounded by H_total / mcw <= 1.25*h_max*n / mcw,
+    so a frontier at least that wide (or fully unrolled) never ranks splits.
+    The exact-cap fast path then replaces the gain-rank argsorts with a
+    trivial count clamp."""
+    exact = int(np.ceil(1.25 * h_max * n / max(min_child_weight, 1e-3)))
+    return frontier >= min(1 << max_depth, exact)
+
+
 # ---------------------------------------------------------------------------
 # Tree growth
 # ---------------------------------------------------------------------------
-def _hist_via_matmul(n: int, d: int, n_bins: int) -> bool:
+def _hist_bf16() -> bool:
+    """bf16 inputs for the histogram matmul (f32 accumulation).
+
+    Exact for RF (one-hot entries, 0/-1 gradients and small-int bootstrap
+    weights are all bf16-representable); boosted gradients round to ~3
+    decimal digits, which only perturbs near-tie split choices.
+    TMOG_HIST_BF16=0/1 forces either way (parity tests force 0).
+    """
+    import os
+
+    force = os.environ.get("TMOG_HIST_BF16")
+    if force is not None and force != "":
+        return force == "1"
+    # measured on v5e: bf16 inputs LOSE ~2x on this matmul shape (the convert
+    # + re-layout outweighs the MXU saving at these small contractions)
+    return False
+
+
+def _hist_via_matmul(n: int, d: int, n_bins: int, c1: int = 2) -> bool:
     """Pick the histogram formulation (static, at trace time).
 
     TPU: scatters (segment_sum) serialize on the VPU and dominated the
     round-2 sweep; the one-hot-matmul formulation routes the same reduction
     through the MXU (measured ~20x faster on the Titanic sweep despite doing
-    more raw FLOPs).  It materializes a shared [n, d*B] bin one-hot, so fall
-    back to segment_sum when that exceeds ~2 GB (the 10M x 500 scale config
-    row-shards first, keeping each shard under the cap).  CPU keeps
+    more raw FLOPs).  It materializes a shared [n, c1*d*B] gradient one-hot,
+    so fall back to segment_sum when that exceeds ~2 GB (the 10M x 500 scale
+    config row-shards first, keeping each shard under the cap).  CPU keeps
     segment_sum — scalar scatters are cheap there and the one-hot is pure
     overhead.  TMOG_HIST_MATMUL=0/1 forces either path (parity tests).
     """
@@ -174,34 +210,42 @@ def _hist_via_matmul(n: int, d: int, n_bins: int) -> bool:
         return force == "1"
     if jax.default_backend() != "tpu":
         return False
-    return float(n) * d * n_bins * 4 <= 2e9
+    return float(n) * d * n_bins * c1 * (2 if _hist_bf16() else 4) <= 2e9
 
 
-def bin_onehot(Xb, n_bins: int) -> jax.Array:
-    """Shared [n, d*B] f32 one-hot of each feature's bin index — built once
-    per launch and reused by every tree and level's histogram matmul."""
+def grad_onehot(Xb, gh, n_bins: int) -> jax.Array:
+    """Shared RHS of the level-histogram matmul: [n, c1*d*B] where entry
+    (r, c*d*B + j*B + b) = gh[r, c] * 1[bin(r, j) == b].
+
+    Built ONCE per launch (gradients are constant across a forest's levels;
+    per boosting round for GBT) and contracted against the per-level
+    weighted slot one-hot — row weights live on the slot side, so this
+    tensor is shared by every tree of a vmapped forest."""
     n, d = Xb.shape
-    oh = jax.nn.one_hot(Xb.astype(jnp.int32), n_bins, dtype=jnp.float32)
-    return oh.reshape(n, d * n_bins)
+    dt = jnp.bfloat16 if _hist_bf16() else jnp.float32
+    oh = jax.nn.one_hot(Xb.astype(jnp.int32), n_bins, dtype=dt)  # [n, d, B]
+    og = gh.astype(dt)[:, :, None, None] * oh[:, None, :, :]     # [n, c1, d, B]
+    return og.reshape(n, -1)
 
 
-def _level_histograms_mm(Obin, ghw, row_slot, m: int, n_bins: int, d: int):
-    """MXU histogram build: G [m, d, B, c], H [m, d, B] via one matmul.
+def _level_histograms_mm(Og, S, w, m: int, n_bins: int, d: int, c1: int):
+    """MXU histogram build: G [m, c, d, B], H [m, d, B] via ONE matmul.
 
-    S = one_hot(row_slot) [n, m] (slot -1 -> all-zero row, i.e. resting rows
-    drop out); SG [n, m*(c+1)] = S (x) ghw; GH = SG^T @ Obin — a single
-    [m*(c+1), n] x [n, d*B] contraction instead of d scatters.
+    S = one_hot(row_slot) [n, m] (slot -1 -> all-zero row, i.e. resting
+    rows drop out); row weights fold into S here so ``Og`` stays shared;
+    GH = (S*w)^T @ Og — a single [m, n] x [n, c1*d*B] contraction instead
+    of d scatters.  Accumulation is always f32 (preferred_element_type);
+    the bins axis stays minor so no tensor has a 2-wide lane dimension.
     """
-    n, c1 = ghw.shape
-    S = jax.nn.one_hot(row_slot, m, dtype=ghw.dtype)          # [n, m]
-    SG = (S[:, :, None] * ghw[:, None, :]).reshape(n, m * c1)
-    GH = SG.T @ Obin                                          # [m*c1, d*B]
-    GH = GH.reshape(m, c1, d, n_bins).transpose(0, 2, 3, 1)   # [m, d, B, c1]
-    return GH[..., :c1 - 1], GH[..., c1 - 1]
+    Sw = S * w.astype(S.dtype)[:, None]
+    GH = lax.dot_general(Sw.astype(Og.dtype), Og, (((0,), (0,)), ((), ())),
+                         preferred_element_type=jnp.float32)     # [m, c1*d*B]
+    GH = GH.reshape(m, c1, d, n_bins)
+    return GH[:, :c1 - 1], GH[:, c1 - 1]
 
 
 def _level_histograms(Xb, ghw, row_slot, m: int, n_bins: int):
-    """Per-(slot, feature, bin) stats: G [m, d, B, c], H [m, d, B].
+    """Per-(slot, feature, bin) stats: G [m, c, d, B], H [m, d, B].
 
     ghw: f32[n, c+1] — weighted gradients with the weighted hessian as the
     last channel, so G and H come out of ONE scatter per feature.
@@ -218,41 +262,62 @@ def _level_histograms(Xb, ghw, row_slot, m: int, n_bins: int):
 
     GH = jax.vmap(per_feature, in_axes=1, out_axes=0)(Xb)  # [d, m*B, c+1]
     c = ghw.shape[1] - 1
-    GH = GH.reshape(d, m, B, c + 1).transpose(1, 0, 2, 3)
-    return GH[..., :c], GH[..., c]
+    GH = GH.reshape(d, m, B, c + 1).transpose(1, 3, 0, 2)  # [m, c1, d, B]
+    return GH[:, :c], GH[:, c]
 
 
-def _grow_level(Xb, ghw, feat_mask, tree: Tree, next_free, slot_node,
-                row_slot, m: int, next_cap: int, n_bins: int, reg_lambda,
-                gamma, min_child_weight, min_info_gain=0.0, Obin=None):
+def _grow_level(Xb, gh, w, feat_mask, nodes, leaf_val, slot_base, next_free,
+                n_active, row_slot, row_node, m: int, next_cap: int,
+                n_bins: int, reg_lambda, gamma, min_child_weight,
+                min_info_gain=0.0, Og=None, exact_cap: bool = False):
     """One breadth-first level over an ``m``-slot frontier.
 
-    Returns (tree', next_free', slot_node'[next_cap], row_slot').  ``m`` and
-    ``next_cap`` are static; when ``next_cap < 2 * m`` the level keeps only
-    the top ``next_cap // 2`` splits by gain (beam cap — see module doc).
-    ``Obin`` (shared bin one-hot) selects the MXU matmul histogram build.
+    SCATTER/GATHER-FREE by design: XLA TPU lowers batched scatters and
+    per-element gathers to near-serial loops (~10 ms per level at 900 trees
+    x 891 rows, measured), so every per-row lookup of per-slot data rides an
+    MXU matmul against the slot one-hot ``S``, node records land with ONE
+    ``dynamic_update_slice`` per level (the frontier occupies the static
+    pool block ``[slot_base, slot_base + m)`` — see ``_pool_size``; offsets
+    are tree-independent so the batched write stays one vectorized op),
+    children pack into ``[next_free, next_free + 2k)`` via tiny selection
+    matmuls (no argsort), and the next frontier needs no materialized map —
+    slot j of the next level IS pool id ``next_free + j``.
+
+    ``nodes`` is the packed i32[P, 4] pool (feat, bin, left, right);
+    ``leaf_val`` f32[P, c]; ``n_active`` the live width of the frontier
+    (slots beyond it are dead); ``slot_base``/``next_free`` are scalars
+    uniform across a vmapped batch (python ints or loop-index affine).
+    Returns (nodes', leaf_val', n_active', row_slot', row_node').  ``m`` and
+    ``next_cap`` are static; when ``next_cap < 2*m`` the level keeps only
+    the top ``next_cap // 2`` splits by gain — unless ``exact_cap`` says the
+    frontier provably cannot overflow, where a count clamp replaces the
+    sorts.  ``Og`` (shared gradient one-hot) selects the MXU matmul
+    histogram build.  A node's leaf value is written once, when the node is
+    created (root at init).  ``row_node`` tracks each row's current pool
+    node so boosting can read final leaf values without a predict walk.
     """
     B = n_bins
     d = Xb.shape[1]
-    P = tree.split_feat.shape[0]
-    if Obin is not None:
-        G, H = _level_histograms_mm(Obin, ghw, row_slot, m, B, d)
+    c = gh.shape[1] - 1
+    iota_m = jnp.arange(m)
+    in_use = iota_m < n_active
+    if Og is not None:
+        S = jax.nn.one_hot(row_slot, m, dtype=jnp.float32)       # [n, m]
+        G, H = _level_histograms_mm(Og, S, w, m, B, d, c + 1)
     else:
-        G, H = _level_histograms(Xb, ghw, row_slot, m, B)
-    GT = G[:, 0].sum(axis=1)   # [m, c] — node totals (identical across features)
-    HT = H[:, 0].sum(axis=1)   # [m]
-    in_use = slot_node >= 0
-    vals = -GT / (HT + reg_lambda)[:, None]
-    leaf_val = tree.leaf_val.at[jnp.where(in_use, slot_node, P)].set(
-        vals, mode="drop")
+        S = None
+        G, H = _level_histograms(Xb, gh * w[:, None], row_slot, m, B)
+    # G: [m, c, d, B]; H: [m, d, B] — bins minor, no 2-wide lane dims
+    GT = G[:, :, 0, :].sum(axis=-1)   # [m, c] — node totals (same per feature)
+    HT = H[:, 0, :].sum(axis=-1)      # [m]
 
-    GL = jnp.cumsum(G, axis=2)                   # [m, d, B, c]
-    HL = jnp.cumsum(H, axis=2)                   # [m, d, B]
-    GR = GT[:, None, None, :] - GL
+    GL = jnp.cumsum(G, axis=-1)                  # [m, c, d, B]
+    HL = jnp.cumsum(H, axis=-1)                  # [m, d, B]
+    GR = GT[:, :, None, None] - GL
     HR = HT[:, None, None] - HL
 
     def score(Gp, Hp):
-        return (Gp * Gp).sum(axis=-1) / (Hp + reg_lambda)
+        return (Gp * Gp).sum(axis=1) / (Hp + reg_lambda)
 
     gain = score(GL, HL) + score(GR, HR) - score(GT, HT)[:, None, None]  # [m,d,B]
     valid = (HL >= min_child_weight) & (HR >= min_child_weight)
@@ -261,7 +326,7 @@ def _grow_level(Xb, ghw, feat_mask, tree: Tree, next_free, slot_node,
     gain = jnp.where(valid, gain, -jnp.inf)
     flat = gain.reshape(m, d * B)
     best = jnp.argmax(flat, axis=1)              # [m]
-    best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+    best_gain = jnp.max(flat, axis=1)
     bf = (best // B).astype(jnp.int32)
     bb = (best % B).astype(jnp.int32)
     # Spark minInfoGain parity: our gain is the total-sum-of-squares drop,
@@ -269,61 +334,90 @@ def _grow_level(Xb, ghw, feat_mask, tree: Tree, next_free, slot_node,
     # gini (g=-onehot) and variance (g=-y) trees — so the per-row threshold
     # scales by the node's hessian total (DefaultSelectorParams.MinInfoGain).
     do_split = (best_gain > gamma) & (best_gain >= min_info_gain * HT) & in_use
-    if next_cap < 2 * m:  # beam cap: keep top next_cap//2 splits by gain
-        order = jnp.argsort(-jnp.where(do_split, best_gain, -jnp.inf))
-        rank = jnp.zeros((m,), jnp.int32).at[order].set(jnp.arange(m))
-        do_split &= rank < next_cap // 2
-
-    k = jnp.cumsum(do_split.astype(jnp.int32))   # inclusive counts
+    half = next_cap // 2
+    if next_cap < 2 * m and not exact_cap:
+        # beam cap: keep top half splits by gain (scatter-free inverse perm)
+        key = jnp.where(do_split, -best_gain, jnp.inf)
+        rank = jnp.argsort(jnp.argsort(key))
+        do_split &= rank < half
+        k = jnp.cumsum(do_split.astype(jnp.int32))
+    else:
+        k = jnp.cumsum(do_split.astype(jnp.int32))
+        if next_cap < 2 * m:  # provably non-binding; clamp guards anyway
+            do_split &= k <= half
+            k = jnp.minimum(k, half)
+    n_split = k[-1]
     child_idx = (k - 1) * 2                      # left child's next-level slot
     left_pool = next_free + child_idx
     right_pool = left_pool + 1
-    tgt = jnp.where(do_split, slot_node, P)
-    tree = Tree(
-        split_feat=tree.split_feat.at[tgt].set(bf, mode="drop"),
-        split_bin=tree.split_bin.at[tgt].set(bb, mode="drop"),
-        left=tree.left.at[tgt].set(left_pool, mode="drop"),
-        right=tree.right.at[tgt].set(right_pool, mode="drop"),
-        leaf_val=leaf_val)
-    # children's leaf values straight from the winning split's stats
-    GLf = GL.reshape(m, d * B, -1)
-    HLf = HL.reshape(m, d * B)
-    GL_best = jnp.take_along_axis(GLf, best[:, None, None], axis=1)[:, 0]  # [m,c]
-    HL_best = jnp.take_along_axis(HLf, best[:, None], axis=1)[:, 0]        # [m]
+    # node records for the whole frontier, ONE dynamic_update_slice.  Slots
+    # past the live frontier get the leaf default — which is exactly the
+    # correct initial record for the children this level allocates there.
+    rec = jnp.stack([jnp.where(do_split, bf, -1),
+                     jnp.where(do_split, bb, 0),
+                     jnp.where(do_split, left_pool, 0),
+                     jnp.where(do_split, right_pool, 0)], axis=-1)   # [m, 4]
+    nodes = lax.dynamic_update_slice(nodes, rec, (slot_base, 0))
+    # children's leaf values straight from the winning split's stats; the
+    # best-split slice is a one-hot reduction, not a take_along_axis gather
+    onehot_best = jax.nn.one_hot(best, d * B, dtype=GL.dtype)        # [m, dB]
+    GL_best = (GL.reshape(m, c, d * B) * onehot_best[:, None, :]).sum(-1)
+    HL_best = (HL.reshape(m, d * B) * onehot_best).sum(-1)
     GR_best = GT - GL_best
     HR_best = HT - HL_best
     lval = -GL_best / (HL_best + reg_lambda)[:, None]
     rval = -GR_best / (HR_best + reg_lambda)[:, None]
-    leaf_val = tree.leaf_val
-    leaf_val = leaf_val.at[jnp.where(do_split, left_pool, P)].set(lval, mode="drop")
-    leaf_val = leaf_val.at[jnp.where(do_split, right_pool, P)].set(rval, mode="drop")
-    tree = tree._replace(leaf_val=leaf_val)
-    # next frontier: children packed into slots [0, 2k)
-    new_slot = jnp.full((next_cap,), -1, jnp.int32)
-    new_slot = new_slot.at[jnp.where(do_split, child_idx, next_cap)].set(
-        left_pool, mode="drop")
-    new_slot = new_slot.at[jnp.where(do_split, child_idx + 1, next_cap)].set(
-        right_pool, mode="drop")
-    # route rows: gather their slot's split; rows on leaves rest (-1)
-    s_safe = jnp.maximum(row_slot, 0)
-    splits_here = do_split[s_safe] & (row_slot >= 0)
-    row_bin = jnp.take_along_axis(Xb, bf[s_safe][:, None], axis=1)[:, 0]
-    go_right = (row_bin > bb[s_safe]).astype(jnp.int32)
-    new_row_slot = jnp.where(splits_here, child_idx[s_safe] + go_right, -1)
-    next_free = next_free + 2 * k[-1]
-    return tree, next_free, new_slot, new_row_slot
+    # pack (lval, rval) of the k split slots into the contiguous child block
+    # [next_free, next_free + 2k) with two tiny selection matmuls (slot s's
+    # left child lands at position child_idx[s], right at +1); the tail
+    # beyond 2k stays zero in not-yet-allocated pool slots, which later
+    # levels overwrite or leave unreachable (no pointer ever reaches them)
+    iota_cap = jnp.arange(next_cap)
+    pos_l = jnp.where(do_split, child_idx, -1)
+    pos_r = jnp.where(do_split, child_idx + 1, -1)
+    L_eq = (iota_cap[:, None] == pos_l[None, :]).astype(leaf_val.dtype)
+    R_eq = (iota_cap[:, None] == pos_r[None, :]).astype(leaf_val.dtype)
+    child_vals = L_eq @ lval + R_eq @ rval                   # [next_cap, c]
+    leaf_val = lax.dynamic_update_slice(leaf_val, child_vals, (next_free, 0))
+    # route rows: each row needs its slot's (do_split, bb, child_idx, bf);
+    # gather-via-matmul against S — per-element gathers serialize on TPU
+    if S is not None:
+        pack = jnp.concatenate(
+            [do_split.astype(jnp.float32)[:, None],
+             bb.astype(jnp.float32)[:, None],
+             child_idx.astype(jnp.float32)[:, None],
+             jax.nn.one_hot(bf, d, dtype=jnp.float32)], axis=1)      # [m, 3+d]
+        routed = S @ pack                                            # [n, 3+d]
+        splits_here = routed[:, 0] > 0.5
+        child_r = routed[:, 2].astype(jnp.int32)
+        row_bin = (routed[:, 3:] * Xb).sum(axis=1)   # f32-exact small ints
+        go_right = (row_bin > routed[:, 1]).astype(jnp.int32)
+    else:
+        s_safe = jnp.maximum(row_slot, 0)
+        splits_here = do_split[s_safe] & (row_slot >= 0)
+        row_bin = jnp.take_along_axis(Xb, bf[s_safe][:, None], axis=1)[:, 0]
+        go_right = (row_bin > bb[s_safe]).astype(jnp.int32)
+        child_r = child_idx[s_safe]
+    new_row_slot = jnp.where(splits_here, child_r + go_right, -1)
+    row_node = jnp.where(splits_here, next_free + child_r + go_right, row_node)
+    return nodes, leaf_val, 2 * n_split, new_row_slot, row_node
 
 
 def grow_tree(Xb, g, h, w, feat_mask, max_depth: int, n_bins: int,
               frontier: int, reg_lambda: float = 1.0, gamma: float = 0.0,
               min_child_weight: float = 1.0, min_info_gain=0.0,
-              Obin=None) -> Tree:
+              Og=None, return_row_node: bool = False,
+              exact_cap: bool = False):
     """Grow one second-order histogram tree (traceable; static shapes).
 
     Xb: int[n, d] pre-binned features; g: f32[n, c] gradients; h: f32[n]
     hessians; w: f32[n] row weights (bootstrap/balancing; 0 drops the row);
     feat_mask: f32[d] 1/0 feature subsampling mask; ``frontier``: static
-    frontier width M (see ``frontier_cap``).
+    frontier width M (see ``frontier_cap``); ``Og``: optional shared
+    ``grad_onehot(Xb, concat([g, h], 1), n_bins)`` selecting the MXU
+    histogram path.  With ``return_row_node`` the final (tree, row_node)
+    pair is returned — ``leaf_val[row_node]`` is the tree's prediction on
+    the training rows, sparing boosting a predict walk.
 
     Gain (XGBoost): sum_c GL_c^2/(HL+l) + GR_c^2/(HR+l) - GT_c^2/(HT+l);
     leaf value: -G/(H+l).  With g=-y, h=1, l~0 this is exactly variance-gain
@@ -335,48 +429,55 @@ def grow_tree(Xb, g, h, w, feat_mask, max_depth: int, n_bins: int,
     n, d = Xb.shape
     c = g.shape[1]
     P = _pool_size(max_depth, frontier)
-    tree = Tree(split_feat=jnp.full((P,), -1, jnp.int32),
-                split_bin=jnp.zeros((P,), jnp.int32),
-                left=jnp.zeros((P,), jnp.int32),
-                right=jnp.zeros((P,), jnp.int32),
-                leaf_val=jnp.zeros((P, c), jnp.float32))
     gw = g * w[:, None]
     hw = h * w
+    root_val = -gw.sum(axis=0) / (hw.sum() + reg_lambda)      # [c]
+    nodes = jnp.tile(jnp.asarray([-1, 0, 0, 0], jnp.int32), (P, 1))
+    leaf_val = jnp.zeros((P, c), jnp.float32).at[0].set(root_val)
+    row_node = jnp.zeros((n,), jnp.int32)
+
+    def as_tree(nodes, leaf_val):
+        return Tree(split_feat=nodes[:, 0], split_bin=nodes[:, 1],
+                    left=nodes[:, 2], right=nodes[:, 3], leaf_val=leaf_val)
+
     if max_depth <= 0:  # single leaf
-        GT = gw.sum(axis=0)
-        HT = hw.sum()
-        return tree._replace(leaf_val=tree.leaf_val.at[0].set(
-            -GT / (HT + reg_lambda)))
-    ghw = jnp.concatenate([gw, hw[:, None]], axis=1)  # one scatter per feature
+        tree = as_tree(nodes, leaf_val)
+        return (tree, row_node) if return_row_node else tree
+    gh = jnp.concatenate([g, h[:, None]], axis=1)  # unweighted; w rides S
 
     M = frontier
     L = M.bit_length() - 1
-    next_free = jnp.asarray(1, jnp.int32)
-    slot_node = jnp.zeros((1,), jnp.int32)       # root in slot 0
-    row_slot = jnp.zeros((n,), jnp.int32)
+    carry = (nodes, leaf_val,
+             jnp.asarray(1, jnp.int32),          # n_active (just the root)
+             jnp.zeros((n,), jnp.int32),         # row_slot
+             row_node)
     # exact unrolled levels: widths 1, 2, 4, ..., min(2^(depth-1), M/ --)
+    # static pool layout (_pool_size): level t's frontier block starts at
+    # 2^t - 1; loop level t's at M - 1 + (t - L)*M — uniform across trees
     u = min(max_depth, L)
     for t in range(u):
         next_cap = 1 << (t + 1)                  # = 2m: no beam cap
-        tree, next_free, slot_node, row_slot = _grow_level(
-            Xb, ghw, feat_mask, tree, next_free, slot_node, row_slot,
-            m=1 << t, next_cap=next_cap, n_bins=n_bins,
-            reg_lambda=reg_lambda, gamma=gamma,
+        carry = _grow_level(
+            Xb, gh, w, feat_mask, carry[0], carry[1], (1 << t) - 1,
+            (1 << (t + 1)) - 1, *carry[2:], m=1 << t, next_cap=next_cap,
+            n_bins=n_bins, reg_lambda=reg_lambda, gamma=gamma,
             min_child_weight=min_child_weight, min_info_gain=min_info_gain,
-            Obin=Obin)
+            Og=Og, exact_cap=exact_cap)
     # deep levels: ONE fori_loop body at fixed M slots
     if max_depth > L:
-        def body(_, carry):
-            tree, next_free, slot_node, row_slot = carry
-            return _grow_level(Xb, ghw, feat_mask, tree, next_free,
-                               slot_node, row_slot, m=M, next_cap=M,
+        def body(t, carry):
+            sb = M - 1 + (t - L) * M             # affine in t: batch-uniform
+            return _grow_level(Xb, gh, w, feat_mask, carry[0], carry[1], sb,
+                               sb + M, *carry[2:], m=M, next_cap=M,
                                n_bins=n_bins, reg_lambda=reg_lambda,
                                gamma=gamma, min_child_weight=min_child_weight,
-                               min_info_gain=min_info_gain, Obin=Obin)
+                               min_info_gain=min_info_gain, Og=Og,
+                               exact_cap=exact_cap)
 
-        tree, next_free, slot_node, row_slot = lax.fori_loop(
-            L, max_depth, body, (tree, next_free, slot_node, row_slot))
-    return tree
+        carry = lax.fori_loop(L, max_depth, body, carry)
+    nodes, leaf_val, row_node = carry[0], carry[1], carry[4]
+    tree = as_tree(nodes, leaf_val)
+    return (tree, row_node) if return_row_node else tree
 
 
 def predict_tree(Xb, tree: Tree, max_depth: int) -> jax.Array:
@@ -399,10 +500,12 @@ def predict_tree(Xb, tree: Tree, max_depth: int) -> jax.Array:
 # ---------------------------------------------------------------------------
 # Random forest — vmap over trees
 # ---------------------------------------------------------------------------
-@functools.partial(jax.jit, static_argnames=("max_depth", "n_bins", "frontier"))
+@functools.partial(jax.jit, static_argnames=("max_depth", "n_bins", "frontier",
+                                             "exact_cap"))
 def fit_forest(Xb, g, h, w_trees, feat_masks, max_depth: int, n_bins: int,
                frontier: int, reg_lambda: float = 1e-6,
-               min_child_weight: float = 1.0, min_info_gain: float = 0.0) -> Tree:
+               min_child_weight: float = 1.0, min_info_gain: float = 0.0,
+               exact_cap: bool = False) -> Tree:
     """Train all trees of a forest in one launch.
 
     w_trees: f32[T, n] bootstrap weights; feat_masks: f32[T, d].
@@ -410,13 +513,16 @@ def fit_forest(Xb, g, h, w_trees, feat_masks, max_depth: int, n_bins: int,
     """
 
     n, d = Xb.shape
-    Obin = bin_onehot(Xb, n_bins) if _hist_via_matmul(n, d, n_bins) else None
+    c1 = g.shape[1] + 1
+    Og = (grad_onehot(Xb, jnp.concatenate([g, h[:, None]], axis=1), n_bins)
+          if _hist_via_matmul(n, d, n_bins, c1) else None)
 
     def one(wt, fm):
         return grow_tree(Xb, g, h, wt, fm, max_depth, n_bins, frontier,
                          reg_lambda=reg_lambda, gamma=0.0,
                          min_child_weight=min_child_weight,
-                         min_info_gain=min_info_gain, Obin=Obin)
+                         min_info_gain=min_info_gain, Og=Og,
+                         exact_cap=exact_cap)
 
     return jax.vmap(one)(w_trees, feat_masks)
 
@@ -439,10 +545,12 @@ def forest_chunk_size(max_depth: int, n_bins: int, d: int, c: int,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("max_depth", "n_bins", "chunk", "frontier"))
+                   static_argnames=("max_depth", "n_bins", "chunk", "frontier",
+                                    "exact_cap"))
 def fit_forest_chunked(Xb, g, h, w_trees, feat_masks, mcw_trees, max_depth: int,
                        n_bins: int, chunk: int, frontier: int,
-                       reg_lambda: float = 1e-6, mig_trees=None) -> Tree:
+                       reg_lambda: float = 1e-6, mig_trees=None,
+                       exact_cap: bool = False) -> Tree:
     """Train an arbitrary tree population with bounded memory: ``lax.map``
     over chunks of ``chunk`` vmapped trees — one compile, sequential chunks.
 
@@ -456,7 +564,9 @@ def fit_forest_chunked(Xb, g, h, w_trees, feat_masks, mcw_trees, max_depth: int,
     d = Xb.shape[1]
     if mig_trees is None:
         mig_trees = jnp.zeros_like(mcw_trees)
-    Obin = bin_onehot(Xb, n_bins) if _hist_via_matmul(n, d, n_bins) else None
+    c1 = g.shape[1] + 1
+    Og = (grad_onehot(Xb, jnp.concatenate([g, h[:, None]], axis=1), n_bins)
+          if _hist_via_matmul(n, d, n_bins, c1) else None)
 
     def one_chunk(args):
         wts, fms, mcws, migs = args
@@ -465,7 +575,7 @@ def fit_forest_chunked(Xb, g, h, w_trees, feat_masks, mcw_trees, max_depth: int,
             return grow_tree(Xb, g, h, wt, fm, max_depth, n_bins, frontier,
                              reg_lambda=reg_lambda, gamma=0.0,
                              min_child_weight=mcw, min_info_gain=mig,
-                             Obin=Obin)
+                             Og=Og, exact_cap=exact_cap)
 
         return jax.vmap(one)(wts, fms, mcws, migs)
 
@@ -479,7 +589,7 @@ def fit_forest_chunked(Xb, g, h, w_trees, feat_masks, mcw_trees, max_depth: int,
 def fit_forest_sharded(mesh, axis_name: str, Xb, g, h, w_trees, feat_masks,
                        mcw_trees, max_depth: int, n_bins: int, chunk: int,
                        frontier: int, reg_lambda: float = 1e-6,
-                       mig_trees=None) -> Tree:
+                       mig_trees=None, exact_cap: bool = False) -> Tree:
     """Tree-axis-sharded forest training: each mesh shard grows its slice of
     the tree population with the memory-chunked kernel — zero communication
     (SURVEY §2.7 axis 2; the OpValidator thread pool spread over chips).
@@ -502,7 +612,8 @@ def fit_forest_sharded(mesh, axis_name: str, Xb, g, h, w_trees, feat_masks,
     def local(xb, gg, hh, w, fm, mc, mg):
         return fit_forest_chunked(xb, gg, hh, w, fm, mc, max_depth=max_depth,
                                   n_bins=n_bins, chunk=chunk, frontier=frontier,
-                                  reg_lambda=reg_lambda, mig_trees=mg)
+                                  reg_lambda=reg_lambda, mig_trees=mg,
+                                  exact_cap=exact_cap)
 
     sm = shard_map(local, mesh=mesh,
                    in_specs=(P(), P(), P(), P(axis_name), P(axis_name),
@@ -538,24 +649,29 @@ def _grad_hess(loss: str, F, y, Y_onehot):
 def _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int,
               max_depth: int, n_bins: int, frontier: int, eta, reg_lambda,
               gamma, min_child_weight, base_score: float, n_classes: int,
-              min_info_gain=0.0) -> Tuple[Tree, jax.Array]:
+              min_info_gain=0.0, exact_cap: bool = False) -> Tuple[Tree, jax.Array]:
     """Traceable boosting body shared by fit_gbt and fit_gbt_batch."""
     n = Xb.shape[0]
     c = n_classes if loss == "softmax" else 1
     Y = jax.nn.one_hot(y.astype(jnp.int32), max(c, 2), dtype=jnp.float32) \
         if loss == "softmax" else jnp.zeros((n, 2), jnp.float32)
     F0 = jnp.full((n, c), base_score, jnp.float32)
-    Obin = bin_onehot(Xb, n_bins) \
-        if _hist_via_matmul(n, Xb.shape[1], n_bins) else None
+    use_mm = _hist_via_matmul(n, Xb.shape[1], n_bins, c + 1)
 
     def round_fn(F, xs):
         rw, fm = xs
         g, hh = _grad_hess(loss, F, y, Y)
-        tree = grow_tree(Xb, g, hh, w * rw, fm, max_depth, n_bins, frontier,
-                         reg_lambda=reg_lambda, gamma=gamma,
-                         min_child_weight=min_child_weight,
-                         min_info_gain=min_info_gain, Obin=Obin)
-        F = F + eta * predict_tree(Xb, tree, max_depth)
+        # gradients change per round, so the shared one-hot is per-round too
+        Og = (grad_onehot(Xb, jnp.concatenate([g, hh[:, None]], axis=1),
+                          n_bins) if use_mm else None)
+        tree, row_node = grow_tree(
+            Xb, g, hh, w * rw, fm, max_depth, n_bins, frontier,
+            reg_lambda=reg_lambda, gamma=gamma,
+            min_child_weight=min_child_weight,
+            min_info_gain=min_info_gain, Og=Og, return_row_node=True,
+            exact_cap=exact_cap)
+        # row_node is each row's resting node — no predict walk needed
+        F = F + eta * tree.leaf_val[row_node]
         return F, tree
 
     F, trees = lax.scan(round_fn, F0, (row_w_rounds, feat_mask_rounds))
@@ -563,13 +679,14 @@ def _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int
 
 
 @functools.partial(jax.jit, static_argnames=("loss", "n_rounds", "max_depth",
-                                             "n_bins", "n_classes", "frontier"))
+                                             "n_bins", "n_classes", "frontier",
+                                             "exact_cap"))
 def fit_gbt(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int,
             max_depth: int, n_bins: int, frontier: int, eta: float = 0.3,
             reg_lambda: float = 1.0, gamma: float = 0.0,
             min_child_weight: float = 1.0, base_score: float = 0.0,
-            n_classes: int = 1, min_info_gain: float = 0.0
-            ) -> Tuple[Tree, jax.Array]:
+            n_classes: int = 1, min_info_gain: float = 0.0,
+            exact_cap: bool = False) -> Tuple[Tree, jax.Array]:
     """XGBoost-style boosting: scan over rounds, one histogram tree per round.
 
     row_w_rounds: f32[R, n] subsample weights per round; feat_mask_rounds:
@@ -580,16 +697,17 @@ def fit_gbt(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int,
     return _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss, n_rounds,
                      max_depth, n_bins, frontier, eta, reg_lambda, gamma,
                      min_child_weight, base_score, n_classes,
-                     min_info_gain=min_info_gain)
+                     min_info_gain=min_info_gain, exact_cap=exact_cap)
 
 
 @functools.partial(jax.jit, static_argnames=("loss", "n_rounds", "max_depth",
-                                             "n_bins", "n_classes", "frontier"))
+                                             "n_bins", "n_classes", "frontier",
+                                             "exact_cap"))
 def fit_gbt_batch(Xb, y, w_batch, row_w_rounds, feat_mask_rounds, loss: str,
                   n_rounds: int, max_depth: int, n_bins: int, frontier: int,
                   eta_b, reg_lambda_b, gamma_b, min_child_weight_b,
                   base_score_b=None, n_classes: int = 1,
-                  min_info_gain_b=None) -> jax.Array:
+                  min_info_gain_b=None, exact_cap: bool = False) -> jax.Array:
     """The fold x grid boosting sweep as ONE launch (the OpValidator
     thread-pool analog for boosted models — SURVEY §2.7 axis 2).
 
@@ -609,7 +727,8 @@ def fit_gbt_batch(Xb, y, w_batch, row_w_rounds, feat_mask_rounds, loss: str,
     def one(w, eta, lam, gam, mcw, base, mig):
         _, F = _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss,
                          n_rounds, max_depth, n_bins, frontier, eta, lam, gam,
-                         mcw, base, n_classes, min_info_gain=mig)
+                         mcw, base, n_classes, min_info_gain=mig,
+                         exact_cap=exact_cap)
         return F
 
     return jax.vmap(one)(w_batch, eta_b, reg_lambda_b, gamma_b,

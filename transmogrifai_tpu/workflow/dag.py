@@ -63,17 +63,35 @@ class FittedDAG:
     fitted_stages: List[PipelineStage]
 
 
-#: jitted fused-layer programs keyed by the participating model objects
-_FUSED_JIT: Dict[Tuple[int, ...], Tuple[object, list]] = {}
+#: jitted fused-layer programs keyed by the participating model objects;
+#: bounded FIFO (each entry pins its models + a compiled executable, so an
+#: unbounded cache would leak across repeated train() calls in one process)
+_FUSED_JIT: "collections.OrderedDict[Tuple[int, ...], Tuple[object, list]]" = \
+    __import__("collections").OrderedDict()
+_FUSED_JIT_MAX = 32
 
 
 def _fusable(t, ds: Dataset) -> bool:
     from ..columns import NumericColumn, VectorColumn
 
-    return (hasattr(t, "jax_transform") and t.n_outputs == 1
-            and all(f.name in ds
-                    and isinstance(ds[f.name], (NumericColumn, VectorColumn))
-                    for f in t.inputs))
+    if not (hasattr(t, "jax_transform") and t.n_outputs == 1):
+        return False
+    cols = [ds.columns.get(f.name) for f in t.inputs]
+    if any(c is None for c in cols):
+        return False
+    if hasattr(t, "jax_host_prep"):
+        # stage does its own host-side preprocessing (e.g. categorical code
+        # lookup) and feeds small integer arrays into the fused launch
+        ready = getattr(t, "jax_host_ready", None)
+        return ready(cols) if ready is not None else True
+    return all(isinstance(c, (NumericColumn, VectorColumn)) for c in cols)
+
+
+def fused_stage_coverage(ds: Dataset, transformers: Sequence[Transformer]
+                         ) -> Tuple[int, int]:
+    """(fusable, total) transformer counts for a layer — the VERDICT r3 #6
+    coverage metric (tests assert >= 80% of Titanic transform stages fuse)."""
+    return sum(1 for t in transformers if _fusable(t, ds)), len(transformers)
 
 
 def _fused_layer(ds: Dataset, fusables: Sequence[Transformer]) -> Dict[str, Any]:
@@ -90,15 +108,22 @@ def _fused_layer(ds: Dataset, fusables: Sequence[Transformer]) -> Dict[str, Any]
     sizes = []
     for t in fusables:
         k = 0
-        for f in t.inputs:
-            col = ds[f.name]
-            if isinstance(col, NumericColumn):
-                flat += [jnp.asarray(col.values, jnp.float32),
-                         jnp.asarray(col.mask)]
-                k += 2
-            else:
-                flat.append(jnp.asarray(col.values, jnp.float32))
+        if hasattr(t, "jax_host_prep"):
+            # host-side prep (e.g. string -> category codes); the expansion
+            # and everything downstream run inside the fused XLA launch
+            for a in t.jax_host_prep([ds[f.name] for f in t.inputs]):
+                flat.append(jnp.asarray(a))
                 k += 1
+        else:
+            for f in t.inputs:
+                col = ds[f.name]
+                if isinstance(col, NumericColumn):
+                    flat += [jnp.asarray(col.values, jnp.float32),
+                             jnp.asarray(col.mask)]
+                    k += 2
+                else:
+                    flat.append(jnp.asarray(col.values, jnp.float32))
+                    k += 1
         sizes.append(k)
     key = tuple(id(t) for t in fusables)
     cached = _FUSED_JIT.get(key)
@@ -116,12 +141,21 @@ def _fused_layer(ds: Dataset, fusables: Sequence[Transformer]) -> Dict[str, Any]
 
         cached = (jax.jit(fused), ts)  # ts ref pins ids against gc reuse
         _FUSED_JIT[key] = cached
+        while len(_FUSED_JIT) > _FUSED_JIT_MAX:
+            _FUSED_JIT.popitem(last=False)
+    else:
+        _FUSED_JIT.move_to_end(key)
     outs = cached[0](flat)
     new_cols = {}
     for t, out in zip(fusables, outs):
-        vm = t.jax_out_metadata([ds[f.name] for f in t.inputs])
-        new_cols[t.get_outputs()[0].name] = VectorColumn(
-            T.OPVector, np.asarray(out), vm)
+        feat = t.get_outputs()[0]
+        if getattr(t, "jax_output", "vector") == "numeric":
+            vals, mask = out
+            new_cols[feat.name] = NumericColumn(
+                feat.ftype, np.asarray(vals), np.asarray(mask))
+        else:
+            vm = t.jax_out_metadata([ds[f.name] for f in t.inputs])
+            new_cols[feat.name] = VectorColumn(T.OPVector, np.asarray(out), vm)
     return new_cols
 
 

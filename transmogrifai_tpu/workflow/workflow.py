@@ -191,7 +191,12 @@ class OpWorkflow(OpWorkflowCore):
         return model
 
     def _response_names(self) -> set:
-        return {f.name for f in self.raw_features if f.is_response}
+        """Names that must survive intermediate-column freeing: responses
+        (labels feed evaluators after training) AND the workflow's result
+        features — a result produced in an early layer and not consumed
+        downstream must still reach ``model.train_data``."""
+        return ({f.name for f in self.raw_features if f.is_response}
+                | {f.name for f in self.result_features})
 
     def _set_blocklist(self, dropped: Sequence[Feature], dropped_map_keys: Dict[str, List[str]]):
         """Blocklist propagation: drop raw features + rebuild the DAG without
@@ -253,7 +258,8 @@ class OpWorkflow(OpWorkflowCore):
             raise ValueError("compute_data_up_to needs at least one feature")
         sub = dag_util.compute_dag(list(features))
         data = self._generate_raw_data(params)
-        fitted = dag_util.fit_and_transform_dag(sub, data)
+        fitted = dag_util.fit_and_transform_dag(
+            sub, data, responses={f.name for f in features})
         return fitted.train
 
 
