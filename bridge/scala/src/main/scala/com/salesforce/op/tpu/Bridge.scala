@@ -1,0 +1,176 @@
+/*
+ * Scala facade of the transmogrifai_tpu bridge.
+ *
+ * Keeps the reference's user surface — OpWorkflow().train() / model.score()
+ * / model.save() / OpWorkflow.loadModel() (reference
+ * core/src/main/scala/com/salesforce/op/OpWorkflow.scala:61,347 and
+ * OpWorkflowModel.scala:261,224) — while the execution substrate is the
+ * Python/JAX runtime on TPU, reached over a socket protocol:
+ *   frame = [1 byte kind 'J'|'A'][4-byte big-endian length][payload]
+ *   'J' = UTF-8 JSON control, 'A' = Arrow IPC stream bytes.
+ * See transmogrifai_tpu/bridge/protocol.py for the op catalogue.
+ *
+ * Dependencies: org.apache.arrow:arrow-vector + arrow-memory-netty (Arrow
+ * IPC), and any JSON library (org.json used here for zero transitive deps).
+ */
+package com.salesforce.op.tpu
+
+import java.io.{ByteArrayInputStream, ByteArrayOutputStream, DataInputStream, DataOutputStream}
+import java.net.Socket
+import java.nio.channels.Channels
+import java.nio.charset.StandardCharsets.UTF_8
+
+import org.apache.arrow.memory.RootAllocator
+import org.apache.arrow.vector.VectorSchemaRoot
+import org.apache.arrow.vector.ipc.{ArrowStreamReader, ArrowStreamWriter}
+import org.json.JSONObject
+
+/** One TCP session with the Python/JAX runtime. */
+final class BridgeConnection(host: String, port: Int) extends AutoCloseable {
+  private val socket = new Socket(host, port)
+  private val in = new DataInputStream(socket.getInputStream)
+  private val out = new DataOutputStream(socket.getOutputStream)
+  private val allocator = new RootAllocator(Long.MaxValue)
+
+  private def sendFrame(kind: Byte, payload: Array[Byte]): Unit = {
+    out.writeByte(kind)
+    out.writeInt(payload.length)
+    out.write(payload)
+    out.flush()
+  }
+
+  def sendJson(obj: JSONObject): Unit =
+    sendFrame('J'.toByte, obj.toString.getBytes(UTF_8))
+
+  def sendArrow(root: VectorSchemaRoot): Unit = {
+    val buf = new ByteArrayOutputStream()
+    val writer = new ArrowStreamWriter(root, null, Channels.newChannel(buf))
+    writer.start(); writer.writeBatch(); writer.end(); writer.close()
+    sendFrame('A'.toByte, buf.toByteArray)
+  }
+
+  private def readFrame(): (Byte, Array[Byte]) = {
+    val kind = in.readByte()
+    val len = in.readInt()
+    val payload = new Array[Byte](len)
+    in.readFully(payload)
+    (kind, payload)
+  }
+
+  def recvJson(): JSONObject = {
+    val (kind, payload) = readFrame()
+    require(kind == 'J'.toByte, s"expected JSON frame, got $kind")
+    val resp = new JSONObject(new String(payload, UTF_8))
+    if (!resp.optBoolean("ok", false))
+      throw new BridgeException(resp.optString("error", "bridge error"))
+    resp
+  }
+
+  /** An op that returns data sends one Arrow frame, then its JSON status. */
+  def recvArrowThenJson(): (VectorSchemaRoot, JSONObject) = {
+    val (kind, payload) = readFrame()
+    if (kind == 'J'.toByte) { // error instead of data
+      val resp = new JSONObject(new String(payload, UTF_8))
+      throw new BridgeException(resp.optString("error", "bridge error"))
+    }
+    val reader = new ArrowStreamReader(new ByteArrayInputStream(payload), allocator)
+    reader.loadNextBatch()
+    val root = reader.getVectorSchemaRoot
+    (root, recvJson())
+  }
+
+  def call(op: String, fields: (String, Any)*): JSONObject = {
+    val req = new JSONObject().put("op", op)
+    fields.foreach { case (k, v) => req.put(k, v) }
+    sendJson(req)
+    recvJson()
+  }
+
+  override def close(): Unit = {
+    try { sendJson(new JSONObject().put("op", "shutdown")); recvJson() }
+    catch { case _: Exception => () }
+    socket.close()
+  }
+}
+
+final class BridgeException(msg: String) extends RuntimeException(msg)
+
+object BridgeConnection {
+  def apply(host: String = "127.0.0.1", port: Int = 7099): BridgeConnection =
+    new BridgeConnection(host, port)
+}
+
+/**
+ * Signature-compatible slice of the reference OpWorkflow
+ * (OpWorkflow.scala:61): set input data + result features, then train().
+ * Feature DAG definition crosses the bridge as a declarative JSON spec
+ * (transmogrifai_tpu/bridge/spec.py) instead of closure-capturing
+ * FeatureBuilders — the Python runtime reconstructs the typed DAG.
+ */
+final class OpWorkflow(conn: BridgeConnection, name: String = "wf") {
+  private var dataName: Option[String] = None
+  private var keyCol: Option[String] = None
+  private var built = false
+
+  /** Ship a dataset (Arrow) to the runtime under a name. */
+  def setInputDataset(root: VectorSchemaRoot, key: String = null,
+                      dataset: String = "train"): OpWorkflow = {
+    conn.sendArrow(root)
+    conn.call("put_data", "name" -> dataset)
+    dataName = Some(dataset)
+    keyCol = Option(key)
+    this
+  }
+
+  /** Declarative workflow spec: features + stages + result names. */
+  def setWorkflowSpec(spec: JSONObject): OpWorkflow = {
+    conn.sendJson(new JSONObject().put("op", "build").put("name", name).put("spec", spec))
+    conn.recvJson()
+    built = true
+    this
+  }
+
+  /** The reference entrypoint (OpWorkflow.train(), OpWorkflow.scala:347). */
+  def train(modelName: String = "model"): OpWorkflowModel = {
+    require(built, "setWorkflowSpec must be called before train()")
+    val data = dataName.getOrElse(throw new IllegalStateException(
+      "setInputDataset must be called before train()"))
+    val fields = Seq("workflow" -> name, "data" -> data, "model" -> modelName) ++
+      keyCol.map("key" -> _)
+    conn.call("train", fields: _*)
+    new OpWorkflowModel(conn, modelName)
+  }
+}
+
+object OpWorkflow {
+  /** OpWorkflow.loadModel analog (OpWorkflow.scala:483). */
+  def loadModel(conn: BridgeConnection, path: String,
+                modelName: String = "model"): OpWorkflowModel = {
+    conn.call("load", "path" -> path, "model" -> modelName)
+    new OpWorkflowModel(conn, modelName)
+  }
+}
+
+/** Fitted-workflow handle (OpWorkflowModel.scala:60). */
+final class OpWorkflowModel(conn: BridgeConnection, name: String) {
+  /** Batch scoring (OpWorkflowModel.score, :261): Arrow in, Arrow out. */
+  def score(root: VectorSchemaRoot, dataset: String = "score"): VectorSchemaRoot = {
+    conn.sendArrow(root)
+    conn.call("put_data", "name" -> dataset)
+    conn.sendJson(new JSONObject().put("op", "score").put("model", name).put("data", dataset))
+    conn.recvArrowThenJson()._1
+  }
+
+  /** scoreAndEvaluate analog (:298). */
+  def evaluate(dataset: String, labelCol: String,
+               evaluator: String = "binary"): JSONObject =
+    conn.call("evaluate", "model" -> name, "data" -> dataset,
+              "label" -> labelCol, "evaluator" -> evaluator)
+      .getJSONObject("metrics")
+
+  /** Model persistence on the runtime side (OpWorkflowModel.save, :224). */
+  def save(path: String): Unit = conn.call("save", "model" -> name, "path" -> path)
+
+  /** ModelSelector summary (summaryJson analog, :199). */
+  def summary(): JSONObject = conn.call("summary", "model" -> name)
+}

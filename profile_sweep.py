@@ -1,0 +1,49 @@
+"""Per-family wall-clock profile of the Titanic default sweep (dev tool)."""
+import time
+
+import numpy as np
+
+from bench import init_backend, titanic_arrays
+
+platform, fb = init_backend()
+print("platform:", platform, fb)
+
+from transmogrifai_tpu.evaluators.classification import OpBinaryClassificationEvaluator
+from transmogrifai_tpu.impl.classification.logistic import OpLogisticRegression
+from transmogrifai_tpu.impl.classification.trees import (
+    OpRandomForestClassifier, OpXGBoostClassifier)
+from transmogrifai_tpu.impl.selector import defaults as D
+from transmogrifai_tpu.impl.tuning.validators import OpCrossValidation
+
+X, y = titanic_arrays()
+print("X", X.shape)
+
+ev = OpBinaryClassificationEvaluator()
+
+
+def timed(name, candidates, reps=3):
+    cv = OpCrossValidation(ev, num_folds=3, seed=42)
+    t0 = time.perf_counter()
+    cv.validate(candidates, X, y)
+    warm = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for r in range(reps):
+        cv = OpCrossValidation(ev, num_folds=3, seed=100 + r)
+        cv.validate(candidates, X, y)
+    dt = (time.perf_counter() - t0) / reps
+    n = sum(len(g) for _, g in candidates)
+    print(f"{name:30s} grids={n:3d} warm={warm:7.2f}s steady={dt:7.3f}s"
+          f"  ({3*n/dt:8.1f} models/s)")
+    return dt
+
+
+rf = D.random_forest_grid()
+by_depth = {}
+for g in rf:
+    by_depth.setdefault(g["max_depth"], []).append(g)
+
+timed("LR x8", [(OpLogisticRegression(), D.logistic_regression_grid())])
+for dep, gs in sorted(by_depth.items()):
+    timed(f"RF depth={dep} x{len(gs)}", [(OpRandomForestClassifier(), gs)])
+timed("RF all x18", [(OpRandomForestClassifier(), rf)])
+timed("XGB x2", [(OpXGBoostClassifier(), D.xgboost_grid())])

@@ -1,0 +1,23 @@
+import numpy as np
+from bench import init_backend
+init_backend()
+import jax, jax.numpy as jnp
+from transmogrifai_tpu.ops import trees as Tr
+
+n, d = 891, 24
+rng = np.random.default_rng(0)
+X = rng.normal(size=(n, d)).astype(np.float32)
+y = (rng.random(n) < 0.4).astype(np.float32)
+Xb, _ = Tr.quantize(X, 32)
+G = -y[:, None]; H = np.ones(n, np.float32)
+TT = 900
+wt = rng.poisson(1.0, size=(TT, n)).astype(np.float32)
+fm = (rng.random((TT, d)) < 0.3).astype(np.float32)
+mcw = np.full(TT, 10.0, np.float32)
+a = [jnp.asarray(v) for v in (Xb, G, H, wt, fm, mcw)]
+def run():
+    return Tr.fit_forest_chunked(*a, max_depth=12, n_bins=32, chunk=TT, frontier=128)
+jax.block_until_ready(run())
+with jax.profiler.trace("/tmp/jaxtrace"):
+    jax.block_until_ready(run())
+print("trace done")

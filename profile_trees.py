@@ -1,0 +1,102 @@
+"""Micro-bench of the RF/GBT kernels at the Titanic hot shapes (dev tool)."""
+import os
+import sys
+import time
+
+import numpy as np
+
+from bench import init_backend
+
+init_backend()
+import jax
+import jax.numpy as jnp
+
+from transmogrifai_tpu.ops import trees as Tr
+
+n, d = 891, 24
+rng = np.random.default_rng(0)
+X = rng.normal(size=(n, d)).astype(np.float32)
+y = (rng.random(n) < 0.4).astype(np.float32)
+Xb, edges = Tr.quantize(X, 32)
+G = -y[:, None]
+H = np.ones(n, np.float32)
+
+
+def t(fn, reps=3):
+    fn()  # compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        jax.block_until_ready(fn())
+    return (time.perf_counter() - t0) / reps
+
+
+def rf_case(TT, depth, frontier, chunk, label):
+    wt = rng.poisson(1.0, size=(TT, n)).astype(np.float32)
+    fm = (rng.random((TT, d)) < 0.3).astype(np.float32)
+    mcw = np.full(TT, 10.0, np.float32)
+    Xb_d, G_d, H_d = jnp.asarray(Xb), jnp.asarray(G), jnp.asarray(H)
+    wt_d, fm_d, mcw_d = jnp.asarray(wt), jnp.asarray(fm), jnp.asarray(mcw)
+
+    def run():
+        return Tr.fit_forest_chunked(Xb_d, G_d, H_d, wt_d, fm_d, mcw_d,
+                                     max_depth=depth, n_bins=32, chunk=chunk,
+                                     frontier=frontier)
+
+    dt = t(run)
+    print(f"{label:44s} {dt*1e3:9.1f} ms")
+    return dt
+
+
+# depth-12 group, as in the sweep: TT=1080 after pad, chunk=?
+from transmogrifai_tpu.ops.trees import forest_chunk_size
+for depth, frontier in ((3, 8), (6, 64), (12, 128)):
+    cs = forest_chunk_size(depth, 32, d, 1, frontier)
+    TT = 900
+    chunk = min(cs, TT)
+    TTp = TT + ((-TT) % chunk)
+    rf_case(TTp, depth, frontier, chunk, f"RF d={depth} M={frontier} TT={TTp} chunk={chunk}")
+
+# depth 12 variants
+rf_case(900, 12, 128, 900, "RF d=12 M=128 one chunk of 900")
+rf_case(900, 12, 128, 300, "RF d=12 M=128 chunk=300")
+rf_case(896, 12, 128, 128, "RF d=12 M=128 chunk=128")
+
+os.environ["TMOG_HIST_MATMUL"] = "0"
+rf_case(900, 12, 128, 900, "RF d=12 segsum one chunk")
+os.environ.pop("TMOG_HIST_MATMUL")
+
+# XGB shape: batch 6, 200 rounds, depth 10, frontier 64
+B = 6
+rw = np.ones((200, n), np.float32)
+fms = np.ones((200, d), np.float32)
+w_batch = jnp.asarray(np.ones((B, n), np.float32))
+eta_b = jnp.full(B, 0.02)
+lam_b = jnp.full(B, 1.0)
+gam_b = jnp.full(B, 0.8)
+mcw_b = jnp.full(B, 1.0)
+
+def xgb():
+    return Tr.fit_gbt_batch(jnp.asarray(Xb), jnp.asarray(y), w_batch,
+                            jnp.asarray(rw), jnp.asarray(fms), loss="logistic",
+                            n_rounds=200, max_depth=10, n_bins=32, frontier=64,
+                            eta_b=eta_b, reg_lambda_b=lam_b, gamma_b=gam_b,
+                            min_child_weight_b=mcw_b)
+
+print(f"{'XGB batch=6 rounds=200 d=10 M=64':44s} {t(xgb)*1e3:9.1f} ms")
+
+def xgb20():
+    return Tr.fit_gbt_batch(jnp.asarray(Xb), jnp.asarray(y), w_batch,
+                            jnp.asarray(rw)[:20], jnp.asarray(fms)[:20],
+                            loss="logistic",
+                            n_rounds=20, max_depth=10, n_bins=32, frontier=64,
+                            eta_b=eta_b, reg_lambda_b=lam_b, gamma_b=gam_b,
+                            min_child_weight_b=mcw_b)
+
+print(f"{'XGB batch=6 rounds=20 d=10 M=64':44s} {t(xgb20)*1e3:9.1f} ms")
+
+def xgb_d5():
+    return Tr.fit_gbt_batch(jnp.asarray(Xb), jnp.asarray(y), w_batch,
+                            jnp.asarray(rw), jnp.asarray(fms), loss="logistic",
+                            n_rounds=200, max_depth=5, n_bins=32, frontier=32,
+                            eta_b=eta_b, reg_lambda_b=lam_b, gamma_b=gam_b,
+                            min_child_weight_b=mcw_b)

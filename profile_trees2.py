@@ -1,0 +1,41 @@
+import os, time
+import numpy as np
+from bench import init_backend
+init_backend()
+import jax, jax.numpy as jnp
+from transmogrifai_tpu.ops import trees as Tr
+
+n, d = 891, 24
+rng = np.random.default_rng(0)
+X = rng.normal(size=(n, d)).astype(np.float32)
+y = (rng.random(n) < 0.4).astype(np.float32)
+Xb, edges = Tr.quantize(X, 32)
+G = -y[:, None]; H = np.ones(n, np.float32)
+
+def t(fn, reps=3):
+    fn()
+    t0 = time.perf_counter()
+    for _ in range(reps): jax.block_until_ready(fn())
+    return (time.perf_counter() - t0) / reps
+
+def rf_case(TT, depth, frontier, chunk, label, env=None):
+    if env:
+        for k, v in env.items(): os.environ[k] = v
+    wt = rng.poisson(1.0, size=(TT, n)).astype(np.float32)
+    fm = (rng.random((TT, d)) < 0.3).astype(np.float32)
+    mcw = np.full(TT, 10.0, np.float32)
+    a = [jnp.asarray(v) for v in (Xb, G, H, wt, fm, mcw)]
+    def run():
+        return Tr.fit_forest_chunked(*a, max_depth=depth, n_bins=32,
+                                     chunk=chunk, frontier=frontier)
+    dt = t(run)
+    print(f"{label:48s} {dt*1e3:9.1f} ms")
+    if env:
+        for k in env: os.environ.pop(k)
+
+rf_case(900, 12, 128, 900, "RF d=12 M=128 (bf16 mm)")
+rf_case(900, 12, 128, 900, "RF d=12 M=128 f32 mm", {"TMOG_HIST_BF16": "0"})
+rf_case(900, 12, 64, 900,  "RF d=12 M=64 beam")
+rf_case(900, 12, 32, 900,  "RF d=12 M=32 beam")
+rf_case(900, 8, 128, 900,  "RF d=8 M=128")
+rf_case(112, 12, 128, 112, "RF d=12 M=128 TT=112")

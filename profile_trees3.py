@@ -1,0 +1,49 @@
+import os, time, sys
+import numpy as np
+from bench import init_backend
+init_backend()
+import jax, jax.numpy as jnp
+from transmogrifai_tpu.ops import trees as Tr
+
+n, d = 891, 24
+rng = np.random.default_rng(0)
+X = rng.normal(size=(n, d)).astype(np.float32)
+y = (rng.random(n) < 0.4).astype(np.float32)
+Xb, _ = Tr.quantize(X, 32)
+G = -y[:, None]; H = np.ones(n, np.float32)
+
+def t(fn, reps=6):
+    fn()
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        ts.append(time.perf_counter() - t0)
+    return min(ts), float(np.median(ts))
+
+def rf_case(TT, depth, frontier, chunk, label):
+    wt = rng.poisson(1.0, size=(TT, n)).astype(np.float32)
+    fm = (rng.random((TT, d)) < 0.3).astype(np.float32)
+    mcw = np.full(TT, 10.0, np.float32)
+    a = [jnp.asarray(v) for v in (Xb, G, H, wt, fm, mcw)]
+    def run():
+        return Tr.fit_forest_chunked(*a, max_depth=depth, n_bins=32,
+                                     chunk=chunk, frontier=frontier)
+    mn, md = t(run)
+    print(f"{label:44s} min {mn*1e3:8.1f}  med {md*1e3:8.1f} ms")
+
+rf_case(900, 3, 8, 900,    "RF d=3  M=8   TT=900")
+rf_case(900, 6, 64, 900,   "RF d=6  M=64  TT=900")
+rf_case(900, 12, 128, 900, "RF d=12 M=128 TT=900")
+
+B = 6
+rw = np.ones((200, n), np.float32)
+fms = np.ones((200, d), np.float32)
+args = dict(loss="logistic", n_rounds=200, max_depth=10, n_bins=32, frontier=64,
+            eta_b=jnp.full(B, 0.02), reg_lambda_b=jnp.full(B, 1.0),
+            gamma_b=jnp.full(B, 0.8), min_child_weight_b=jnp.full(B, 1.0))
+xa = [jnp.asarray(v) for v in (Xb, y, np.ones((B, n), np.float32), rw, fms)]
+def xgb():
+    return Tr.fit_gbt_batch(xa[0], xa[1], xa[2], xa[3], xa[4], **args)
+mn, md = t(xgb)
+print(f"{'XGB batch=6 rounds=200 d=10 M=64':44s} min {mn*1e3:8.1f}  med {md*1e3:8.1f} ms")

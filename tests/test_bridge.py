@@ -1,0 +1,115 @@
+"""JVM<->JAX bridge round-trip (VERDICT r3 missing #1 / next #5).
+
+Spins the real socket server in a thread and drives the full facade
+sequence the Scala client performs: put_data (Arrow) -> build (declarative
+spec) -> train -> score (Arrow back) -> evaluate -> save -> load ->
+re-score parity.
+"""
+import socket
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+
+from transmogrifai_tpu.bridge.client import BridgeClient
+from transmogrifai_tpu.bridge.server import serve
+
+
+@pytest.fixture(scope="module")
+def bridge_port():
+    ready = threading.Event()
+    t = threading.Thread(target=serve, kwargs={"port": 0, "ready": ready},
+                         daemon=True)
+    t.start()
+    assert ready.wait(10)
+    yield ready.port  # type: ignore[attr-defined]
+
+
+def _df(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    sex = rng.choice(["m", "f"], n)
+    y = ((x1 + (sex == "m") + rng.normal(scale=0.5, size=n)) > 0.5).astype(float)
+    return pd.DataFrame({"label": y, "x1": x1, "sex": sex})
+
+
+SPEC = {
+    "features": [
+        {"name": "label", "type": "RealNN", "response": True},
+        {"name": "x1", "type": "Real"},
+        {"name": "sex", "type": "PickList"},
+    ],
+    "stages": [
+        {"cls": "impl.feature.vectorizers.RealVectorizer",
+         "params": {}, "inputs": ["x1"], "name": "nums"},
+        {"cls": "impl.feature.vectorizers.OneHotVectorizer",
+         "params": {"top_k": 5, "min_support": 1}, "inputs": ["sex"],
+         "name": "cats"},
+        {"cls": "impl.feature.vectorizers.VectorsCombiner",
+         "params": {}, "inputs": ["nums", "cats"], "name": "vec"},
+        {"cls": "impl.classification.logistic.OpLogisticRegression",
+         "params": {"reg_param": 0.01}, "inputs": ["label", "vec"],
+         "name": "pred"},
+    ],
+    "result": ["pred"],
+}
+
+
+def test_bridge_train_score_save_load_roundtrip(bridge_port, tmp_path):
+    c = BridgeClient(port=bridge_port)
+    info = c.ping()
+    assert info["devices"] >= 1
+
+    df = _df()
+    r = c.put_data("train", df)
+    assert r["rows"] == len(df)
+    b = c.build(SPEC)
+    assert b["resultFeatures"]
+    tr = c.train("train")
+    pred_name = tr["resultFeatures"][0]
+
+    scores = c.score("train")
+    pcol = f"{pred_name}.prediction"
+    assert pcol in scores.column_names
+    preds = np.asarray(scores[pcol])
+    assert preds.shape[0] == len(df)
+    acc = float((preds == df["label"].to_numpy()).mean())
+    assert acc > 0.8, acc
+
+    m = c.evaluate("train", label="label")
+    assert m["AuROC"] > 0.8
+
+    # persistence round trip through the bridge
+    path = str(tmp_path / "bridged_model")
+    c.save(path)
+    c.load(path, model="model2")
+    scores2 = c.score("train", model="model2")
+    np.testing.assert_array_equal(np.asarray(scores2[pcol]), preds)
+    c.close()
+
+
+def test_bridge_error_paths(bridge_port):
+    c = BridgeClient(port=bridge_port)
+    with pytest.raises(RuntimeError, match="unknown op"):
+        c._call({"op": "no_such_op"})
+    with pytest.raises(RuntimeError, match="KeyError"):
+        c.train("never_uploaded")
+    # spec safety: absolute class paths outside the package are rejected
+    with pytest.raises(RuntimeError):
+        c.build({"features": [], "result": [],
+                 "stages": [{"cls": "os.system", "inputs": [], "name": "x"}]})
+    c.close()
+
+
+def test_bridge_rejects_oversized_frame(bridge_port):
+    s = socket.create_connection(("127.0.0.1", bridge_port))
+    # a malformed giant header must not allocate; server drops the session
+    s.sendall(b"J" + (0x7FFFFFFF + 1).to_bytes(4, "big"))
+    s.close()
+    # server must still serve new sessions afterwards
+    c = BridgeClient(port=bridge_port)
+    assert c.ping()["devices"] >= 1
+    c.close()

@@ -1,0 +1,11 @@
+"""JVM <-> JAX bridge: drive the TPU runtime from the Scala OpWorkflow facade.
+
+North star (BASELINE.json): the reference's Scala entrypoint
+``OpWorkflow().train()`` (OpWorkflow.scala:61,347) drives a TPU pod through
+this bridge — Arrow IPC data frames + JSON control frames over TCP.  The JVM
+half lives in ``bridge/scala/``; ``client.py`` is its tested Python twin.
+"""
+from .client import BridgeClient
+from .server import serve
+
+__all__ = ["BridgeClient", "serve"]

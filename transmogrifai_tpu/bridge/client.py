@@ -1,0 +1,87 @@
+"""Python bridge client — mirrors the Scala facade 1:1 (and tests it).
+
+The Scala source under ``bridge/scala/`` implements exactly this sequence
+with ``org.apache.arrow.vector`` + ``java.net.Socket``; keeping a Python
+twin means the protocol is covered by tests/test_bridge.py even though this
+image has no JVM to compile the Scala half.
+"""
+from __future__ import annotations
+
+import socket
+from typing import Any, Dict, Optional
+
+from . import protocol as P
+
+
+class BridgeClient:
+    def __init__(self, host: str = "127.0.0.1", port: int = 7099):
+        self.sock = socket.create_connection((host, port))
+
+    # ---- plumbing -----------------------------------------------------------
+    def _call(self, req: Dict[str, Any], table=None, expect_arrow: bool = False):
+        if table is not None:
+            P.send_arrow(self.sock, table)
+        P.send_json(self.sock, req)
+        result_table = None
+        if expect_arrow:
+            kind, payload = P.recv_frame(self.sock)
+            if kind == P.KIND_ARROW:
+                result_table = P.parse_arrow(payload)
+                resp = P.recv_json(self.sock)
+            else:  # error came back instead of data
+                import json as _json
+
+                resp = _json.loads(payload.decode("utf-8"))
+        else:
+            resp = P.recv_json(self.sock)
+        if not resp.get("ok"):
+            raise RuntimeError(f"bridge error: {resp.get('error')}\n"
+                               f"{resp.get('traceback', '')}")
+        return (resp, result_table) if expect_arrow else resp
+
+    # ---- the OpWorkflow facade surface --------------------------------------
+    def ping(self) -> Dict[str, Any]:
+        return self._call({"op": "ping"})
+
+    def put_data(self, name: str, df) -> Dict[str, Any]:
+        import pyarrow as pa
+
+        return self._call({"op": "put_data", "name": name},
+                          table=pa.Table.from_pandas(df))
+
+    def build(self, spec: Dict[str, Any], name: str = "wf") -> Dict[str, Any]:
+        return self._call({"op": "build", "name": name, "spec": spec})
+
+    def train(self, data: str, workflow: str = "wf", model: str = "model",
+              key: Optional[str] = None) -> Dict[str, Any]:
+        req = {"op": "train", "workflow": workflow, "data": data, "model": model}
+        if key:
+            req["key"] = key
+        return self._call(req)
+
+    def score(self, data: str, model: str = "model"):
+        resp, table = self._call({"op": "score", "model": model, "data": data},
+                                 expect_arrow=True)
+        return table
+
+    def evaluate(self, data: str, label: str, model: str = "model",
+                 evaluator: str = "binary") -> Dict[str, float]:
+        return self._call({"op": "evaluate", "model": model, "data": data,
+                           "label": label, "evaluator": evaluator})["metrics"]
+
+    def save(self, path: str, model: str = "model") -> None:
+        self._call({"op": "save", "model": model, "path": path})
+
+    def load(self, path: str, model: str = "model") -> None:
+        self._call({"op": "load", "model": model, "path": path})
+
+    def summary(self, model: str = "model") -> Dict[str, Any]:
+        return self._call({"op": "summary", "model": model})["summary"]
+
+    def shutdown(self) -> None:
+        P.send_json(self.sock, {"op": "shutdown"})
+        P.recv_json(self.sock)
+        self.sock.close()
+
+    def close(self) -> None:
+        self.sock.close()

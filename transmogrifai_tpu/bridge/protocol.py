@@ -1,0 +1,93 @@
+"""Wire protocol of the JVM <-> JAX bridge (BASELINE north star).
+
+The reference's user entrypoint is the Scala ``OpWorkflow().train()``
+(core/src/main/scala/com/salesforce/op/OpWorkflow.scala:61,347).  To drive
+this TPU runtime from that surface WITHOUT Spark in the loop, the bridge
+speaks a deliberately boring protocol any JVM (or C++) client can implement
+with zero exotic dependencies:
+
+- transport: one TCP connection per session,
+- framing: every message is ``[1-byte kind][4-byte big-endian length][payload]``,
+  - kind ``J``: UTF-8 JSON control message ``{"op": ..., ...}``,
+  - kind ``A``: Arrow IPC *stream* bytes (the lingua franca between JVM
+    ``org.apache.arrow.vector`` and Python ``pyarrow``),
+- every request gets exactly one JSON response frame (``{"ok": true, ...}``
+  or ``{"ok": false, "error": ...}``), optionally preceded by one Arrow
+  frame when the op returns data (``score``/``compute``).
+
+Ops (mirroring OpWorkflowRunner's run types, OpWorkflowRunner.scala:358):
+
+  put_data    {name}                + Arrow frame    -> stores a dataset
+  build       {spec}                                 -> materialize workflow
+  train       {workflow}                             -> fit, returns summary
+  score       {model, data}                          -> Arrow frame + json
+  evaluate    {model, data, evaluator}               -> metrics json
+  save        {model, path} / load {path}            -> model persistence
+  summary     {model}                                -> ModelSelector summary
+  shutdown    {}                                     -> server exits
+
+The workflow ``spec`` is declarative (no pickled closures — SURVEY §7
+"Serialization" hard part): features by (name, type, field, response) and
+stages by (class path, params, input feature names); see bridge/spec.py.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Any, Dict, Optional, Tuple
+
+KIND_JSON = b"J"
+KIND_ARROW = b"A"
+
+_HEADER = struct.Struct(">cI")
+MAX_FRAME = 1 << 31
+
+
+def send_frame(sock: socket.socket, kind: bytes, payload: bytes) -> None:
+    sock.sendall(_HEADER.pack(kind, len(payload)))
+    sock.sendall(payload)
+
+
+def send_json(sock: socket.socket, obj: Dict[str, Any]) -> None:
+    send_frame(sock, KIND_JSON, json.dumps(obj).encode("utf-8"))
+
+
+def send_arrow(sock: socket.socket, table) -> None:
+    import pyarrow as pa
+
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    send_frame(sock, KIND_ARROW, sink.getvalue().to_pybytes())
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("bridge peer closed the connection")
+        buf += chunk
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket) -> Tuple[bytes, bytes]:
+    kind, length = _HEADER.unpack(_read_exact(sock, _HEADER.size))
+    if length > MAX_FRAME:
+        raise ValueError(f"frame of {length} bytes exceeds MAX_FRAME")
+    return kind, _read_exact(sock, length)
+
+
+def recv_json(sock: socket.socket) -> Dict[str, Any]:
+    kind, payload = recv_frame(sock)
+    if kind != KIND_JSON:
+        raise ValueError(f"expected JSON frame, got {kind!r}")
+    return json.loads(payload.decode("utf-8"))
+
+
+def parse_arrow(payload: bytes):
+    import pyarrow as pa
+
+    with pa.ipc.open_stream(pa.BufferReader(payload)) as r:
+        return r.read_all()

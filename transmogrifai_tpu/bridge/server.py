@@ -1,0 +1,197 @@
+"""Bridge server: drives the TPU runtime for a JVM (or any) client.
+
+One session per connection; state is per-connection (datasets, workflows,
+fitted models).  The server is the TPU-side half of the north-star picture
+(BASELINE.json): the Scala ``OpWorkflow.train()`` facade in
+``bridge/scala/`` connects here, ships data as Arrow, and drives
+train/score/save/load — no Spark, no JVM on this side.
+
+Run standalone:  ``python -m transmogrifai_tpu.bridge.server --port 7099``
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import socket
+import threading
+import traceback
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from . import protocol as P
+
+log = logging.getLogger(__name__)
+
+
+class BridgeSession:
+    """Per-connection state + op dispatch."""
+
+    def __init__(self):
+        self.datasets: Dict[str, Any] = {}     # name -> pandas.DataFrame
+        self.workflows: Dict[str, Any] = {}    # name -> OpWorkflow
+        self.models: Dict[str, Any] = {}       # name -> OpWorkflowModel
+        self.result_names: Dict[str, list] = {}
+
+    # ---- ops ---------------------------------------------------------------
+    def op_put_data(self, req, arrow_table):
+        if arrow_table is None:
+            raise ValueError("put_data requires an Arrow frame")
+        self.datasets[req["name"]] = arrow_table.to_pandas()
+        return {"rows": arrow_table.num_rows, "cols": arrow_table.num_columns}
+
+    def op_build(self, req, _):
+        from .spec import build_workflow
+
+        wf = build_workflow(req["spec"])
+        name = req.get("name", "wf")
+        self.workflows[name] = wf
+        self.result_names[name] = [f.name for f in wf.result_features]
+        return {"workflow": name, "resultFeatures": self.result_names[name]}
+
+    def op_train(self, req, _):
+        wf = self.workflows[req.get("workflow", "wf")]
+        df = self.datasets[req["data"]]
+        key = req.get("key")
+        wf.set_input_dataset(df, key=key) if key else wf.set_input_dataset(df)
+        model = wf.train()
+        name = req.get("model", "model")
+        self.models[name] = model
+        return {"model": name,
+                "resultFeatures": [f.name for f in model.result_features]}
+
+    def _scores_table(self, model, df):
+        import pyarrow as pa
+
+        scored = model.score(df)
+        cols: Dict[str, Any] = {}
+        for f in model.result_features:
+            col = scored[f.name]
+            if hasattr(col, "prediction"):  # Prediction triple
+                cols[f"{f.name}.prediction"] = np.asarray(col.prediction,
+                                                          np.float64)
+                prob = getattr(col, "probability", None)
+                if prob is not None:
+                    p = np.asarray(prob, np.float64)
+                    for j in range(p.shape[1]):
+                        cols[f"{f.name}.probability_{j}"] = p[:, j]
+            elif hasattr(col, "mask"):
+                cols[f.name] = np.where(col.mask, col.values, np.nan)
+            else:
+                cols[f.name] = np.asarray(col.values)
+        return pa.table(cols)
+
+    def op_score(self, req, _):
+        model = self.models[req.get("model", "model")]
+        df = self.datasets[req["data"]]
+        return {"rows": len(df)}, self._scores_table(model, df)
+
+    def op_evaluate(self, req, _):
+        from ..evaluators import (OpBinaryClassificationEvaluator,
+                                  OpMultiClassificationEvaluator,
+                                  OpRegressionEvaluator)
+
+        model = self.models[req.get("model", "model")]
+        kind = req.get("evaluator", "binary")
+        pred_name = model.result_features[0].name
+        ev = {"binary": OpBinaryClassificationEvaluator,
+              "multiclass": OpMultiClassificationEvaluator,
+              "regression": OpRegressionEvaluator}[kind](
+            label_col=req["label"], prediction_col=pred_name)
+        metrics = model.evaluate(ev)
+        return {"metrics": {k: v for k, v in metrics.items()
+                            if isinstance(v, (int, float, str))}}
+
+    def op_save(self, req, _):
+        self.models[req.get("model", "model")].save(req["path"])
+        return {"path": req["path"]}
+
+    def op_load(self, req, _):
+        from ..workflow.model import OpWorkflowModel
+
+        model = OpWorkflowModel.load(req["path"])
+        name = req.get("model", "model")
+        self.models[name] = model
+        return {"model": name}
+
+    def op_summary(self, req, _):
+        model = self.models[req.get("model", "model")]
+        return {"summary": model.summary()}
+
+    def op_ping(self, req, _):
+        import jax
+
+        return {"backend": jax.default_backend(),
+                "devices": len(jax.devices())}
+
+
+def _handle_connection(conn: socket.socket) -> bool:
+    """Serve one session; returns True if a shutdown was requested."""
+    session = BridgeSession()
+    pending_arrow = None
+    with conn:
+        while True:
+            try:
+                kind, payload = P.recv_frame(conn)
+            except (ConnectionError, OSError):
+                return False
+            if kind == P.KIND_ARROW:
+                pending_arrow = P.parse_arrow(payload)
+                continue
+            req = __import__("json").loads(payload.decode("utf-8"))
+            op = req.get("op", "")
+            if op == "shutdown":
+                P.send_json(conn, {"ok": True})
+                return True
+            handler = getattr(session, f"op_{op}", None)
+            if handler is None:
+                P.send_json(conn, {"ok": False, "error": f"unknown op {op!r}"})
+                pending_arrow = None
+                continue
+            try:
+                out = handler(req, pending_arrow)
+                if isinstance(out, tuple):  # (json, arrow) response pair
+                    resp, table = out
+                    P.send_arrow(conn, table)
+                else:
+                    resp = out
+                P.send_json(conn, {"ok": True, **(resp or {})})
+            except Exception as e:  # surface the error to the client
+                log.warning("bridge op %s failed: %s", op, e)
+                P.send_json(conn, {"ok": False, "error": f"{type(e).__name__}: {e}",
+                                   "traceback": traceback.format_exc(limit=8)})
+            pending_arrow = None
+
+
+def serve(host: str = "127.0.0.1", port: int = 7099,
+          ready: Optional[threading.Event] = None) -> int:
+    """Accept loop; returns the bound port (0 requests an ephemeral port)."""
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((host, port))
+    srv.listen(4)
+    bound = srv.getsockname()[1]
+    if ready is not None:
+        ready.port = bound  # type: ignore[attr-defined]
+        ready.set()
+    log.info("bridge listening on %s:%d", host, bound)
+    try:
+        while True:
+            conn, _ = srv.accept()
+            if _handle_connection(conn):
+                return bound
+    finally:
+        srv.close()
+
+
+def main():
+    ap = argparse.ArgumentParser(description="transmogrifai_tpu bridge server")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=7099)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    serve(args.host, args.port)
+
+
+if __name__ == "__main__":
+    main()

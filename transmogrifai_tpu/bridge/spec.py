@@ -1,0 +1,81 @@
+"""Declarative workflow spec -> OpWorkflow (the bridge's no-closures IR).
+
+The Scala facade cannot ship Python lambdas, so a workflow crosses the
+bridge as data (the reference has the same constraint between driver and
+executors and solves it with closure serialization; we solve it by making
+the spec DECLARATIVE — SURVEY §7 "Serialization" hard part):
+
+```json
+{
+  "features": [
+    {"name": "survived", "type": "RealNN", "field": "survived", "response": true},
+    {"name": "age", "type": "Real", "field": "age"}
+  ],
+  "stages": [
+    {"cls": "impl.feature.vectorizers.RealVectorizer",
+     "params": {"fill_with_mean": true}, "inputs": ["age"], "name": "nums"},
+    {"cls": "impl.selector.factories.BinaryClassificationModelSelector",
+     "factory": "with_cross_validation", "params": {"num_folds": 3},
+     "inputs": ["survived", "nums"], "name": "pred"}
+  ],
+  "result": ["pred"]
+}
+```
+
+``cls`` is resolved inside the ``transmogrifai_tpu`` package (absolute
+dotted paths are rejected unless they stay inside the package — the bridge
+must not be a remote-code-execution service); ``factory`` optionally names
+a classmethod constructor.  Each stage's single output is registered under
+``name`` for downstream inputs.
+"""
+from __future__ import annotations
+
+import importlib
+from typing import Any, Dict, List
+
+from .. import types as T
+from ..features.builder import FeatureBuilder
+from ..workflow.workflow import OpWorkflow
+
+_PKG = "transmogrifai_tpu"
+
+
+def _resolve_stage_class(path: str):
+    if path.startswith(_PKG + "."):
+        path = path[len(_PKG) + 1:]
+    mod_name, _, cls_name = path.rpartition(".")
+    if not mod_name:
+        raise ValueError(f"stage class {path!r} must be module-qualified")
+    mod = importlib.import_module(f"{_PKG}.{mod_name}")
+    return getattr(mod, cls_name)
+
+
+def build_workflow(spec: Dict[str, Any]) -> OpWorkflow:
+    """Materialize an OpWorkflow from a declarative spec (see module doc)."""
+    by_name: Dict[str, Any] = {}
+    for f in spec.get("features", []):
+        ftype = getattr(T, f["type"])
+        fb = FeatureBuilder(f["name"], ftype).extract(
+            field=f.get("field", f["name"]))
+        feat = fb.as_response() if f.get("response") else fb.as_predictor()
+        by_name[f["name"]] = feat
+
+    for s in spec.get("stages", []):
+        cls = _resolve_stage_class(s["cls"])
+        params = dict(s.get("params", {}))
+        if s.get("factory"):
+            stage = getattr(cls, s["factory"])(**params)
+        else:
+            stage = cls(**params)
+        inputs = [by_name[i] for i in s["inputs"]]
+        stage.set_input(*inputs)
+        out = stage.get_output()
+        by_name[s["name"]] = out
+
+    results = [by_name[r] for r in spec["result"]]
+    wf = OpWorkflow().set_result_features(*results)
+    return wf
+
+
+def list_result_names(spec: Dict[str, Any]) -> List[str]:
+    return list(spec["result"])

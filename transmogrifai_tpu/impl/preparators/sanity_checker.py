@@ -240,10 +240,15 @@ class SanityChecker(BinaryEstimator, AllowLabelAsInput):
         stream = (sharded is True) or (
             sharded == "auto" and method == "pearson" and n > (1 << 18))
         if stream and method == "pearson":
-            from ...parallel.mesh import data_mesh
+            from ...parallel.mesh import DATA_AXIS, active_mesh, data_mesh
             from ...parallel.stats import DataShardedStats, chunked
 
-            mesh = data_mesh()
+            # honor an installed (data, model) mesh — the workflow-level
+            # sweep and the stats pass then ride the SAME mesh, stats on its
+            # data axis (SURVEY §2.7 axis 1; the dryrun exercises this)
+            mesh = active_mesh()
+            if mesh is None or int(mesh.shape.get(DATA_AXIS, 1)) <= 1:
+                mesh = data_mesh()
             acc = DataShardedStats(X.shape[1], mesh=mesh)
             full_stats = acc.moments(chunked(X)())
             acc_c = DataShardedStats(len(corr_cols), mesh=mesh)
