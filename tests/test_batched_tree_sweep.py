@@ -85,3 +85,42 @@ def test_non_batchable_grid_key_falls_back(data):
     with pytest.raises(NotImplementedError):
         OpRandomForestClassifier().fit_grid_folds(X, y, folds,
                                                   [{"bogus_param": 1}])
+
+
+def test_frontier_bound_uses_actual_weight_sum():
+    """DataBalancer-style up-weighted folds (sum(w) ~ n/(1-p) > 1.25n) must
+    not be declared exact for a frontier sized from the 1.25n heuristic
+    (round-4 ADVICE: exact_cap's count clamp silently kept first-come splits
+    instead of the gain beam when the bound was violated)."""
+    from transmogrifai_tpu.ops import trees as Tr
+
+    n, depth, mcw = 1000, 10, 1.0
+    # heuristic frontier sized for ~unit weights
+    frontier = Tr.frontier_cap(n, depth, mcw, h_max=0.25, max_frontier=512)
+    assert Tr.frontier_is_exact(n, depth, mcw, 0.25, frontier)
+    # balancer weights sum to 4n: the same frontier is NOT provably exact...
+    heavy = 4.0 * n
+    assert not Tr.frontier_is_exact(n, depth, mcw, 0.25, frontier,
+                                    total_weight=heavy)
+    # ...and sizing from the actual sum restores exactness (or unrolls)
+    f2 = Tr.frontier_cap(n, depth, mcw, h_max=0.25, max_frontier=4096,
+                         total_weight=heavy)
+    assert Tr.frontier_is_exact(n, depth, mcw, 0.25, f2, total_weight=heavy)
+
+
+def test_zero_reg_lambda_leaves_finite(data):
+    """reg_lambda=0 used to 0/0-NaN dead frontier slots and poison every
+    child leaf through the packing matmul (round-4 ADVICE)."""
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.ops import trees as Tr
+
+    X, y, _, _ = data
+    n, d = X.shape
+    Xb, _ = Tr.quantize(X, 16)
+    g = -np.asarray(y, np.float32)[:, None]
+    tree = Tr.grow_tree(jnp.asarray(Xb), jnp.asarray(g),
+                        jnp.ones(n, jnp.float32), jnp.ones(n, jnp.float32),
+                        jnp.ones(d, jnp.float32), max_depth=4, n_bins=16,
+                        frontier=16, reg_lambda=0.0)
+    assert bool(jnp.isfinite(tree.leaf_val).all())

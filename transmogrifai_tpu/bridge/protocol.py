@@ -74,7 +74,7 @@ def _read_exact(sock: socket.socket, n: int) -> bytes:
 
 def recv_frame(sock: socket.socket) -> Tuple[bytes, bytes]:
     kind, length = _HEADER.unpack(_read_exact(sock, _HEADER.size))
-    if length > MAX_FRAME:
+    if length >= MAX_FRAME:
         raise ValueError(f"frame of {length} bytes exceeds MAX_FRAME")
     return kind, _read_exact(sock, length)
 

@@ -98,7 +98,10 @@ class BridgeSession:
               "multiclass": OpMultiClassificationEvaluator,
               "regression": OpRegressionEvaluator}[kind](
             label_col=req["label"], prediction_col=pred_name)
-        metrics = model.evaluate(ev)
+        # evaluate on the NAMED dataset — without it the model silently
+        # re-evaluates its training data and held-out metrics lie
+        data = self.datasets[req["data"]] if req.get("data") else None
+        metrics = model.evaluate(ev, data=data)
         return {"metrics": {k: v for k, v in metrics.items()
                             if isinstance(v, (int, float, str))}}
 
@@ -133,7 +136,9 @@ def _handle_connection(conn: socket.socket) -> bool:
         while True:
             try:
                 kind, payload = P.recv_frame(conn)
-            except (ConnectionError, OSError):
+            except (ConnectionError, OSError, ValueError):
+                # peer closed, or a malformed/oversized frame header: drop
+                # the session without allocating; the accept loop lives on
                 return False
             if kind == P.KIND_ARROW:
                 pending_arrow = P.parse_arrow(payload)

@@ -138,11 +138,6 @@ def boosted_grid_folds(est, X, y, train_w, grids, loss: str, n_classes: int,
         rw = Tr.subsample_weights(n, n_rounds, subsample, rng)
         fms = Tr.feature_masks(d, n_rounds, colsample, rng)
         mcw_min = min(bps[ci]["min_child_weight"] for ci in cis)
-        frontier = Tr.frontier_cap(
-            n, max_depth, mcw_min, h_max=h_max,
-            max_frontier=int(est.get_param("max_frontier",
-                                           DEFAULT_MAX_FRONTIER_BOOSTED)))
-        exact_cap = Tr.frontier_is_exact(n, max_depth, mcw_min, h_max, frontier)
         B = n_folds * len(cis)
         w_batch = np.empty((B, n), np.float32)
         eta_b = np.empty(B, np.float32)
@@ -163,6 +158,17 @@ def boosted_grid_folds(est, X, y, train_w, grids, loss: str, n_classes: int,
             if fold_base_score:  # regression starts from the fold's label mean
                 wsum = max(float(train_w[f].sum()), 1e-12)
                 base_b[bi] = float((yf * train_w[f]).sum() / wsum)
+        # frontier bound from the ACTUAL weight sums (DataBalancer folds can
+        # sum to n/(1-p) > 1.25n); per-round subsample masks rw are <= 1 so
+        # the fold sum dominates every round's hessian total
+        w_sum_max = float(w_batch.sum(axis=1).max())
+        frontier = Tr.frontier_cap(
+            n, max_depth, mcw_min, h_max=h_max,
+            max_frontier=int(est.get_param("max_frontier",
+                                           DEFAULT_MAX_FRONTIER_BOOSTED)),
+            total_weight=w_sum_max)
+        exact_cap = Tr.frontier_is_exact(n, max_depth, mcw_min, h_max, frontier,
+                                         total_weight=w_sum_max)
         # candidate axis sharded over the active mesh's model axis (zero-weight
         # padding candidates train on no rows); inputs replicated
         from ..parallel.mesh import replicate_input, shard_candidates
@@ -239,10 +245,6 @@ def forest_grid_folds(est, X, y, train_w, grids, n_classes: int, convert) -> lis
         Xb, _ = Tr.quantize(X, n_bins)
         mcw_min = min(float(candidates[ci].get_param("min_instances_per_node", 1))
                       for ci in cis)
-        frontier = Tr.frontier_cap(
-            n, max_depth, mcw_min, h_max=1.0,
-            max_frontier=int(est.get_param("max_frontier", DEFAULT_MAX_FRONTIER)))
-        exact_cap = Tr.frontier_is_exact(n, max_depth, mcw_min, 1.0, frontier)
         pairs = [(f, ci) for f in range(n_folds) for ci in cis]
         TT = len(pairs) * n_trees
         w_trees = np.empty((TT, n), np.float32)
@@ -266,6 +268,16 @@ def forest_grid_folds(est, X, y, train_w, grids, n_classes: int, convert) -> lis
                 cand.get_param("min_instances_per_node", 1))
             mig[gi * n_trees:(gi + 1) * n_trees] = float(
                 cand.get_param("min_info_gain", 0.0))
+        # frontier bound from the ACTUAL per-tree weight sums: Poisson
+        # bootstrap x DataBalancer fold weights routinely exceed the 1.25*n
+        # heuristic, and exact_cap's count clamp must provably never bind
+        w_sum_max = float(w_trees.sum(axis=1).max())
+        frontier = Tr.frontier_cap(
+            n, max_depth, mcw_min, h_max=1.0,
+            max_frontier=int(est.get_param("max_frontier", DEFAULT_MAX_FRONTIER)),
+            total_weight=w_sum_max)
+        exact_cap = Tr.frontier_is_exact(n, max_depth, mcw_min, 1.0, frontier,
+                                         total_weight=w_sum_max)
         from ..parallel.mesh import MODEL_AXIS, active_mesh, model_shards
 
         n_shard = model_shards()

@@ -123,19 +123,24 @@ def _next_pow2(x: int) -> int:
 
 
 def frontier_cap(n: int, max_depth: int, min_child_weight: float = 1.0,
-                 h_max: float = 1.0, max_frontier: int = 512) -> int:
+                 h_max: float = 1.0, max_frontier: int = 512,
+                 total_weight: float = None) -> int:
     """Frontier slots M for ``grow_tree`` (static; power of two).
 
     At most ``H_total / (2 * mcw)`` nodes can validly split per level
     (children need hessian weight >= mcw each), so a frontier of
     ``H_total / mcw`` slots loses nothing.  ``h_max`` bounds one row's
-    hessian (1 for variance/gini trees, 0.25 for logistic/softmax); the 1.25
-    factor absorbs Poisson-bootstrap weight inflation.  Beyond
+    hessian per unit weight (1 for variance/gini trees, 0.25 for
+    logistic/softmax).  ``total_weight`` is the actual row-weight sum (max
+    over the tree batch) — callers that know their weights (bootstrap,
+    DataBalancer up-weighting) MUST pass it; the 1.25*n fallback only covers
+    unweighted rows plus mild Poisson-bootstrap inflation.  Beyond
     ``max_frontier`` growth is a gain-ranked beam (see module docstring).
     """
     if max_depth <= 1:
         return 2
-    exact = int(np.ceil(1.25 * h_max * n / max(min_child_weight, 1e-3)))
+    tw = 1.25 * n if total_weight is None else float(total_weight)
+    exact = int(np.ceil(h_max * tw / max(min_child_weight, 1e-3)))
     # 2^max_depth (not 2^(max_depth-1)): the last split level's children must
     # all fit the next frontier, else the beam silently halves the deepest
     # level; when this term binds the tree is fully unrolled and exact.
@@ -160,13 +165,18 @@ def _pool_size(max_depth: int, frontier: int) -> int:
 
 
 def frontier_is_exact(n: int, max_depth: int, min_child_weight: float,
-                      h_max: float, frontier: int) -> bool:
+                      h_max: float, frontier: int,
+                      total_weight: float = None) -> bool:
     """True when ``frontier`` provably cannot overflow (no beam truncation):
-    a level's children are bounded by H_total / mcw <= 1.25*h_max*n / mcw,
+    a level's children are bounded by H_total / mcw <= h_max*sum(w) / mcw,
     so a frontier at least that wide (or fully unrolled) never ranks splits.
     The exact-cap fast path then replaces the gain-rank argsorts with a
-    trivial count clamp."""
-    exact = int(np.ceil(1.25 * h_max * n / max(min_child_weight, 1e-3)))
+    trivial count clamp.  ``total_weight`` must be the ACTUAL max weight sum
+    over the tree batch when weights can exceed 1 per row (Poisson
+    bootstrap, DataBalancer ~n/(1-p)); the 1.25*n fallback is only safe for
+    near-unit weights."""
+    tw = 1.25 * n if total_weight is None else float(total_weight)
+    exact = int(np.ceil(h_max * tw / max(min_child_weight, 1e-3)))
     return frontier >= min(1 << max_depth, exact)
 
 
@@ -365,8 +375,12 @@ def _grow_level(Xb, gh, w, feat_mask, nodes, leaf_val, slot_base, next_free,
     HL_best = (HL.reshape(m, d * B) * onehot_best).sum(-1)
     GR_best = GT - GL_best
     HR_best = HT - HL_best
-    lval = -GL_best / (HL_best + reg_lambda)[:, None]
-    rval = -GR_best / (HR_best + reg_lambda)[:, None]
+    # dead slots have HL_best = 0; with reg_lambda = 0 the ratio is 0/0 = NaN
+    # and 0 * NaN would poison the child-packing matmul below — zero them
+    lval = jnp.where(do_split[:, None],
+                     -GL_best / (HL_best + reg_lambda)[:, None], 0.0)
+    rval = jnp.where(do_split[:, None],
+                     -GR_best / (HR_best + reg_lambda)[:, None], 0.0)
     # pack (lval, rval) of the k split slots into the contiguous child block
     # [next_free, next_free + 2k) with two tiny selection matmuls (slot s's
     # left child lands at position child_idx[s], right at +1); the tail

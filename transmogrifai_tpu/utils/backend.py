@@ -97,10 +97,21 @@ def ensure_backend(prefer: Optional[str] = None,
     global _RESULT
     if _RESULT is not None and prefer is None and not fresh:
         return _RESULT
-    if probe_timeout is None:
-        probe_timeout = float(os.environ.get("TMOG_PROBE_TIMEOUT", "300"))
+    # escalating probe schedule (round-4 VERDICT #1): a dead tunnel fails
+    # fast (60 s), a slow-initializing one gets a patient final attempt —
+    # total budget ~7 min instead of the old 3 x 300 s = 15 min.
+    env_t = os.environ.get("TMOG_PROBE_TIMEOUT")
+    if probe_timeout is not None:
+        schedule = [float(probe_timeout)]
+    elif env_t:
+        schedule = [float(env_t)]
+    else:
+        schedule = [60.0, 120.0, 240.0]
     if retries is None:
-        retries = int(os.environ.get("TMOG_PROBE_RETRIES", "2"))
+        retries = int(os.environ.get("TMOG_PROBE_RETRIES", str(len(schedule) - 1)))
+    while len(schedule) < 1 + max(retries, 0):
+        schedule.append(schedule[-1])
+    schedule = schedule[:1 + max(retries, 0)]
     import jax
 
     if prefer:
@@ -133,7 +144,7 @@ def ensure_backend(prefer: Optional[str] = None,
             return _RESULT
 
     reason: Optional[str] = None
-    for attempt in range(1 + max(retries, 0)):
+    for attempt, probe_timeout in enumerate(schedule):
         try:
             r = subprocess.run([sys.executable, "-c", _PROBE],
                                capture_output=True, text=True,
@@ -158,7 +169,7 @@ def ensure_backend(prefer: Optional[str] = None,
             reason = f"{type(e).__name__}: {e}"
             diag = reason
         print(f"transmogrifai_tpu: backend probe attempt "
-              f"{attempt + 1}/{1 + max(retries, 0)} failed: {reason}\n"
+              f"{attempt + 1}/{len(schedule)} failed: {reason}\n"
               f"  probe stderr tail: {diag}", file=sys.stderr)
     print(f"transmogrifai_tpu: WARNING falling back to CPU ({reason})",
           file=sys.stderr)
