@@ -1,0 +1,8 @@
+"""Runnable example apps (reference: helloworld/src/main/scala/com/salesforce/hw).
+
+Run as modules from the repo root (or after ``pip install -e .``):
+
+    python -m helloworld.titanic --run-type train --model-location /tmp/titanic_model
+    python -m helloworld.iris
+    python -m helloworld.boston
+"""

@@ -1,0 +1,97 @@
+"""Device-side batched metrics (ops/metrics) must equal the host evaluators.
+
+The fused sweep selects models from these numbers, so they are held to the
+host implementations (evaluators/) at 1e-5 — including score TIES (midrank
+AuROC, distinct-threshold AuPR) and fold masking (excluded rows must not
+shift ranks or counts).  Reference math:
+OpBinaryClassificationEvaluator.scala:56, OpRegressionEvaluator.scala:55.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.evaluators.classification import (
+    OpBinaryClassificationEvaluator, OpMultiClassificationEvaluator)
+from transmogrifai_tpu.evaluators.regression import OpRegressionEvaluator
+from transmogrifai_tpu.ops.metrics import (BINARY_METRICS,
+                                           MULTICLASS_METRICS,
+                                           REGRESSION_METRICS,
+                                           binary_grid_metrics,
+                                           multiclass_grid_metrics,
+                                           regression_grid_metrics)
+
+
+@pytest.fixture(scope="module")
+def binary_case():
+    rng = np.random.default_rng(0)
+    n, F, C = 257, 3, 5
+    y = rng.integers(0, 2, n).astype(np.float32)
+    # two-decimal scores guarantee plenty of ties (the RF vote-fraction case)
+    scores = np.round(rng.random((F, C, n)), 2).astype(np.float32)
+    vm = rng.random((F, n)) > 0.35
+    return y, scores, vm
+
+
+def test_binary_metrics_match_host_evaluator(binary_case):
+    y, scores, vm = binary_case
+    F, C, n = scores.shape
+    strict = np.array([0, 1, 0, 1, 0], np.float32)
+    dev = binary_grid_metrics(y, scores, vm.astype(np.float32), strict)
+    ev = OpBinaryClassificationEvaluator()
+    for f in range(F):
+        for c in range(C):
+            m = vm[f]
+            s = scores[f, c][m]
+            pred = (s > 0.5) if strict[c] else (s >= 0.5)
+            host = ev.evaluate_arrays(y[m], pred.astype(np.float64), s)
+            for name in BINARY_METRICS:
+                assert abs(host[name] - float(np.asarray(dev[name])[f, c])) < 1e-5, \
+                    (f, c, name)
+
+
+def test_binary_metrics_empty_validation_class():
+    """A fold whose validation rows are all one class: AuROC/AuPR -> 0 like
+    the host roc_auc/pr_auc guards, no NaN."""
+    n = 64
+    y = np.ones(n, np.float32)
+    scores = np.random.default_rng(1).random((1, 1, n)).astype(np.float32)
+    vm = np.ones((1, n), np.float32)
+    dev = binary_grid_metrics(y, scores, vm, np.zeros(1, np.float32))
+    assert float(np.asarray(dev["AuROC"])[0, 0]) == 0.0
+    assert np.isfinite(np.asarray(dev["AuPR"])).all()
+
+
+def test_regression_metrics_match_host_evaluator():
+    rng = np.random.default_rng(3)
+    n, F, C = 211, 2, 4
+    y = rng.normal(size=n).astype(np.float32)
+    preds = (y[None, None, :] + rng.normal(0, 0.5, (F, C, n))).astype(np.float32)
+    vm = rng.random((F, n)) > 0.3
+    dev = regression_grid_metrics(y, preds, vm.astype(np.float32))
+    ev = OpRegressionEvaluator()
+    for f in range(F):
+        for c in range(C):
+            m = vm[f]
+            host = ev.evaluate_arrays(y[m], preds[f, c][m])
+            for name in REGRESSION_METRICS:
+                assert abs(host[name] - float(np.asarray(dev[name])[f, c])) < 1e-4, \
+                    (f, c, name)
+
+
+def test_multiclass_metrics_match_host_evaluator():
+    rng = np.random.default_rng(5)
+    n, F, C, k = 180, 2, 3, 4
+    y = rng.integers(0, k, n).astype(np.float32)
+    probs = rng.random((F, C, n, k)).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    vm = rng.random((F, n)) > 0.3
+    y1 = np.eye(k, dtype=np.float32)[y.astype(np.int64)]
+    dev = multiclass_grid_metrics(y1, probs, vm.astype(np.float32))
+    ev = OpMultiClassificationEvaluator()
+    for f in range(F):
+        for c in range(C):
+            m = vm[f]
+            pred = probs[f, c].argmax(-1).astype(np.float64)
+            host = ev.evaluate_arrays(y[m], pred[m])
+            for name in MULTICLASS_METRICS:
+                assert abs(host[name] - float(np.asarray(dev[name])[f, c])) < 1e-5, \
+                    (f, c, name)

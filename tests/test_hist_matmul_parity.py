@@ -40,7 +40,8 @@ def _grow(Xb, y, wt, fm, mig=0.0):
 def test_matmul_histograms_match_segment_sum(monkeypatch):
     Xb, y, rng = _fixture()
     n, d = Xb.shape
-    wt = Tr.bootstrap_weights(n, 1, rng)[0]
+    kb, _ = Tr.rng_keys(0)
+    wt = np.asarray(Tr.bootstrap_weights(kb, n, 1))[0]
     fm = np.ones(d, np.float32)
 
     monkeypatch.setenv("TMOG_HIST_MATMUL", "0")
@@ -63,8 +64,9 @@ def test_forest_chunked_matmul_flag_parity(monkeypatch):
     Xb, y, rng = _fixture(seed=3)
     n, d = Xb.shape
     T = 8
-    wt = Tr.bootstrap_weights(n, T, rng)
-    fm = Tr.feature_masks(d, T, 0.5, rng)
+    kb, kf = Tr.rng_keys(3)
+    wt = np.asarray(Tr.bootstrap_weights(kb, n, T))
+    fm = np.asarray(Tr.feature_masks(kf, d, T, 0.5))
     mcw = np.full(T, 5.0, np.float32)
 
     def fit():
@@ -86,8 +88,9 @@ def test_gbt_matmul_flag_parity(monkeypatch):
     Xb, y, rng = _fixture(seed=5)
     n, d = Xb.shape
     R = 6
-    rw = Tr.subsample_weights(n, R, 1.0, rng)
-    fms = Tr.feature_masks(d, R, 1.0, rng)
+    ks, kf = Tr.rng_keys(5)
+    rw = np.asarray(Tr.subsample_weights(ks, n, R, 1.0))
+    fms = np.asarray(Tr.feature_masks(kf, d, R, 1.0))
 
     def fit():
         _, F = Tr.fit_gbt(jnp.asarray(Xb), jnp.asarray(y), jnp.ones(n),

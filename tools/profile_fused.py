@@ -1,0 +1,61 @@
+"""Per-fragment device-time profile of the fused sweep (dev tool)."""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from bench import init_backend, titanic_arrays, make_selector
+
+platform, fb = init_backend()
+print("platform:", platform)
+
+from transmogrifai_tpu.impl.sweep_fragments import build_sweep_plan
+from transmogrifai_tpu.ops.sweep import run_sweep
+
+X, y = titanic_arrays()
+sel = make_selector()
+v = sel.validator
+n = len(y)
+train_w, val_mask = v.make_folds(n, None)
+prep_w = sel.splitter.prepare_weights(y)
+train_w = train_w * prep_w[None, :].astype(np.float32)
+val_mask = val_mask & (prep_w > 0)[None, :]
+
+plan = build_sweep_plan(sel.models, X, y, train_w, v.evaluator)
+full = plan.spec
+
+
+def time_spec(name, frags, strict_len):
+    spec = (full[0], frags, full[2][:strict_len])
+    # remap cis to 0..strict_len-1? metrics tensor sized by strict tuple —
+    # keep global C; scores for absent candidates stay zero, harmless
+    spec = (full[0], frags, full[2])
+    t0 = time.perf_counter()
+    m = run_sweep(spec, plan.X, plan.xbs, plan.y, train_w, val_mask, plan.blob)
+    np.asarray(m)
+    warm = time.perf_counter() - t0
+    reps = 5
+    t0 = time.perf_counter()
+    for r in range(reps):
+        tw = train_w * (1.0 + 1e-7 * r)  # new buffer: defeat memoization
+        m = run_sweep(spec, plan.X, plan.xbs, plan.y, tw, val_mask, plan.blob)
+        np.asarray(m)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:24s} warm={warm:7.2f}s steady={dt*1e3:9.1f} ms")
+    return dt
+
+
+frags = full[1]
+by_kind = {}
+for f in frags:
+    by_kind.setdefault(f[0], []).append(f)
+
+time_spec("ALL", frags, len(full[2]))
+for kind, fs in by_kind.items():
+    time_spec(f"only:{kind}", tuple(fs), len(full[2]))
+if "forest" in by_kind:
+    groups = by_kind["forest"][0][2]
+    for g in groups:
+        frag = ("forest", by_kind["forest"][0][1], (g,))
+        time_spec(f"forest depth={g[1]} frontier={g[9]} chunk={g[11]}", (frag,),
+                  len(full[2]))

@@ -1,5 +1,6 @@
 """Per-family wall-clock profile of the Titanic default sweep (dev tool)."""
-import time
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 

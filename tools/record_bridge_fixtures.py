@@ -1,0 +1,118 @@
+"""Record golden wire-bytes fixtures for the bridge protocol (dev tool).
+
+Writes the EXACT bytes a conforming client sends for a canonical session —
+one file per request frame sequence — to tests/fixtures/bridge/.  The
+replay test (tests/test_bridge_golden.py) feeds these raw bytes to a live
+server socket and validates the responses, so the protocol contract is
+pinned independently of the Python client implementation: a JVM client
+that produces these bytes (see bridge/scala/README.md) is conforming.
+
+Regenerate only when the protocol intentionally changes:
+    python tools/record_bridge_fixtures.py
+"""
+import json
+import os
+import struct
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "tests", "fixtures", "bridge")
+HEADER = struct.Struct(">cI")
+
+
+def frame(kind: bytes, payload: bytes) -> bytes:
+    return HEADER.pack(kind, len(payload)) + payload
+
+
+def jframe(obj) -> bytes:
+    return frame(b"J", json.dumps(obj, sort_keys=True).encode("utf-8"))
+
+
+def aframe(table) -> bytes:
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    return frame(b"A", sink.getvalue().to_pybytes())
+
+
+def canonical_df(n=60, seed=7) -> pd.DataFrame:
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    sex = rng.choice(["m", "f"], n)
+    y = ((x1 + (sex == "m") + rng.normal(scale=0.4, size=n)) > 0.5).astype(float)
+    return pd.DataFrame({"label": y, "x1": x1, "sex": sex})
+
+
+SPEC = {
+    "features": [
+        {"name": "label", "type": "RealNN", "response": True},
+        {"name": "x1", "type": "Real"},
+        {"name": "sex", "type": "PickList"},
+    ],
+    "stages": [
+        {"cls": "impl.feature.vectorizers.RealVectorizer",
+         "params": {}, "inputs": ["x1"], "name": "nums"},
+        {"cls": "impl.feature.vectorizers.OneHotVectorizer",
+         "params": {"top_k": 5, "min_support": 1}, "inputs": ["sex"],
+         "name": "cats"},
+        {"cls": "impl.feature.vectorizers.VectorsCombiner",
+         "params": {}, "inputs": ["nums", "cats"], "name": "vec"},
+        {"cls": "impl.classification.logistic.OpLogisticRegression",
+         "params": {"reg_param": 0.01}, "inputs": ["label", "vec"],
+         "name": "pred"},
+    ],
+    "result": ["pred"],
+}
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    df = canonical_df()
+    table = pa.Table.from_pandas(df, preserve_index=False)
+
+    # each fixture: the raw request bytes; expected response keys live in
+    # expectations.json next to them
+    fixtures = [
+        ("01_ping", jframe({"op": "ping"}),
+         {"ok": True, "has": ["backend", "devices"]}),
+        ("02_put_data", aframe(table) + jframe({"op": "put_data",
+                                                "name": "train"}),
+         {"ok": True, "equals": {"rows": len(df), "cols": 3}}),
+        ("03_build", jframe({"op": "build", "spec": SPEC, "name": "wf"}),
+         {"ok": True, "equals": {"workflow": "wf"}}),
+        ("04_train", jframe({"op": "train", "workflow": "wf",
+                             "data": "train", "model": "model"}),
+         {"ok": True, "equals": {"model": "model"}}),
+        ("05_score", jframe({"op": "score", "model": "model",
+                             "data": "train"}),
+         {"ok": True, "arrow": True, "equals": {"rows": len(df)}}),
+        ("06_evaluate", jframe({"op": "evaluate", "model": "model",
+                                "data": "train", "evaluator": "binary",
+                                "label": "label"}),
+         {"ok": True, "has": ["metrics"]}),
+        ("07_summary", jframe({"op": "summary", "model": "model"}),
+         {"ok": True, "has": ["summary"]}),
+        ("08_bad_op", jframe({"op": "no_such_op"}),
+         {"ok": False, "has": ["error"]}),
+        ("09_shutdown", jframe({"op": "shutdown"}), {"ok": True}),
+    ]
+    expect = {}
+    for name, raw, exp in fixtures:
+        with open(os.path.join(OUT, f"{name}.bin"), "wb") as f:
+            f.write(raw)
+        expect[name] = exp
+    with open(os.path.join(OUT, "expectations.json"), "w") as f:
+        json.dump(expect, f, indent=1, sort_keys=True)
+    # the label column ships with the fixture set for score-accuracy checks
+    np.save(os.path.join(OUT, "labels.npy"), df["label"].to_numpy())
+    print(f"wrote {len(fixtures)} fixtures to {OUT}")
+
+
+if __name__ == "__main__":
+    main()

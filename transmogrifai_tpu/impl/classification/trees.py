@@ -94,14 +94,14 @@ class OpRandomForestClassifier(_TreeClassifierBase):
         n_bins = int(self.get_param("max_bins", 32))
         depth = int(self.get_param("max_depth", 5))
         n_trees = int(self.get_param("num_trees", 20))
-        rng = np.random.default_rng(int(self.get_param("seed", 42)))
         Xb, edges = Tr.quantize(X, n_bins)
         G = self._class_grads(y, k)
         sw = np.ones(n, np.float32) if w is None else np.asarray(w, np.float32)
-        wt = Tr.bootstrap_weights(n, n_trees, rng,
-                                  rate=float(self.get_param("subsampling_rate", 1.0))
-                                  ) * sw[None, :]
-        fms = Tr.feature_masks(d, n_trees, self._subset_frac(d), rng)
+        kb, kf = Tr.rng_keys(int(self.get_param("seed", 42)))
+        wt = Tr.bootstrap_weights(
+            kb, n, n_trees,
+            rate=float(self.get_param("subsampling_rate", 1.0))) * _as_f32(sw)[None, :]
+        fms = Tr.feature_masks(kf, d, n_trees, self._subset_frac(d))
         mcw = float(self.get_param("min_instances_per_node", 1))
         forest = Tr.fit_forest(jnp.asarray(Xb), jnp.asarray(G), _as_f32(np.ones(n)),
                                jnp.asarray(wt), jnp.asarray(fms),
@@ -142,6 +142,9 @@ class OpRandomForestClassifier(_TreeClassifierBase):
 
 class OpDecisionTreeClassifier(OpRandomForestClassifier):
     """Single gini tree (num_trees=1, no bagging/subsetting)."""
+
+    #: batched sweep grows the same deterministic un-bagged tree fit_arrays does
+    _grid_bootstrap = False
 
     def __init__(self, max_depth: int = 5, max_bins: int = 32,
                  min_instances_per_node: int = 1, min_info_gain: float = 0.0,
@@ -194,11 +197,11 @@ class _BoostedClassifierBase(_TreeClassifierBase):
         bp = self._boost_params()
         n, d = X.shape
         k = self._n_classes(y)
-        rng = np.random.default_rng(int(self.get_param("seed", 42)))
         Xb, edges = Tr.quantize(X, bp["n_bins"])
         sw = np.ones(n, np.float32) if w is None else np.asarray(w, np.float32)
-        rw = Tr.subsample_weights(n, bp["n_rounds"], bp["subsample"], rng)
-        fms = Tr.feature_masks(d, bp["n_rounds"], bp["colsample"], rng)
+        ks, kf = Tr.rng_keys(int(self.get_param("seed", 42)))
+        rw = Tr.subsample_weights(ks, n, bp["n_rounds"], bp["subsample"])
+        fms = Tr.feature_masks(kf, d, bp["n_rounds"], bp["colsample"])
         loss = "logistic" if k == 2 else "softmax"
         frontier = self._frontier(n, bp["max_depth"], bp["min_child_weight"], 0.25)
         trees, _ = Tr.fit_gbt(jnp.asarray(Xb), _as_f32(y), jnp.asarray(sw),

@@ -237,9 +237,8 @@ class SanityChecker(BinaryEstimator, AllowLabelAsInput):
         with_corr = not bool(self.get_param("feature_label_corr_only", False))
         corr_cols = self._correlation_columns(meta)
         sharded = self.get_param("sharded_stats", "auto")
-        stream = (sharded is True) or (
-            sharded == "auto" and method == "pearson" and n > (1 << 18))
-        if stream and method == "pearson":
+        stream = (sharded is True) or (sharded == "auto" and n > (1 << 18))
+        if stream and method in ("pearson", "spearman"):
             from ...parallel.mesh import DATA_AXIS, active_mesh, data_mesh
             from ...parallel.stats import DataShardedStats, chunked
 
@@ -254,17 +253,32 @@ class SanityChecker(BinaryEstimator, AllowLabelAsInput):
             acc_c = DataShardedStats(len(corr_cols), mesh=mesh)
             ch = 1 << 18
             all_cols = len(corr_cols) == X.shape[1]
+            if method == "spearman":
+                # global rank transform on device (parallel/stats), then the
+                # SAME streaming Pearson passes run over the ranks — the
+                # Spark Statistics.corr("spearman") sort-then-Pearson scheme
+                from ...parallel.stats import rank_transform
+
+                Xs = rank_transform(X if all_cols else X[:, corr_cols])
+                ys = rank_transform(np.asarray(y, np.float32))
+                mean_c = np.full(len(corr_cols), (n + 1) / 2.0)
+                y_mean = (n + 1) / 2.0
+            else:
+                Xs = X if all_cols else None
+                ys = y
+                mean_c = full_stats.mean[corr_cols]
+                y_mean = float(np.mean(y))
 
             def xy_chunks():
                 for lo in range(0, n, ch):
-                    Xc = X[lo:lo + ch]
                     # avoid a per-chunk column-gather copy when nothing is
                     # excluded (the common case at scale)
-                    yield (Xc if all_cols else Xc[:, corr_cols]), y[lo:lo + ch]
+                    Xc = (Xs[lo:lo + ch] if Xs is not None
+                          else X[lo:lo + ch][:, corr_cols])
+                    yield Xc, ys[lo:lo + ch]
 
             corr_label_sub, corr_matrix_sub = acc_c.correlations_from(
-                xy_chunks, full_stats.mean[corr_cols], float(np.mean(y)),
-                with_corr_matrix=with_corr)
+                xy_chunks, mean_c, y_mean, with_corr_matrix=with_corr)
         else:
             _, corr_label_sub, corr_matrix_sub = S.correlations_with_label(
                 X[:, corr_cols], y, method=method, with_corr_matrix=with_corr)

@@ -57,13 +57,14 @@ class OpRandomForestRegressor(_TreeRegressorBase):
         n_bins = int(self.get_param("max_bins", 32))
         depth = int(self.get_param("max_depth", 5))
         n_trees = int(self.get_param("num_trees", 20))
-        rng = np.random.default_rng(int(self.get_param("seed", 42)))
         Xb, edges = Tr.quantize(X, n_bins)
         sw = np.ones(n, np.float32) if w is None else np.asarray(w, np.float32)
-        wt = Tr.bootstrap_weights(n, n_trees, rng,
-                                  rate=float(self.get_param("subsampling_rate", 1.0))
-                                  ) * sw[None, :]
-        fms = Tr.feature_masks(d, n_trees, self._subset_frac(d), rng)
+        kb, kf = Tr.rng_keys(int(self.get_param("seed", 42)))
+        wt = Tr.bootstrap_weights(
+            kb, n, n_trees,
+            rate=float(self.get_param("subsampling_rate", 1.0))
+        ) * jnp.asarray(sw)[None, :]
+        fms = Tr.feature_masks(kf, d, n_trees, self._subset_frac(d))
         g = jnp.asarray(-np.asarray(y, np.float32)[:, None])
         mcw = float(self.get_param("min_instances_per_node", 1))
         forest = Tr.fit_forest(jnp.asarray(Xb), g, jnp.ones(n, jnp.float32),
@@ -93,6 +94,9 @@ class OpRandomForestRegressor(_TreeRegressorBase):
 
 
 class OpDecisionTreeRegressor(OpRandomForestRegressor):
+    #: batched sweep grows the same deterministic un-bagged tree fit_arrays does
+    _grid_bootstrap = False
+
     def __init__(self, max_depth: int = 5, max_bins: int = 32,
                  min_instances_per_node: int = 1, min_info_gain: float = 0.0,
                  seed: int = 42, uid: Optional[str] = None, **extra):
@@ -135,11 +139,11 @@ class _BoostedRegressorBase(_TreeRegressorBase):
                    w: Optional[np.ndarray] = None) -> Dict[str, Any]:
         bp = self._boost_params()
         n, d = X.shape
-        rng = np.random.default_rng(int(self.get_param("seed", 42)))
         Xb, edges = Tr.quantize(X, bp["n_bins"])
         sw = np.ones(n, np.float32) if w is None else np.asarray(w, np.float32)
-        rw = Tr.subsample_weights(n, bp["n_rounds"], bp["subsample"], rng)
-        fms = Tr.feature_masks(d, bp["n_rounds"], bp["colsample"], rng)
+        ks, kf = Tr.rng_keys(int(self.get_param("seed", 42)))
+        rw = Tr.subsample_weights(ks, n, bp["n_rounds"], bp["subsample"])
+        fms = Tr.feature_masks(kf, d, bp["n_rounds"], bp["colsample"])
         base = float(np.average(y, weights=np.maximum(sw, 1e-12)))
         frontier = self._frontier(n, bp["max_depth"], bp["min_child_weight"])
         trees, _ = Tr.fit_gbt(jnp.asarray(Xb), jnp.asarray(np.asarray(y, np.float32)),

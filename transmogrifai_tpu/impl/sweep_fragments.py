@@ -1,0 +1,336 @@
+"""Builders turning a selector candidate list into a fused sweep program.
+
+The validator hands its ``candidates = [(estimator, grids), ...]`` list here;
+``build_sweep_plan`` translates every family it understands into a static
+spec fragment + dynamic f32 blob for ``ops/sweep.run_sweep`` — the
+one-launch fold x grid sweep.  Families (or grids) outside the supported
+surface return None and the validator keeps its legacy per-family path, so
+custom estimators lose nothing.
+
+Supported families (the full reference DEFAULT sweeps,
+DefaultSelectorParams.scala:37-75):
+
+- OpLogisticRegression (binary; reg_param/elastic_net_param grids),
+- OpLinearRegression (reg_param/elastic_net_param),
+- OpRandomForestClassifier / OpDecisionTreeClassifier (binary) and the
+  regressor twins — any grid over trees_common._FOREST_GRID_KEYS,
+- OpGBTClassifier / OpXGBoostClassifier (binary) and the regressor twins —
+  any grid over trees_common._DYNAMIC_BOOST_KEYS + static boosting shape.
+
+Frontier sizing: with the bootstrap drawn on DEVICE the builder cannot read
+the realized Poisson weight sums, so it bounds them: mean + 5 sigma of the
+Poisson total on top of the fold-weight sum (P(exceed) < 3e-7 even per
+group; on violation the kernel's count clamp would only trim the deepest
+level's worst splits).  ``exact_cap`` is claimed only under that bound.
+"""
+from __future__ import annotations
+
+import logging
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops import trees as Tr
+from ..ops.metrics import BINARY_METRICS, REGRESSION_METRICS
+from ..utils import devcache
+from .trees_common import (DEFAULT_MAX_FRONTIER, DEFAULT_MAX_FRONTIER_BOOSTED,
+                           _DYNAMIC_BOOST_KEYS, _FOREST_GRID_KEYS)
+
+log = logging.getLogger(__name__)
+
+
+class _Blob:
+    """Append-only f32 parameter vector with static offsets."""
+
+    def __init__(self):
+        self.parts: List[np.ndarray] = []
+        self.off = 0
+
+    def add(self, values) -> int:
+        arr = np.asarray(values, np.float32).ravel()
+        off = self.off
+        self.parts.append(arr)
+        self.off += arr.size
+        return off
+
+    def pack(self) -> np.ndarray:
+        if not self.parts:
+            return np.zeros(1, np.float32)
+        return np.concatenate(self.parts)
+
+
+class SweepPlan:
+    """A ready-to-run fused sweep: spec + arrays + metric bookkeeping."""
+
+    def __init__(self, spec, X, xbs, y, blob, problem: str):
+        self.spec = spec
+        self.X = X
+        self.xbs = xbs
+        self.y = y
+        self.blob = blob
+        self.problem = problem
+        self.metric_names = (BINARY_METRICS if problem == "binary"
+                            else REGRESSION_METRICS)
+
+    def run(self, train_w: np.ndarray, val_mask: np.ndarray) -> np.ndarray:
+        """Execute; returns host metrics [F, C, M] (ONE device pull)."""
+        from ..ops.sweep import run_sweep
+
+        out = run_sweep(self.spec, self.X, self.xbs, self.y,
+                        np.asarray(train_w, np.float32),
+                        np.asarray(val_mask, np.float32), self.blob)
+        return np.asarray(out)
+
+
+def _poisson_bound(fold_sum: float, rate: float, max_w: float) -> float:
+    """Upper bound on a Poisson(rate)-bootstrapped fold weight sum: mean +
+    5 sigma, with sigma^2 = rate * sum_i w_i^2 <= rate * max_w * sum_w using
+    the ACTUAL max row weight (DataBalancer can up-weight far past any
+    constant heuristic).  P(exceed 5 sigma) < 3e-7 per group."""
+    mean = rate * fold_sum
+    sigma = math.sqrt(max(rate * fold_sum * max(max_w, 1.0), 1.0))
+    return mean + 5.0 * sigma + 5.0 * max(max_w, 1.0)
+
+
+def _xb_index(xbs: List, X: np.ndarray, n_bins: int) -> int:
+    """Pre-binned matrix index for ``n_bins`` (cached per X identity)."""
+    dev = devcache.derived(
+        X, ("xb", n_bins),
+        lambda: devcache.device_array(Tr.quantize(X, n_bins)[0], tag=f"xb{n_bins}"))
+    for i, a in enumerate(xbs):
+        if a is dev:
+            return i
+    xbs.append(dev)
+    return len(xbs) - 1
+
+
+def _lr_fragments(est, grids, pos: int, blob: _Blob, y) -> Optional[List]:
+    base_mi = int(est.get_param("max_iter", 100))
+    base_fi = bool(est.get_param("fit_intercept", True))
+    family = est.get_param("family", "auto")
+    num_classes = int(np.max(np.asarray(y))) + 1 if len(y) else 2
+    if family == "multinomial" or (family == "auto" and num_classes > 2):
+        return None  # softmax not fused yet
+    for g in grids:
+        for k in g:
+            if k not in ("reg_param", "elastic_net_param"):
+                return None
+    reg = np.array([float(g.get("reg_param", est.get_param("reg_param", 0.0)))
+                    for g in grids], np.float32)
+    alpha = np.array([float(g.get("elastic_net_param",
+                                  est.get_param("elastic_net_param", 0.0)))
+                      for g in grids], np.float32)
+    l1 = reg * alpha
+    l2 = reg * (1.0 - alpha)
+    frags = []
+    newton = tuple(int(pos + i) for i in np.where(l1 == 0.0)[0])
+    fista = tuple(int(pos + i) for i in np.where(l1 != 0.0)[0])
+    if newton:
+        idx = [c - pos for c in newton]
+        off_l2 = blob.add(l2[idx])
+        frags.append(("newton", newton,
+                      min(max(base_mi // 4, 10), 50), base_fi, off_l2))
+    if fista:
+        idx = [c - pos for c in fista]
+        off_l1 = blob.add(l1[idx])
+        off_l2 = blob.add(l2[idx])
+        frags.append(("fista", fista, max(base_mi, 200), base_fi,
+                      off_l1, off_l2))
+    return frags
+
+
+def _linreg_fragments(est, grids, pos: int, blob: _Blob) -> Optional[List]:
+    base_mi = int(est.get_param("max_iter", 100))
+    base_fi = bool(est.get_param("fit_intercept", True))
+    for g in grids:
+        for k in g:
+            if k not in ("reg_param", "elastic_net_param"):
+                return None
+    reg = np.array([float(g.get("reg_param", est.get_param("reg_param", 0.0)))
+                    for g in grids], np.float32)
+    alpha = np.array([float(g.get("elastic_net_param",
+                                  est.get_param("elastic_net_param", 0.0)))
+                      for g in grids], np.float32)
+    cis = tuple(range(pos, pos + len(grids)))
+    off_l1 = blob.add(reg * alpha)
+    off_l2 = blob.add(reg * (1.0 - alpha))
+    return [("fista", cis, max(base_mi, 300), base_fi, off_l1, off_l2)]
+
+
+def _forest_fragment(est, grids, pos: int, blob: _Blob, xbs, X, train_w,
+                     classification: bool) -> Optional[List]:
+    for g in grids:
+        for k in g:
+            if k not in _FOREST_GRID_KEYS:
+                return None
+    n, d = X.shape
+    cands = [est.copy_with_params(dict(g)) for g in grids]
+    groups: Dict[tuple, List[int]] = {}
+    for i, c in enumerate(cands):
+        key = (int(c.get_param("max_depth", 5)),
+               int(c.get_param("num_trees", 20)),
+               int(c.get_param("max_bins", 32)),
+               float(c._subset_frac(d)),
+               float(c.get_param("subsampling_rate", 1.0)),
+               bool(getattr(c, "_grid_bootstrap", True)),
+               int(c.get_param("seed", 42)))
+        groups.setdefault(key, []).append(i)
+    tw = np.asarray(train_w, np.float32)
+    fold_sum = float(tw.sum(axis=1).max())
+    max_w = float(tw.max()) if tw.size else 1.0
+    out_groups = []
+    for (depth, ntrees, n_bins, frac, rate, bag, seed), idxs in groups.items():
+        mcw = [float(cands[i].get_param("min_instances_per_node", 1))
+               for i in idxs]
+        mig = [float(cands[i].get_param("min_info_gain", 0.0)) for i in idxs]
+        bound = _poisson_bound(fold_sum, rate, max_w) if bag else fold_sum
+        mcw_min = min(mcw)
+        frontier = Tr.frontier_cap(
+            n, depth, mcw_min, h_max=1.0,
+            max_frontier=int(est.get_param("max_frontier",
+                                           DEFAULT_MAX_FRONTIER)),
+            total_weight=bound)
+        exact = Tr.frontier_is_exact(n, depth, mcw_min, 1.0, frontier,
+                                     total_weight=bound)
+        c = 1  # binary/regression both use 1-channel leaves
+        F = train_w.shape[0]
+        TT = F * len(idxs) * ntrees
+        chunk = Tr.balanced_chunk(
+            TT, Tr.forest_chunk_size(depth, n_bins, d, c, frontier, n_rows=n))
+        out_groups.append((
+            tuple(int(pos + i) for i in idxs), depth, ntrees,
+            _xb_index(xbs, X, n_bins), n_bins, frac,
+            rate if bag else 1.0, bag, seed, frontier, exact, chunk,
+            blob.add(mcw), blob.add(mig)))
+    return [("forest", 1, tuple(out_groups))]
+
+
+def _gbt_fragment(est, grids, pos: int, blob: _Blob, xbs, X, train_w,
+                  loss: str) -> Optional[List]:
+    static_keys = ("num_round", "max_iter", "max_depth", "max_bins",
+                   "subsample", "subsampling_rate", "colsample_bytree")
+    for g in grids:
+        for k in g:
+            if k not in _DYNAMIC_BOOST_KEYS and k not in static_keys:
+                return None
+    n, d = X.shape
+    cands = [est.copy_with_params(dict(g)) for g in grids]
+    bps = [c._boost_params() for c in cands]
+    groups: Dict[tuple, List[int]] = {}
+    for i, bp in enumerate(bps):
+        key = (bp["n_rounds"], bp["max_depth"], bp["n_bins"],
+               float(bp["subsample"]), float(bp["colsample"]),
+               int(cands[i].get_param("seed", 42)))
+        groups.setdefault(key, []).append(i)
+    fold_sum = float(np.asarray(train_w, np.float32).sum(axis=1).max())
+    h_max = 0.25 if loss == "logistic" else 1.0
+    fold_base = loss == "squared"
+    out_groups = []
+    for (rounds, depth, n_bins, subsample, colsample, seed), idxs in groups.items():
+        mcw_min = min(bps[i]["min_child_weight"] for i in idxs)
+        frontier = Tr.frontier_cap(
+            n, depth, mcw_min, h_max=h_max,
+            max_frontier=int(est.get_param("max_frontier",
+                                           DEFAULT_MAX_FRONTIER_BOOSTED)),
+            total_weight=fold_sum)
+        exact = Tr.frontier_is_exact(n, depth, mcw_min, h_max, frontier,
+                                     total_weight=fold_sum)
+        out_groups.append((
+            tuple(int(pos + i) for i in idxs), rounds, depth,
+            _xb_index(xbs, X, n_bins), n_bins, subsample, colsample, seed,
+            frontier, exact, fold_base,
+            blob.add([bps[i]["eta"] for i in idxs]),
+            blob.add([bps[i]["reg_lambda"] for i in idxs]),
+            blob.add([bps[i]["gamma"] for i in idxs]),
+            blob.add([bps[i]["min_child_weight"] for i in idxs]),
+            blob.add([bps[i].get("min_info_gain", 0.0) for i in idxs])))
+    return [("gbt", loss, 1, tuple(out_groups))]
+
+
+def build_sweep_plan(candidates: Sequence[Tuple[Any, Sequence[Dict[str, Any]]]],
+                     X: np.ndarray, y: np.ndarray, train_w: np.ndarray,
+                     evaluator) -> Optional[SweepPlan]:
+    """Translate the candidate list into a fused program, or None.
+
+    Requires: every family supported, a device-computable default metric,
+    and (for classification) a binary 0/1 label.
+    """
+    from .classification.logistic import OpLogisticRegression
+    from .classification.trees import (OpGBTClassifier,
+                                       OpRandomForestClassifier,
+                                       OpXGBoostClassifier)
+    from .regression.linear import OpLinearRegression
+    from .regression.trees import (OpGBTRegressor, OpRandomForestRegressor,
+                                   OpXGBoostRegressor)
+
+    from ..evaluators import _SingleMetric
+    from ..evaluators.classification import OpBinaryClassificationEvaluator
+    from ..evaluators.regression import OpRegressionEvaluator
+
+    yv = np.asarray(y)
+    binary = bool(np.isin(yv, (0.0, 1.0)).all()) and len(np.unique(yv)) == 2
+    # exact types only: a subclass may override evaluate_arrays, and the
+    # device program must compute the SAME number the host path would.
+    # _SingleMetric (the Evaluators.* factory wrapper) delegates verbatim to
+    # its inner evaluator, so unwrap it and honor its chosen default metric.
+    inner = evaluator.inner if type(evaluator) is _SingleMetric else evaluator
+    if type(inner) is OpBinaryClassificationEvaluator and binary:
+        problem = "binary"
+        if evaluator.default_metric not in BINARY_METRICS:
+            return None
+    elif type(inner) is OpRegressionEvaluator:
+        problem = "regression"
+        if evaluator.default_metric not in REGRESSION_METRICS:
+            return None
+    else:
+        return None
+
+    X = np.ascontiguousarray(np.asarray(X, np.float32))
+    blob = _Blob()
+    xbs: List = []
+    frags: List = []
+    strict: List[int] = []
+    pos = 0
+    for est, grids in candidates:
+        grids = [dict(g) for g in (list(grids) or [{}])]
+        G = len(grids)
+        if problem == "binary":
+            if isinstance(est, OpLogisticRegression):
+                fr = _lr_fragments(est, grids, pos, blob, yv)
+                s = 0
+            elif isinstance(est, OpRandomForestClassifier):  # covers DT subclass
+                fr = _forest_fragment(est, grids, pos, blob, xbs, X, train_w,
+                                      classification=True)
+                s = 1  # argmax([1-p, p]) ties to class 0 => p > 0.5
+            elif isinstance(est, (OpGBTClassifier, OpXGBoostClassifier)):
+                fr = _gbt_fragment(est, grids, pos, blob, xbs, X, train_w,
+                                   loss="logistic")
+                s = 0  # _margins_to_preds uses p >= 0.5
+            else:
+                fr = None
+                s = 0
+        else:
+            if isinstance(est, OpLinearRegression):
+                fr = _linreg_fragments(est, grids, pos, blob)
+            elif isinstance(est, OpRandomForestRegressor):
+                fr = _forest_fragment(est, grids, pos, blob, xbs, X, train_w,
+                                      classification=False)
+            elif isinstance(est, (OpGBTRegressor, OpXGBoostRegressor)):
+                fr = _gbt_fragment(est, grids, pos, blob, xbs, X, train_w,
+                                   loss="squared")
+            else:
+                fr = None
+            s = 0
+        if fr is None:
+            log.debug("fused sweep: unsupported family %s; falling back",
+                      type(est).__name__)
+            return None
+        frags.extend(fr)
+        strict.extend([s] * G)
+        pos += G
+
+    spec = (problem, tuple(frags), tuple(strict))
+    Xd = devcache.device_array(X, np.float32)
+    yd = devcache.device_array(np.asarray(yv, np.float32), np.float32)
+    return SweepPlan(spec, Xd, tuple(xbs), yd, blob.pack(), problem)

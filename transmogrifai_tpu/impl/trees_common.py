@@ -133,10 +133,10 @@ def boosted_grid_folds(est, X, y, train_w, grids, loss: str, n_classes: int,
 
     h_max = 0.25 if loss in ("logistic", "softmax") else 1.0
     for (n_rounds, max_depth, n_bins, subsample, colsample), cis in groups.items():
-        rng = np.random.default_rng(int(est.get_param("seed", 42)))
         Xb, _ = Tr.quantize(X, n_bins)
-        rw = Tr.subsample_weights(n, n_rounds, subsample, rng)
-        fms = Tr.feature_masks(d, n_rounds, colsample, rng)
+        ks, kfm = Tr.rng_keys(int(est.get_param("seed", 42)))
+        rw = Tr.subsample_weights(ks, n, n_rounds, subsample)
+        fms = Tr.feature_masks(kfm, d, n_rounds, colsample)
         mcw_min = min(bps[ci]["min_child_weight"] for ci in cis)
         B = n_folds * len(cis)
         w_batch = np.empty((B, n), np.float32)
@@ -251,17 +251,21 @@ def forest_grid_folds(est, X, y, train_w, grids, n_classes: int, convert) -> lis
         fms = np.empty((TT, d), np.float32)
         mcw = np.empty(TT, np.float32)
         mig = np.zeros(TT, np.float32)
+        draw_cache: Dict[tuple, tuple] = {}
         for gi, (f, ci) in enumerate(pairs):
             cand = candidates[ci]
-            rng = np.random.default_rng(int(cand.get_param("seed", 42)))
-            if getattr(cand, "_grid_bootstrap", True):
-                boot = Tr.bootstrap_weights(
-                    n, n_trees, rng,
-                    rate=float(cand.get_param("subsampling_rate", 1.0)))
-                fm = Tr.feature_masks(d, n_trees, cand._subset_frac(d), rng)
-            else:  # single deterministic tree (OpDecisionTree*): no bagging
-                boot = np.ones((n_trees, n), np.float32)
-                fm = np.ones((n_trees, d), np.float32)
+            seed = int(cand.get_param("seed", 42))
+            rate = float(cand.get_param("subsampling_rate", 1.0))
+            frac = cand._subset_frac(d)
+            bag = bool(getattr(cand, "_grid_bootstrap", True))
+            dkey = (seed, rate, frac, bag)
+            if dkey not in draw_cache:  # one device draw + pull per config
+                kb, kfm = Tr.rng_keys(seed)
+                draw_cache[dkey] = (
+                    np.asarray(Tr.bootstrap_weights(kb, n, n_trees, bag, rate)),
+                    np.asarray(Tr.feature_masks(kfm, d, n_trees,
+                                                frac if bag else 1.0)))
+            boot, fm = draw_cache[dkey]
             w_trees[gi * n_trees:(gi + 1) * n_trees] = boot * train_w[f][None, :]
             fms[gi * n_trees:(gi + 1) * n_trees] = fm
             mcw[gi * n_trees:(gi + 1) * n_trees] = float(
@@ -281,8 +285,9 @@ def forest_grid_folds(est, X, y, train_w, grids, n_classes: int, convert) -> lis
         from ..parallel.mesh import MODEL_AXIS, active_mesh, model_shards
 
         n_shard = model_shards()
-        chunk = min(Tr.forest_chunk_size(max_depth, n_bins, d, c, frontier),
-                    max(TT // n_shard, 1))
+        chunk = Tr.balanced_chunk(
+            max(TT // n_shard, 1),
+            Tr.forest_chunk_size(max_depth, n_bins, d, c, frontier, n_rows=n))
         pad = (-TT) % (chunk * n_shard)
         if pad:  # zero-weight padding trees grow no splits and are dropped
             w_trees = np.concatenate([w_trees, np.zeros((pad, n), np.float32)])

@@ -159,6 +159,8 @@ class OpValidator:
         return summary
 
     def _sweep(self, candidates, X, y, train_w, val_mask, summary) -> None:
+        if self._fused_sweep(candidates, X, y, train_w, val_mask, summary):
+            return
         for est, grids in candidates:
             grids = list(grids) or [{}]
             preds = None
@@ -198,6 +200,62 @@ class OpValidator:
                     model_type=type(est).__name__, grid=dict(grid),
                     metric_name=self.evaluator.default_metric,
                     fold_metrics=fold_metrics, metric_value=value, error=err))
+
+    def _fused_sweep(self, candidates, X, y, train_w, val_mask, summary) -> bool:
+        """ONE-launch fold x grid sweep (ops/sweep) when every family and the
+        evaluator's default metric have a device program.
+
+        Returns True when the summary was filled.  Latency rationale
+        (round-5): the per-family path pays a device round trip per launch,
+        upload, and metric pull — tens of ms each over a tunneled backend;
+        the fused program costs one upload + one launch + one [F, C, M]
+        metrics pull regardless of grid size.  Disable with
+        TMOG_FUSED_SWEEP=0.  Multi-device meshes keep the legacy path, which
+        shards the candidate axis (parallel/mesh.shard_candidates).
+        """
+        import os
+
+        if os.environ.get("TMOG_FUSED_SWEEP", "1") == "0":
+            return False
+        from ...parallel.mesh import model_shards
+
+        if model_shards() > 1:
+            return False
+        try:
+            from ..sweep_fragments import build_sweep_plan
+
+            plan = build_sweep_plan(candidates, X, y, train_w, self.evaluator)
+        except Exception as e:
+            log.warning("fused sweep build failed (%s); per-family path", e)
+            return False
+        if plan is None:
+            return False
+        try:
+            metrics = plan.run(train_w, val_mask)
+        except Exception as e:
+            log.warning("fused sweep run failed (%s); per-family path", e)
+            return False
+        mi = plan.metric_names.index(self.evaluator.default_metric)
+        bad = -np.inf if self.evaluator.is_larger_better else np.inf
+        ci = 0
+        for est, grids in candidates:
+            for grid in (list(grids) or [{}]):
+                fm = [float(v) for v in metrics[:, ci, mi]]
+                value = float(np.mean(fm))
+                err = None
+                if not np.isfinite(value):
+                    # marked as a failed candidate (error set) so validate()'s
+                    # all-models-failed guard still fires when the whole grid
+                    # diverges — never silently selected
+                    value = bad
+                    err = f"non-finite {self.evaluator.default_metric} on device"
+                summary.results.append(ModelEvaluation(
+                    model_uid=est.uid, model_name=type(est).__name__,
+                    model_type=type(est).__name__, grid=dict(grid),
+                    metric_name=self.evaluator.default_metric,
+                    fold_metrics=fm, metric_value=value, error=err))
+                ci += 1
+        return True
 
 
 class OpCrossValidation(OpValidator):

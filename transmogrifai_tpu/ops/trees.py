@@ -223,6 +223,19 @@ def _hist_via_matmul(n: int, d: int, n_bins: int, c1: int = 2) -> bool:
     return float(n) * d * n_bins * c1 * (2 if _hist_bf16() else 4) <= 2e9
 
 
+def bin_onehot(Xb, n_bins: int) -> jax.Array:
+    """Gradient-FREE histogram RHS: [n, d*B] with entry (r, j*B + b) =
+    1[bin(r, j) == b].  Depends only on the binned matrix, so boosting
+    builds it ONCE per launch (the gradient-carrying ``grad_onehot`` must be
+    rebuilt every round); per-tree gradients then ride the LHS of the level
+    GEMM (see ``_grow_level_batch``'s gh_t path).  Honors the same
+    ``_hist_bf16`` knob as ``grad_onehot`` (0/1 entries are bf16-exact)."""
+    n, d = Xb.shape
+    dt = jnp.bfloat16 if _hist_bf16() else jnp.float32
+    oh = jax.nn.one_hot(Xb.astype(jnp.int32), n_bins, dtype=dt)
+    return oh.reshape(n, -1)
+
+
 def grad_onehot(Xb, gh, n_bins: int) -> jax.Array:
     """Shared RHS of the level-histogram matmul: [n, c1*d*B] where entry
     (r, c*d*B + j*B + b) = gh[r, c] * 1[bin(r, j) == b].
@@ -512,6 +525,237 @@ def predict_tree(Xb, tree: Tree, max_depth: int) -> jax.Array:
 
 
 # ---------------------------------------------------------------------------
+# Batch-native level grower — the whole tree chunk in ONE flat GEMM per level
+#
+# Round-5 measurement (tools/probe_hist_mm.py, v5e): the vmapped per-tree
+# histogram contraction ([m, n] @ [n, c1*d*B] batched over ~600 trees) runs
+# at ~2 TFLOP/s, while the SAME reduction flattened to a single
+# [T*m, n] @ [n, c1*d*B] GEMM runs at ~28 TFLOP/s — XLA lowers the big-M
+# 2-D GEMM onto the MXU 14x better than the small-M batched-GEMM.  So the
+# forest kernels grow their whole chunk with an explicit tree axis: slot
+# one-hots are built [T, m, n] (slot axis ahead of rows: no transpose before
+# the flatten) and every level runs one flat GEMM.
+# ---------------------------------------------------------------------------
+def _grow_level_batch(Xb, gh, w_t, feat_mask_t, nodes, leaf_val, slot_base,
+                      next_free, n_active, row_slot, row_node, m: int,
+                      next_cap: int, n_bins: int, reg_lambda_t, gamma_t,
+                      mcw_t, mig_t, Og, exact_cap: bool,
+                      gh_t=None, Obin=None):
+    """One breadth-first level for a BATCH of T trees (shared Xb).
+
+    Same split math as ``_grow_level`` (see its docstring for the
+    scatter/gather-free design); shapes carry a leading tree axis:
+    w_t f32[T, n], feat_mask_t f32[T, d], nodes i32[T, P, 4],
+    leaf_val f32[T, P, c], n_active i32[T], row_slot/row_node i32[T, n],
+    per-tree hyperparameters f32[T].  Two GEMM layouts:
+
+    - SHARED gradients (forests: every tree of the sweep sees the same
+      g/h): ``gh`` f32[n, c1] + ``Og = grad_onehot(...)`` — LHS is the
+      weighted slot one-hot [T*m, n], RHS carries the gradients.
+    - PER-TREE gradients (boosting: each batch element has its own margins
+      F): ``gh_t`` f32[T, n, c1] + ``Obin = bin_onehot(...)`` — gradients
+      ride the LHS ([T*m*c1, n]), the RHS is the gradient-free bin one-hot
+      built once per LAUNCH instead of once per round.
+
+    The segment-sum fallback stays on the vmapped ``grow_tree``.
+    """
+    B = n_bins
+    n, d = Xb.shape
+    c = (gh.shape[1] if gh_t is None else gh_t.shape[2]) - 1
+    T = w_t.shape[0]
+    iota_m = jnp.arange(m)
+    in_use = iota_m[None, :] < n_active[:, None]                    # [T, m]
+    # slot one-hot with slot axis BEFORE rows: flattening needs no transpose
+    S = (row_slot[:, None, :] == iota_m[None, :, None]).astype(jnp.float32)
+    Sw = S * w_t[:, None, :]                                        # [T, m, n]
+    if gh_t is None:
+        GH = lax.dot_general(Sw.reshape(T * m, n).astype(Og.dtype), Og,
+                             (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    else:
+        # [T, m, c1, n]: slot one-hot x per-tree weighted gradients
+        L = Sw[:, :, None, :] * gh_t.transpose(0, 2, 1)[:, None, :, :]
+        GH = lax.dot_general(L.reshape(T * m * (c + 1), n).astype(Obin.dtype),
+                             Obin, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    GH = GH.reshape(T, m, c + 1, d, B)
+    G, H = GH[:, :, :c], GH[:, :, c]                # [T,m,c,d,B], [T,m,d,B]
+    GT = G[:, :, :, 0, :].sum(axis=-1)              # [T, m, c]
+    HT = H[:, :, 0, :].sum(axis=-1)                 # [T, m]
+
+    GL = jnp.cumsum(G, axis=-1)
+    HL = jnp.cumsum(H, axis=-1)
+    GR = GT[:, :, :, None, None] - GL
+    HR = HT[:, :, None, None] - HL
+
+    lam = reg_lambda_t[:, None, None, None]
+
+    def score(Gp, Hp):
+        return (Gp * Gp).sum(axis=2) / (Hp + lam)
+
+    gain = score(GL, HL) + score(GR, HR) \
+        - ((GT * GT).sum(axis=2) / (HT + reg_lambda_t[:, None]))[:, :, None, None]
+    valid = (HL >= mcw_t[:, None, None, None]) & (HR >= mcw_t[:, None, None, None])
+    valid &= feat_mask_t[:, None, :, None] > 0.0
+    valid &= jnp.arange(B)[None, None, None, :] < B - 1
+    gain = jnp.where(valid, gain, -jnp.inf)
+    flat = gain.reshape(T, m, d * B)
+    best = jnp.argmax(flat, axis=-1)                                # [T, m]
+    best_gain = jnp.max(flat, axis=-1)
+    bf = (best // B).astype(jnp.int32)
+    bb = (best % B).astype(jnp.int32)
+    do_split = (best_gain > gamma_t[:, None]) \
+        & (best_gain >= mig_t[:, None] * HT) & in_use
+    half = next_cap // 2
+    if next_cap < 2 * m and not exact_cap:
+        key = jnp.where(do_split, -best_gain, jnp.inf)
+        rank = jnp.argsort(jnp.argsort(key, axis=1), axis=1)
+        do_split &= rank < half
+        k = jnp.cumsum(do_split.astype(jnp.int32), axis=1)
+    else:
+        k = jnp.cumsum(do_split.astype(jnp.int32), axis=1)
+        if next_cap < 2 * m:
+            do_split &= k <= half
+            k = jnp.minimum(k, half)
+    n_split = k[:, -1]
+    child_idx = (k - 1) * 2
+    left_pool = next_free + child_idx
+    right_pool = left_pool + 1
+    rec = jnp.stack([jnp.where(do_split, bf, -1),
+                     jnp.where(do_split, bb, 0),
+                     jnp.where(do_split, left_pool, 0),
+                     jnp.where(do_split, right_pool, 0)], axis=-1)  # [T, m, 4]
+    nodes = lax.dynamic_update_slice(nodes, rec, (0, slot_base, 0))
+    onehot_best = jax.nn.one_hot(best, d * B, dtype=GL.dtype)       # [T, m, dB]
+    GL_best = jnp.einsum("tmcx,tmx->tmc", GL.reshape(T, m, c, d * B),
+                         onehot_best)
+    HL_best = jnp.einsum("tmx,tmx->tm", HL.reshape(T, m, d * B), onehot_best)
+    GR_best = GT - GL_best
+    HR_best = HT - HL_best
+    lval = jnp.where(do_split[:, :, None],
+                     -GL_best / (HL_best + reg_lambda_t[:, None])[:, :, None], 0.0)
+    rval = jnp.where(do_split[:, :, None],
+                     -GR_best / (HR_best + reg_lambda_t[:, None])[:, :, None], 0.0)
+    iota_cap = jnp.arange(next_cap)
+    pos_l = jnp.where(do_split, child_idx, -1)
+    pos_r = jnp.where(do_split, child_idx + 1, -1)
+    L_eq = (iota_cap[None, :, None] == pos_l[:, None, :]).astype(leaf_val.dtype)
+    R_eq = (iota_cap[None, :, None] == pos_r[:, None, :]).astype(leaf_val.dtype)
+    child_vals = jnp.einsum("tpm,tmc->tpc", L_eq, lval) \
+        + jnp.einsum("tpm,tmc->tpc", R_eq, rval)          # [T, next_cap, c]
+    leaf_val = lax.dynamic_update_slice(leaf_val, child_vals, (0, next_free, 0))
+    # route rows: per-row slot data via the S matmul (gathers serialize)
+    pack = jnp.concatenate(
+        [do_split.astype(jnp.float32)[:, :, None],
+         bb.astype(jnp.float32)[:, :, None],
+         child_idx.astype(jnp.float32)[:, :, None],
+         jax.nn.one_hot(bf, d, dtype=jnp.float32)], axis=-1)        # [T, m, 3+d]
+    routed = jnp.einsum("tmn,tmp->tnp", S, pack)                    # [T, n, 3+d]
+    splits_here = routed[:, :, 0] > 0.5
+    child_r = routed[:, :, 2].astype(jnp.int32)
+    row_bin = (routed[:, :, 3:] * Xb[None, :, :]).sum(axis=-1)
+    go_right = (row_bin > routed[:, :, 1]).astype(jnp.int32)
+    new_row_slot = jnp.where(splits_here, child_r + go_right, -1)
+    row_node = jnp.where(splits_here, next_free + child_r + go_right, row_node)
+    return nodes, leaf_val, 2 * n_split, new_row_slot, row_node
+
+
+def grow_forest(Xb, g, h, w_t, feat_mask_t, max_depth: int, n_bins: int,
+                frontier: int, reg_lambda_t, gamma_t, mcw_t, mig_t,
+                exact_cap: bool = False, return_row_node: bool = False,
+                gh_t=None, Obin=None):
+    """Grow T trees together; ONE flat GEMM per level (see header note).
+
+    Shared: Xb int[n, d].  Gradients either SHARED (g f32[n, c], h f32[n] —
+    forests) or PER TREE (``gh_t`` f32[T, n, c1] with ``Obin =
+    bin_onehot(Xb, n_bins)``; pass g/h as None — boosting).  Per tree:
+    w_t f32[T, n], feat_mask_t f32[T, d], reg_lambda/gamma/mcw/mig f32[T].
+    Falls back to ``vmap(grow_tree)`` when the matmul histogram path is off
+    (CPU).  Returns Tree with leading [T] axis (+ row_node on request).
+    """
+    Xb = Xb.astype(jnp.int32)
+    n, d = Xb.shape
+    c = (g.shape[1] if gh_t is None else gh_t.shape[2] - 1)
+    c1 = c + 1
+    T = w_t.shape[0]
+    if not _hist_via_matmul(n, d, n_bins, c1):
+        if gh_t is None:
+            def one(wt, fm, lam, gam, mcw, mig):
+                return grow_tree(Xb, g, h, wt, fm, max_depth, n_bins,
+                                 frontier, reg_lambda=lam, gamma=gam,
+                                 min_child_weight=mcw, min_info_gain=mig,
+                                 Og=None, return_row_node=return_row_node,
+                                 exact_cap=exact_cap)
+
+            return jax.vmap(one)(w_t, feat_mask_t, reg_lambda_t, gamma_t,
+                                 mcw_t, mig_t)
+
+        def one(ght, wt, fm, lam, gam, mcw, mig):
+            return grow_tree(Xb, ght[:, :c], ght[:, c], wt, fm, max_depth,
+                             n_bins, frontier, reg_lambda=lam, gamma=gam,
+                             min_child_weight=mcw, min_info_gain=mig,
+                             Og=None, return_row_node=return_row_node,
+                             exact_cap=exact_cap)
+
+        return jax.vmap(one)(gh_t, w_t, feat_mask_t, reg_lambda_t, gamma_t,
+                             mcw_t, mig_t)
+    if gh_t is None:
+        gh = jnp.concatenate([g, h[:, None]], axis=1)
+        Og = grad_onehot(Xb, gh, n_bins)
+        Obin = None
+        gw_sum = (g[None, :, :] * w_t[:, :, None]).sum(axis=1)      # [T, c]
+        hw_sum = (h[None, :] * w_t).sum(axis=1)                     # [T]
+    else:
+        gh = None
+        Og = None
+        if Obin is None:
+            Obin = bin_onehot(Xb, n_bins)
+        gw_sum = (gh_t[:, :, :c] * w_t[:, :, None]).sum(axis=1)
+        hw_sum = (gh_t[:, :, c] * w_t).sum(axis=1)
+    P = _pool_size(max_depth, frontier)
+    root_val = -gw_sum / (hw_sum + reg_lambda_t)[:, None]
+    nodes = jnp.tile(jnp.asarray([-1, 0, 0, 0], jnp.int32), (T, P, 1))
+    leaf_val = jnp.zeros((T, P, c), jnp.float32).at[:, 0].set(root_val)
+    row_node = jnp.zeros((T, n), jnp.int32)
+
+    def as_tree(nodes, leaf_val):
+        return Tree(split_feat=nodes[:, :, 0], split_bin=nodes[:, :, 1],
+                    left=nodes[:, :, 2], right=nodes[:, :, 3],
+                    leaf_val=leaf_val)
+
+    if max_depth <= 0:
+        tree = as_tree(nodes, leaf_val)
+        return (tree, row_node) if return_row_node else tree
+
+    M = frontier
+    L = M.bit_length() - 1
+    carry = (nodes, leaf_val, jnp.ones((T,), jnp.int32),
+             jnp.zeros((T, n), jnp.int32), row_node)
+    u = min(max_depth, L)
+    for t in range(u):
+        carry = _grow_level_batch(
+            Xb, gh, w_t, feat_mask_t, carry[0], carry[1], (1 << t) - 1,
+            (1 << (t + 1)) - 1, *carry[2:], m=1 << t, next_cap=1 << (t + 1),
+            n_bins=n_bins, reg_lambda_t=reg_lambda_t, gamma_t=gamma_t,
+            mcw_t=mcw_t, mig_t=mig_t, Og=Og, exact_cap=exact_cap,
+            gh_t=gh_t, Obin=Obin)
+    if max_depth > L:
+        def body(t, carry):
+            sb = M - 1 + (t - L) * M
+            return _grow_level_batch(
+                Xb, gh, w_t, feat_mask_t, carry[0], carry[1], sb, sb + M,
+                *carry[2:], m=M, next_cap=M, n_bins=n_bins,
+                reg_lambda_t=reg_lambda_t, gamma_t=gamma_t, mcw_t=mcw_t,
+                mig_t=mig_t, Og=Og, exact_cap=exact_cap,
+                gh_t=gh_t, Obin=Obin)
+
+        carry = lax.fori_loop(L, max_depth, body, carry)
+    nodes, leaf_val, row_node = carry[0], carry[1], carry[4]
+    tree = as_tree(nodes, leaf_val)
+    return (tree, row_node) if return_row_node else tree
+
+
+# ---------------------------------------------------------------------------
 # Random forest — vmap over trees
 # ---------------------------------------------------------------------------
 @functools.partial(jax.jit, static_argnames=("max_depth", "n_bins", "frontier",
@@ -526,19 +770,14 @@ def fit_forest(Xb, g, h, w_trees, feat_masks, max_depth: int, n_bins: int,
     Returns Tree with leading tree axis.
     """
 
-    n, d = Xb.shape
-    c1 = g.shape[1] + 1
-    Og = (grad_onehot(Xb, jnp.concatenate([g, h[:, None]], axis=1), n_bins)
-          if _hist_via_matmul(n, d, n_bins, c1) else None)
-
-    def one(wt, fm):
-        return grow_tree(Xb, g, h, wt, fm, max_depth, n_bins, frontier,
-                         reg_lambda=reg_lambda, gamma=0.0,
-                         min_child_weight=min_child_weight,
-                         min_info_gain=min_info_gain, Og=Og,
-                         exact_cap=exact_cap)
-
-    return jax.vmap(one)(w_trees, feat_masks)
+    T = w_trees.shape[0]
+    return grow_forest(Xb, g, h, w_trees, feat_masks, max_depth, n_bins,
+                       frontier,
+                       reg_lambda_t=jnp.full(T, reg_lambda, jnp.float32),
+                       gamma_t=jnp.zeros(T, jnp.float32),
+                       mcw_t=jnp.full(T, min_child_weight, jnp.float32),
+                       mig_t=jnp.full(T, min_info_gain, jnp.float32),
+                       exact_cap=exact_cap)
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth",))
@@ -549,13 +788,25 @@ def predict_forest(Xb, forest: Tree, max_depth: int) -> jax.Array:
 
 
 def forest_chunk_size(max_depth: int, n_bins: int, d: int, c: int,
-                      frontier: int, budget_bytes: float = 1.5e9) -> int:
-    """Trees per chunk so one chunk's level histograms fit the budget.
+                      frontier: int, budget_bytes: float = 1.5e9,
+                      n_rows: int = 0) -> int:
+    """Trees per chunk so one chunk's level tensors fit the budget.
 
-    A level materializes G [M, d, B, c] + cumsums per tree; the x3 covers
-    the cumsum/gain temporaries."""
-    per_tree = frontier * n_bins * d * (c + 1) * 4 * 3
+    A level materializes G [M, d, B, c] + cumsums per tree (x3 covers the
+    cumsum/gain temporaries) plus, on the batch-GEMM path, the slot one-hot
+    [M, n] and its weighted flattening (the ``2 * n_rows`` term)."""
+    per_tree = frontier * (n_bins * d * (c + 1) * 3 + 2 * n_rows) * 4
     return max(1, int(budget_bytes / max(per_tree, 1)))
+
+
+def balanced_chunk(total: int, chunk_max: int) -> int:
+    """Even chunk size: ceil-divide ``total`` into the fewest chunks that
+    respect ``chunk_max``, then size chunks evenly so zero-weight padding is
+    at most ``n_chunks - 1`` trees (a naive min(total, chunk_max) padded a
+    900-tree group to 2 x 635 = 41% waste — round-5 profile)."""
+    total = max(int(total), 1)
+    n_chunks = -(-total // max(int(chunk_max), 1))
+    return -(-total // n_chunks)
 
 
 @functools.partial(jax.jit,
@@ -578,20 +829,14 @@ def fit_forest_chunked(Xb, g, h, w_trees, feat_masks, mcw_trees, max_depth: int,
     d = Xb.shape[1]
     if mig_trees is None:
         mig_trees = jnp.zeros_like(mcw_trees)
-    c1 = g.shape[1] + 1
-    Og = (grad_onehot(Xb, jnp.concatenate([g, h[:, None]], axis=1), n_bins)
-          if _hist_via_matmul(n, d, n_bins, c1) else None)
 
     def one_chunk(args):
         wts, fms, mcws, migs = args
-
-        def one(wt, fm, mcw, mig):
-            return grow_tree(Xb, g, h, wt, fm, max_depth, n_bins, frontier,
-                             reg_lambda=reg_lambda, gamma=0.0,
-                             min_child_weight=mcw, min_info_gain=mig,
-                             Og=Og, exact_cap=exact_cap)
-
-        return jax.vmap(one)(wts, fms, mcws, migs)
+        lam = jnp.full(wts.shape[0], reg_lambda, jnp.float32)
+        gam = jnp.zeros(wts.shape[0], jnp.float32)
+        return grow_forest(Xb, g, h, wts, fms, max_depth, n_bins, frontier,
+                           reg_lambda_t=lam, gamma_t=gam, mcw_t=mcws,
+                           mig_t=migs, exact_cap=exact_cap)
 
     trees = lax.map(one_chunk, (w_trees.reshape(-1, chunk, n),
                                 feat_masks.reshape(-1, chunk, d),
@@ -738,15 +983,61 @@ def fit_gbt_batch(Xb, y, w_batch, row_w_rounds, feat_mask_rounds, loss: str,
     if min_info_gain_b is None:
         min_info_gain_b = jnp.zeros(w_batch.shape[0], jnp.float32)
 
-    def one(w, eta, lam, gam, mcw, base, mig):
-        _, F = _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss,
-                         n_rounds, max_depth, n_bins, frontier, eta, lam, gam,
-                         mcw, base, n_classes, min_info_gain=mig,
-                         exact_cap=exact_cap)
-        return F
+    Xb = Xb.astype(jnp.int32)
+    n, d = Xb.shape
+    B = w_batch.shape[0]
+    c = n_classes if loss == "softmax" else 1
+    if not _hist_via_matmul(n, d, n_bins, c + 1):
+        # segment-sum backends keep the per-element vmap formulation
+        def one(w, eta, lam, gam, mcw, base, mig):
+            _, F = _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss,
+                             n_rounds, max_depth, n_bins, frontier, eta, lam,
+                             gam, mcw, base, n_classes, min_info_gain=mig,
+                             exact_cap=exact_cap)
+            return F
 
-    return jax.vmap(one)(w_batch, eta_b, reg_lambda_b, gamma_b,
-                         min_child_weight_b, base_score_b, min_info_gain_b)
+        return jax.vmap(one)(w_batch, eta_b, reg_lambda_b, gamma_b,
+                             min_child_weight_b, base_score_b, min_info_gain_b)
+
+    # batch-native boosting: every round grows its B trees as ONE
+    # flat-GEMM forest (per-tree gradients ride the LHS); the gradient-free
+    # bin one-hot RHS is built ONCE for the whole launch instead of per
+    # round (see bin_onehot / _grow_level_batch)
+    Y = jax.nn.one_hot(y.astype(jnp.int32), max(c, 2), dtype=jnp.float32) \
+        if loss == "softmax" else jnp.zeros((n, 2), jnp.float32)
+    Obin = bin_onehot(Xb, n_bins)
+    F0 = jnp.broadcast_to(base_score_b[:, None, None], (B, n, c)).astype(jnp.float32)
+
+    def round_fn(F, xs):
+        rw, fmr = xs                                   # [n], [d] shared
+        if loss == "squared":
+            gb = F[..., 0] - y[None, :]
+            hb = jnp.ones((B, n), jnp.float32)
+            g3 = gb[..., None]
+        elif loss == "logistic":
+            p = jax.nn.sigmoid(F[..., 0])
+            g3 = (p - y[None, :])[..., None]
+            hb = jnp.maximum(p * (1 - p), 1e-6)
+        else:  # softmax
+            p = jax.nn.softmax(F, axis=-1)
+            g3 = p - Y[None, :, :]
+            hb = jnp.maximum((p * (1 - p)).mean(axis=-1), 1e-6)
+        gh_t = jnp.concatenate([g3, hb[..., None]], axis=-1)   # [B, n, c1]
+        tree, row_node = grow_forest(
+            Xb, None, None, w_batch * rw[None, :],
+            jnp.broadcast_to(fmr[None, :], (B, d)), max_depth, n_bins,
+            frontier, reg_lambda_t=reg_lambda_b, gamma_t=gamma_b,
+            mcw_t=min_child_weight_b, mig_t=min_info_gain_b,
+            exact_cap=exact_cap, return_row_node=True,
+            gh_t=gh_t, Obin=Obin)
+        # leaf lookup via one gather per round (row_node tracks leaves)
+        leaves = jnp.take_along_axis(
+            tree.leaf_val, row_node[:, :, None].repeat(c, axis=2), axis=1)
+        F = F + eta_b[:, None, None] * leaves
+        return F, None
+
+    F, _ = lax.scan(round_fn, F0, (row_w_rounds, feat_mask_rounds))
+    return F
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth",))
@@ -758,36 +1049,47 @@ def predict_gbt(Xb, trees: Tree, max_depth: int, eta: float,
 
 
 # ---------------------------------------------------------------------------
-# Host-side helpers for subsampling masks
+# Subsampling masks — DEVICE-side RNG (threefry: identical draws on every
+# backend).  These are traceable and run INSIDE the fit kernels, so the
+# sweep never uploads [T, n] bootstrap matrices over the wire (measured
+# ~70 ms per device_put on a tunneled backend — round-5 latency probe).
+# fit_arrays and the fused sweep interpreter share the same (seed -> key ->
+# draw) scheme, so the batched fold x grid path trains on EXACTLY the same
+# bootstraps as the per-candidate loop path (tests/test_batched_tree_sweep).
 # ---------------------------------------------------------------------------
-def bootstrap_weights(n: int, n_trees: int, rng: np.random.Generator,
-                      bootstrap: bool = True, rate: float = 1.0) -> np.ndarray:
+def rng_keys(seed: int):
+    """(bootstrap_key, feature_key) — the canonical split both paths use."""
+    kb, kf = jax.random.split(jax.random.PRNGKey(jnp.uint32(seed)))
+    return kb, kf
+
+
+def bootstrap_weights(key, n: int, n_trees: int, bootstrap: bool = True,
+                      rate: float = 1.0) -> jax.Array:
     """Poisson(rate) bootstrap weights — the with-replacement limit Spark's
     BaggedPoint uses, with ``rate`` = RF subsamplingRate (each tree sees a
-    bootstrap of expected size ``n * rate``)."""
+    bootstrap of expected size ``n * rate``).  Traceable."""
     if not bootstrap:
-        return np.ones((n_trees, n), np.float32)
-    return rng.poisson(rate, size=(n_trees, n)).astype(np.float32)
+        return jnp.ones((n_trees, n), jnp.float32)
+    return jax.random.poisson(key, rate, (n_trees, n)).astype(jnp.float32)
 
 
-def feature_masks(d: int, n_trees: int, frac: float,
-                  rng: np.random.Generator) -> np.ndarray:
+def feature_masks(key, d: int, n_trees: int, frac: float) -> jax.Array:
     """Per-tree feature-subset masks (featureSubsetStrategy / colsample):
-    exactly k features per tree via a random-key threshold (vectorized)."""
+    exactly k features per tree via a random-key threshold.  Traceable."""
     if frac >= 1.0:
-        return np.ones((n_trees, d), np.float32)
+        return jnp.ones((n_trees, d), jnp.float32)
     k = max(1, int(round(frac * d)))
-    r = rng.random((n_trees, d))
-    thresh = np.partition(r, k - 1, axis=1)[:, k - 1: k]
-    return (r <= thresh).astype(np.float32)
+    r = jax.random.uniform(key, (n_trees, d))
+    thresh = jnp.sort(r, axis=1)[:, k - 1: k]
+    return (r <= thresh).astype(jnp.float32)
 
 
-def subsample_weights(n: int, n_rounds: int, frac: float,
-                      rng: np.random.Generator) -> np.ndarray:
-    """Per-round row-subsample masks (GBT subsamplingRate / XGB subsample)."""
+def subsample_weights(key, n: int, n_rounds: int, frac: float) -> jax.Array:
+    """Per-round row-subsample masks (GBT subsamplingRate / XGB subsample).
+    Traceable."""
     if frac >= 1.0:
-        return np.ones((n_rounds, n), np.float32)
-    return (rng.random((n_rounds, n)) < frac).astype(np.float32)
+        return jnp.ones((n_rounds, n), jnp.float32)
+    return (jax.random.uniform(key, (n_rounds, n)) < frac).astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------

@@ -17,9 +17,13 @@ formulation:
   (raw second moments over 10M rows would not be),
 - accumulators live on device replicated; one tiny d2h at finalize.
 
-Spearman over streams needs a global rank transform; the streaming path is
-Pearson-only (the reference default).  Sampled Spearman stays available via
-utils/stats.correlations_with_label.
+Spearman needs a GLOBAL rank transform first (Spark Statistics.corr
+"spearman" sorts each column cluster-wide, SanityChecker.scala:406-466);
+here ``rank_transform`` computes per-column midranks on device in column
+blocks (sort + two searchsorteds — ties averaged exactly like
+utils/stats._rank_data), then the SAME streaming Pearson passes run over
+the ranks, whose mean is exactly (n+1)/2.  Sampled Spearman stays available
+via utils/stats.correlations_with_label.
 """
 from __future__ import annotations
 
@@ -168,18 +172,59 @@ def chunked(X: np.ndarray, y: Optional[np.ndarray] = None,
     return gen_xy
 
 
+@jax.jit
+def _midrank_cols(Xb):
+    """Per-column average-tie midranks (1-based): f32[n, k] -> f32[n, k]."""
+
+    def one(col):
+        order = jnp.argsort(col)
+        ss = col[order]
+        lo = jnp.searchsorted(ss, ss, side="left")
+        hi = jnp.searchsorted(ss, ss, side="right")
+        mid = (lo + hi + 1).astype(jnp.float32) * 0.5
+        return jnp.zeros_like(mid).at[order].set(mid)
+
+    return jax.vmap(one, in_axes=1, out_axes=1)(Xb)
+
+
+def rank_transform(X: np.ndarray, block_cols: int = 128) -> np.ndarray:
+    """Global average-tie ranks per column, computed on device in column
+    blocks (the Spearman prep; parity with utils/stats._rank_data)."""
+    X = np.asarray(X, np.float32)
+    if X.ndim == 1:
+        return rank_transform(X[:, None], block_cols)[:, 0]
+    n, d = X.shape
+    out = np.empty((n, d), np.float32)
+    for lo in range(0, d, block_cols):
+        blk = np.ascontiguousarray(X[:, lo:lo + block_cols])
+        out[:, lo:lo + block_cols] = np.asarray(_midrank_cols(jnp.asarray(blk)))
+    return out
+
+
 def sharded_correlations(X: np.ndarray, y: np.ndarray, mesh=None,
                          with_corr_matrix: bool = True,
-                         chunk_rows: int = 1 << 18
+                         chunk_rows: int = 1 << 18, method: str = "pearson"
                          ) -> Tuple[ColStats, np.ndarray, Optional[np.ndarray]]:
-    """Drop-in large-data Pearson path for SanityChecker: two sharded
-    streaming passes over row chunks.  Returns (col_stats, corr_with_label,
-    corr_matrix|None) matching utils/stats.correlations_with_label."""
+    """Drop-in large-data correlation path for SanityChecker: two sharded
+    streaming passes over row chunks.  ``method`` "spearman" rank-transforms
+    every column on device first (one extra [n, d] f32 materialization) and
+    streams Pearson over the ranks; column stats are always raw-space.
+    Returns (col_stats, corr_with_label, corr_matrix|None) matching
+    utils/stats.correlations_with_label."""
+    n = X.shape[0]
     acc = DataShardedStats(X.shape[1], mesh=mesh)
     stats = acc.moments(chunked(X, chunk_rows=chunk_rows)())
-    y64 = np.asarray(y, np.float64)
-    y_mean = float(y64.mean()) if len(y64) else 0.0
+    if method == "spearman":
+        Xc = rank_transform(X)
+        yc = rank_transform(np.asarray(y, np.float32))
+        mean_c = np.full(X.shape[1], (n + 1) / 2.0)  # midrank mean, exact
+        y_mean = (n + 1) / 2.0
+    else:
+        Xc, yc = X, y
+        mean_c = stats.mean
+        y64 = np.asarray(y, np.float64)
+        y_mean = float(y64.mean()) if len(y64) else 0.0
     corr_label, corr_matrix = acc.correlations_from(
-        chunked(X, y, chunk_rows=chunk_rows), stats.mean, y_mean,
+        chunked(Xc, yc, chunk_rows=chunk_rows), mean_c, y_mean,
         with_corr_matrix=with_corr_matrix)
     return stats, corr_label, corr_matrix

@@ -1,0 +1,88 @@
+"""Device-residency cache for host arrays (and derived binned variants).
+
+Motivation (round-5 perf work): on a tunneled TPU backend every
+host->device transfer pays tens of milliseconds of wire latency, and the
+selector sweep used to re-upload the SAME feature matrix once per model
+family per rep (plus re-quantize it per tree group).  This cache keys device
+buffers by the identity of the host ``np.ndarray`` so X / y / binned-X
+upload once and every family reuses the resident buffer.
+
+A weakref on the source array evicts its entry when the array dies, so the
+cache cannot leak past the data's lifetime and a recycled ``id()`` can never
+serve another array's buffers (the eviction callback runs before the id can
+be reused).  Arrays that refuse weakrefs are simply not cached.
+
+Caveat (documented contract): callers must not MUTATE a cached array in
+place — the framework's columnar pipeline never does (transforms build new
+arrays).
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+_entries: Dict[int, Dict[str, Any]] = {}
+
+
+def _slot(arr: np.ndarray) -> Optional[Dict[Any, Any]]:
+    """The per-array cache dict (derived products keyed by caller tags), or
+    None when the array cannot be weakref'd (then nothing is cached)."""
+    key = id(arr)
+    ent = _entries.get(key)
+    if ent is not None:
+        return ent["products"]
+    try:
+        ref = weakref.ref(arr, lambda _r, k=key: _entries.pop(k, None))
+    except TypeError:  # exotic ndarray subclass without weakref support
+        return None
+    products: Dict[Any, Any] = {}
+    _entries[key] = {"_ref": ref, "products": products}
+    return products
+
+
+def device_array(arr, dtype=None, tag: str = "base"):
+    """Device-resident copy of ``arr`` (cached by host-array identity).
+
+    Already-on-device jax arrays pass through untouched.  ``tag`` separates
+    derived variants (e.g. different dtypes) of the same host array.
+    """
+    import jax.numpy as jnp
+
+    def build():
+        return jnp.asarray(arr) if dtype is None \
+            else jnp.asarray(np.asarray(arr, dtype))
+
+    if not isinstance(arr, np.ndarray):  # jax array (or scalar): no caching
+        return jnp.asarray(arr) if dtype is None else jnp.asarray(arr, dtype)
+    products = _slot(arr)
+    if products is None:
+        return build()
+    key = (tag, None if dtype is None else np.dtype(dtype).str)
+    dev = products.get(key)
+    if dev is None:
+        dev = build()
+        products[key] = dev
+    return dev
+
+
+def derived(arr: np.ndarray, key: Tuple, build) -> Any:
+    """Cached derived product of ``arr`` (e.g. quantized bins + edges).
+
+    ``build()`` is called once per (array identity, key); its result is
+    cached for the array's lifetime.  Uncacheable arrays just rebuild.
+    """
+    products = _slot(arr)
+    if products is None:
+        return build()
+    k = ("derived",) + key
+    out = products.get(k)
+    if out is None:
+        out = build()
+        products[k] = out
+    return out
+
+
+def clear() -> None:
+    _entries.clear()
