@@ -5,4 +5,5 @@ Run as modules from the repo root (or after ``pip install -e .``):
     python -m helloworld.titanic --run-type train --model-location /tmp/titanic_model
     python -m helloworld.iris
     python -m helloworld.boston
+    python -m helloworld.dataprep
 """

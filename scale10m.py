@@ -217,7 +217,7 @@ def main():
         out["backend_fallback"] = fallback
     print(json.dumps(out))
     with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "SCALE_r04.json"), "w") as f:
+                           "SCALE_r05.json"), "w") as f:
         json.dump(out, f, indent=1)
 
 

@@ -50,11 +50,18 @@ def _summaries(validator_cls, evaluator, cands, X, y, **kw):
 
 
 def test_binary_fused_matches_legacy(data):
+    from transmogrifai_tpu.impl.classification.mlp import \
+        OpMultilayerPerceptronClassifier
+    from transmogrifai_tpu.impl.classification.svc import OpLinearSVC
+
     X, y, _ = data
     cands = [
         (OpLogisticRegression(),
          [{"reg_param": 0.01, "elastic_net_param": 0.1},
           {"reg_param": 0.1, "elastic_net_param": 0.0}]),
+        (OpLinearSVC(max_iter=50), [{"reg_param": 0.01}, {"reg_param": 0.1}]),
+        (OpMultilayerPerceptronClassifier(hidden_layers=(4,), max_iter=25),
+         [{"step_size": 0.03}, {"step_size": 0.1, "seed": 7}]),
         (OpRandomForestClassifier(num_trees=10),
          # two candidates share the depth-3 static group (the default grid's
          # Gc=6 shape: broadcast across the candidate axis must be explicit)

@@ -1,0 +1,67 @@
+"""Model-quality parity with the reference's published example results
+(round-4 VERDICT weak #6 / next #8).
+
+The reference's OpTitanicSimple run reports HOLDOUT AuROC 0.8822 and Error
+0.1644 (/root/reference/README.md:82-96, default binary selector sweep).
+This asserts our full default sweep on the same data lands in the same
+ballpark: AuROC >= 0.86, Error <= 0.19 — not a lucky in-sample fit.
+Iris / Boston get comparable sanity bars (the reference publishes no
+numbers for them; bars are set a few points under our measured results).
+"""
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.readers import DataReaders
+
+TITANIC = "/root/reference/test-data/PassengerDataAllWithHeader.csv"
+pytestmark = pytest.mark.skipif(not os.path.exists(TITANIC),
+                                reason="reference Titanic data not present")
+
+
+def _selector_summary(model):
+    for st in model.stages:
+        s = getattr(st, "summary", None)
+        if s is not None and getattr(s, "holdout_evaluation", None) is not None:
+            return s
+    raise AssertionError("no selector holdout evaluation found")
+
+
+def test_titanic_holdout_matches_reference():
+    from helloworld.titanic import build_workflow, titanic_data
+
+    wf, pred = build_workflow()
+    wf.set_reader(DataReaders.Simple.custom(titanic_data(), key="PassengerId"))
+    model = wf.train()
+    s = _selector_summary(model)
+    ho = s.holdout_evaluation
+    # reference holdout: AuROC 0.8822, Error 0.1644 (README.md:82-96)
+    assert ho["AuROC"] >= 0.86, ho["AuROC"]
+    assert ho["Error"] <= 0.19, ho["Error"]
+    # training-set metrics in the same ballpark as the reference's 0.8767
+    tr = s.train_evaluation
+    assert tr["AuROC"] >= 0.84, tr["AuROC"]
+
+
+def test_iris_holdout_quality():
+    from helloworld.iris import build_workflow, iris_data
+
+    wf, pred = build_workflow()
+    wf.set_reader(DataReaders.Simple.custom(iris_data(), key=None))
+    model = wf.train()
+    s = _selector_summary(model)
+    assert s.holdout_evaluation["F1"] >= 0.85, s.holdout_evaluation
+
+
+def test_boston_holdout_quality():
+    from helloworld.boston import build_workflow, boston_data
+
+    wf, pred = build_workflow()
+    wf.set_reader(DataReaders.Simple.custom(boston_data(), key=None))
+    model = wf.train()
+    s = _selector_summary(model)
+    rmse = s.holdout_evaluation["RootMeanSquaredError"]
+    y_sd = float(np.std(boston_data()["medv"]))
+    # a real model must beat predicting the mean by a wide margin
+    assert rmse <= 0.62 * y_sd, (rmse, y_sd)

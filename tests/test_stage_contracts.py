@@ -1,0 +1,493 @@
+"""Uniform stage-contract harness (round-4 VERDICT missing #6 / next #5).
+
+The reference subjects EVERY stage to OpTransformerSpec / OpEstimatorSpec
+(features/.../test/OpTransformerSpec.scala:53, OpEstimatorSpec.scala:55):
+batch output ≡ row-function output ≡ serialization round-trip, uniformly.
+This harness is the analog: it DISCOVERS every concrete Transformer /
+Estimator in ``transmogrifai_tpu.impl`` (+ features), feeds typed random
+testkit data per a declarative spec, and asserts
+
+  1. batch ``transform_columns`` ≡ per-row ``transform_row`` (on the fitted
+     model for estimators),
+  2. stage serialization round-trip (workflow/serialization encode→decode)
+     preserves the batch output exactly,
+
+for every stage — or the stage appears in EXEMPT with a written reason.
+A newly added stage with neither a spec nor an exemption FAILS the
+coverage test, so nothing silently skips the contract.
+"""
+from __future__ import annotations
+
+import importlib
+import inspect
+import pkgutil
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Type
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.columns import (Dataset, VectorColumn,
+                                       column_from_scalars)
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.features.metadata import (VectorColumnMetadata,
+                                                 VectorMetadata)
+from transmogrifai_tpu.stages.base import Estimator, Model, PipelineStage
+from transmogrifai_tpu.workflow import serialization as ser
+
+N = 24          # dataset rows
+N_ROW_CHECK = 6  # rows compared scalar-by-scalar
+
+# ---------------------------------------------------------------------------
+# typed random values
+# ---------------------------------------------------------------------------
+_WORDS = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta"]
+
+
+def _values(ftype, rng, no_null: bool = False):
+    def maybe_null(v):
+        return None if (not no_null and rng.random() < 0.2) else v
+
+    out = []
+    for i in range(N):
+        if issubclass(ftype, T.RealNN):
+            out.append(float(rng.normal()))
+        elif issubclass(ftype, (T.Currency, T.Percent, T.Real)):
+            out.append(maybe_null(float(rng.normal())))
+        elif issubclass(ftype, (T.Date, T.DateTime)):
+            out.append(maybe_null(int(rng.integers(1, 1_600_000_000_000))))
+        elif issubclass(ftype, T.Integral):
+            out.append(maybe_null(int(rng.integers(0, 50))))
+        elif issubclass(ftype, T.Binary):
+            out.append(maybe_null(bool(rng.random() < 0.5)))
+        elif issubclass(ftype, T.Email):
+            out.append(maybe_null(f"{_WORDS[i % 6]}@example.com"))
+        elif issubclass(ftype, T.URL):
+            out.append(maybe_null(f"https://www.{_WORDS[i % 6]}.org/x"))
+        elif issubclass(ftype, T.Phone):
+            out.append(maybe_null(f"+1415555{1000 + i:04d}"))
+        elif issubclass(ftype, T.Base64):
+            out.append(maybe_null("iVBORw0KGgo=" if i % 2 else "JVBERi0xLjQ="))
+        elif issubclass(ftype, (T.PickList, T.ComboBox, T.ID, T.TextArea,
+                                T.PostalCode, T.Street, T.City, T.State,
+                                T.Country, T.Text)):
+            out.append(maybe_null(_WORDS[int(rng.integers(0, 6))]))
+        elif issubclass(ftype, (T.DateList, T.DateTimeList)):
+            out.append([int(rng.integers(1, 1_600_000_000_000))
+                        for _ in range(int(rng.integers(0, 4)))])
+        elif issubclass(ftype, T.TextList):
+            out.append([_WORDS[int(rng.integers(0, 6))]
+                        for _ in range(int(rng.integers(0, 5)))])
+        elif issubclass(ftype, T.MultiPickList):
+            out.append({_WORDS[int(rng.integers(0, 4))]
+                        for _ in range(int(rng.integers(0, 3)))})
+        elif issubclass(ftype, T.Geolocation):
+            out.append(maybe_null([float(rng.uniform(-60, 60)),
+                                   float(rng.uniform(-170, 170)), 5.0]))
+        elif issubclass(ftype, T.GeolocationMap):
+            out.append({k: [float(rng.uniform(-60, 60)),
+                            float(rng.uniform(-170, 170)), 5.0]
+                        for k in _WORDS[: int(rng.integers(1, 3))]})
+        elif issubclass(ftype, T.MultiPickListMap):
+            out.append({k: {_WORDS[int(rng.integers(0, 4))]}
+                        for k in _WORDS[: int(rng.integers(1, 3))]})
+        elif issubclass(ftype, (T.RealMap, T.CurrencyMap, T.PercentMap)):
+            out.append({k: float(rng.normal())
+                        for k in _WORDS[: int(rng.integers(1, 4))]})
+        elif issubclass(ftype, T.IntegralMap):
+            out.append({k: int(rng.integers(0, 9))
+                        for k in _WORDS[: int(rng.integers(1, 4))]})
+        elif issubclass(ftype, T.BinaryMap):
+            out.append({k: bool(rng.random() < 0.5)
+                        for k in _WORDS[: int(rng.integers(1, 4))]})
+        elif issubclass(ftype, (T.TextMap, T.PickListMap, T.IDMap, T.EmailMap,
+                                T.URLMap)):
+            out.append({k: _WORDS[int(rng.integers(0, 6))]
+                        for k in _WORDS[: int(rng.integers(1, 4))]})
+        else:
+            raise NotImplementedError(f"no generator for {ftype.__name__}")
+    return out
+
+
+_VEC = object()      # sentinel: OPVector input
+_VEC_POS = object()  # sentinel: non-negative OPVector (NaiveBayes)
+_LABEL = object()    # sentinel: RealNN binary response
+
+
+def _build_dataset(input_spec, rng):
+    """(Dataset, features) for a spec of ftypes / _VEC / _LABEL entries."""
+    cols: Dict[str, Any] = {}
+    feats: List[Any] = []
+    keys = np.array([str(i) for i in range(N)], dtype=object)
+    for j, spec in enumerate(input_spec):
+        name = f"in_{j}"
+        if spec is _VEC or spec is _VEC_POS:
+            d = 4
+            vals = rng.normal(size=(N, d)).astype(np.float32)
+            if spec is _VEC_POS:
+                vals = np.abs(vals)
+            meta = VectorMetadata(name, tuple(
+                VectorColumnMetadata((f"f{k}",), ("Real",), index=k)
+                for k in range(d)))
+            cols[name] = VectorColumn(T.OPVector, vals, meta)
+            feats.append(FeatureBuilder(name, T.OPVector).from_field()
+                         .as_predictor())
+        elif spec is _LABEL:
+            y = (rng.random(N) < 0.5).astype(float)
+            cols[name] = column_from_scalars(T.RealNN,
+                                             [T.RealNN(v) for v in y])
+            feats.append(FeatureBuilder(name, T.RealNN).from_field()
+                         .as_response())
+        else:
+            vals = _values(spec, rng)
+            scalars = [v if isinstance(v, T.FeatureType) else T.make(spec, v)
+                       for v in vals]
+            cols[name] = column_from_scalars(spec, scalars)
+            feats.append(FeatureBuilder(name, spec).from_field()
+                         .as_predictor())
+    return Dataset(cols, keys), feats
+
+
+# ---------------------------------------------------------------------------
+# scalar equality
+# ---------------------------------------------------------------------------
+def _feq(a, b, atol=1e-5) -> bool:
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        a, b = np.asarray(a, float), np.asarray(b, float)
+        return a.shape == b.shape and bool(
+            np.allclose(a, b, atol=atol, equal_nan=True))
+    if isinstance(a, float) and isinstance(b, float):
+        return (np.isnan(a) and np.isnan(b)) or abs(a - b) <= atol
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+        return abs(float(a) - float(b)) <= atol
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        return len(a) == len(b) and all(_feq(x, y, atol) for x, y in zip(a, b))
+    if isinstance(a, dict) and isinstance(b, dict):
+        return set(a) == set(b) and all(_feq(a[k], b[k], atol) for k in a)
+    if isinstance(a, (set, frozenset)) and isinstance(b, (set, frozenset)):
+        return a == b
+    return a == b
+
+
+def _scalar_eq(a: T.FeatureType, b: T.FeatureType, atol=1e-5) -> bool:
+    if isinstance(a, T.Prediction) or isinstance(b, T.Prediction):
+        if a.is_empty != b.is_empty:
+            return False
+        return _feq(a.value, b.value, atol)
+    if a.is_empty and b.is_empty:
+        return True
+    return _feq(a.value, b.value, atol)
+
+
+# ---------------------------------------------------------------------------
+# specs: class name -> (ctor thunk, input spec, flags)
+# ---------------------------------------------------------------------------
+class Spec:
+    def __init__(self, ctor: Callable[[], PipelineStage], inputs: Sequence,
+                 skip_serialization: Optional[str] = None, atol: float = 1e-5):
+        self.ctor = ctor
+        self.inputs = list(inputs)
+        self.skip_serialization = skip_serialization
+        self.atol = atol
+
+
+def _specs() -> Dict[str, Spec]:
+    from transmogrifai_tpu.impl.classification.logistic import OpLogisticRegression
+    from transmogrifai_tpu.impl.classification.mlp import \
+        OpMultilayerPerceptronClassifier
+    from transmogrifai_tpu.impl.classification.naive_bayes import OpNaiveBayes
+    from transmogrifai_tpu.impl.classification.svc import OpLinearSVC
+    from transmogrifai_tpu.impl.classification import trees as ctrees
+    from transmogrifai_tpu.impl.feature import (bucketizers, dates, detectors,
+                                                embeddings, geo, hashing,
+                                                map_vectorizers, scalers,
+                                                smart_text, text,
+                                                transformers, vectorizers)
+    from transmogrifai_tpu.impl.preparators.sanity_checker import (
+        MinVarianceFilter, SanityChecker)
+    from transmogrifai_tpu.impl.regression import trees as rtrees
+    from transmogrifai_tpu.impl.regression.glm import \
+        OpGeneralizedLinearRegression
+    from transmogrifai_tpu.impl.regression.linear import OpLinearRegression
+
+    S = Spec
+    predictors_binary = {
+        "OpLogisticRegression": lambda: OpLogisticRegression(reg_param=0.01),
+        "OpLinearSVC": lambda: OpLinearSVC(max_iter=30),
+        "OpNaiveBayes": None,  # below: needs non-negative features
+        "OpMultilayerPerceptronClassifier":
+            lambda: OpMultilayerPerceptronClassifier(hidden_layers=(4,),
+                                                     max_iter=20),
+        "OpRandomForestClassifier":
+            lambda: ctrees.OpRandomForestClassifier(num_trees=5, max_depth=3),
+        "OpDecisionTreeClassifier":
+            lambda: ctrees.OpDecisionTreeClassifier(max_depth=3),
+        "OpGBTClassifier": lambda: ctrees.OpGBTClassifier(max_iter=4,
+                                                          max_depth=2),
+        "OpXGBoostClassifier": lambda: ctrees.OpXGBoostClassifier(
+            num_round=4, max_depth=2),
+    }
+    predictors_reg = {
+        "OpLinearRegression": lambda: OpLinearRegression(reg_param=0.01),
+        "OpGeneralizedLinearRegression":
+            lambda: OpGeneralizedLinearRegression(max_iter=10),
+        "OpRandomForestRegressor":
+            lambda: rtrees.OpRandomForestRegressor(num_trees=5, max_depth=3),
+        "OpDecisionTreeRegressor":
+            lambda: rtrees.OpDecisionTreeRegressor(max_depth=3),
+        "OpGBTRegressor": lambda: rtrees.OpGBTRegressor(max_iter=4,
+                                                        max_depth=2),
+        "OpXGBoostRegressor": lambda: rtrees.OpXGBoostRegressor(num_round=4,
+                                                                max_depth=2),
+    }
+    specs: Dict[str, Spec] = {
+        name: S(ctor, [_LABEL, _VEC])
+        for name, ctor in {**predictors_binary, **predictors_reg}.items()
+        if ctor is not None
+    }
+    specs["OpNaiveBayes"] = S(OpNaiveBayes, [_LABEL, _VEC_POS], atol=1e-4)
+
+    specs.update({
+        # ---- math / misc transformers ---------------------------------
+        "AddTransformer": S(transformers.AddTransformer, [T.Real, T.Real]),
+        "SubtractTransformer": S(transformers.SubtractTransformer,
+                                 [T.Real, T.Real]),
+        "MultiplyTransformer": S(transformers.MultiplyTransformer,
+                                 [T.Real, T.Real]),
+        "DivideTransformer": S(transformers.DivideTransformer,
+                               [T.Real, T.Real]),
+        "ScalarMathTransformer": S(lambda: transformers.ScalarMathTransformer(
+            "plus", 2.5), [T.Real]),
+        "AliasTransformer": S(lambda: transformers.AliasTransformer("al"),
+                              [T.Real]),
+        "SubstringTransformer": S(transformers.SubstringTransformer,
+                                  [T.Text, T.Text]),
+        "ExistsTransformer": S(transformers.ExistsTransformer, [T.Real]),
+        "ToOccurTransformer": S(transformers.ToOccurTransformer, [T.Real]),
+        "FillMissingWithMean": S(transformers.FillMissingWithMean, [T.Real]),
+        "LambdaTransformer": S(
+            lambda: transformers.LambdaTransformer(
+                lambda v: T.Real(None if v.is_empty else v.value * 2.0),
+                T.Real, T.Real),
+            [T.Real],
+            skip_serialization="closure-capturing fn; serialization of "
+                               "lambda sources is covered in "
+                               "test_workflow_serialization"),
+        "FilterTransformer": S(
+            lambda: transformers.FilterTransformer(
+                lambda v: bool(v and str(v).startswith("a")), T.Text),
+            [T.Text], skip_serialization="closure-capturing predicate"),
+        "ReplaceTransformer": S(lambda: transformers.ReplaceTransformer(
+            "alpha", "omega"), [T.Text]),
+        # ---- scalers ---------------------------------------------------
+        "OpScalarStandardScaler": S(scalers.OpScalarStandardScaler, [T.Real]),
+        "ScalerTransformer": S(lambda: scalers.ScalerTransformer(
+            slope=2.0, intercept=1.0), [T.Real]),
+        "PercentileCalibrator": S(scalers.PercentileCalibrator, [T.RealNN]),
+        "IsotonicRegressionCalibrator": S(
+            scalers.IsotonicRegressionCalibrator, [_LABEL, T.RealNN]),
+        # ---- bucketizers ----------------------------------------------
+        "NumericBucketizer": S(lambda: bucketizers.NumericBucketizer(
+            splits=[-10.0, -0.5, 0.5, 10.0]), [T.Real]),
+        "DecisionTreeNumericBucketizer": S(
+            bucketizers.DecisionTreeNumericBucketizer, [_LABEL, T.Real]),
+        # ---- vectorizers ----------------------------------------------
+        "RealVectorizer": S(vectorizers.RealVectorizer, [T.Real, T.Real]),
+        "RealNNVectorizer": S(vectorizers.RealNNVectorizer,
+                              [T.RealNN, T.RealNN]),
+        "IntegralVectorizer": S(vectorizers.IntegralVectorizer, [T.Integral]),
+        "BinaryVectorizer": S(vectorizers.BinaryVectorizer,
+                              [T.Binary, T.Binary]),
+        "OneHotVectorizer": S(lambda: vectorizers.OneHotVectorizer(
+            top_k=4, min_support=1), [T.PickList, T.PickList]),
+        "OpSetVectorizer": S(lambda: vectorizers.OpSetVectorizer(
+            top_k=4, min_support=1), [T.MultiPickList]),
+        "VectorsCombiner": S(vectorizers.VectorsCombiner, [_VEC, _VEC]),
+        "StandardScalerVectorizer": S(vectorizers.StandardScalerVectorizer,
+                                      [_VEC]),
+        # ---- text ------------------------------------------------------
+        "TextTokenizer": S(text.TextTokenizer, [T.Text]),
+        "LangDetector": S(text.LangDetector, [T.Text]),
+        "OpStopWordsRemover": S(text.OpStopWordsRemover, [T.TextList]),
+        "OpNGram": S(text.OpNGram, [T.TextList]),
+        "TextLenTransformer": S(text.TextLenTransformer, [T.Text]),
+        "OpCountVectorizer": S(lambda: text.OpCountVectorizer(min_df=1),
+                               [T.TextList]),
+        "OpStringIndexer": S(text.OpStringIndexer, [T.Text]),
+        "OpIndexToString": S(lambda: text.OpIndexToString(labels=_WORDS),
+                             [T.RealNN]),
+        "NGramSimilarity": S(text.NGramSimilarity, [T.Text, T.Text]),
+        "JaccardSimilarity": S(text.JaccardSimilarity,
+                               [T.MultiPickList, T.MultiPickList]),
+        # ---- detectors -------------------------------------------------
+        "PhoneNumberParser": S(detectors.PhoneNumberParser, [T.Phone]),
+        "NormalizePhoneNumber": S(detectors.NormalizePhoneNumber, [T.Phone]),
+        "ValidEmailTransformer": S(detectors.ValidEmailTransformer, [T.Email]),
+        "EmailToPickList": S(detectors.EmailToPickList, [T.Email]),
+        "UrlToPickList": S(detectors.UrlToPickList, [T.URL]),
+        "MimeTypeDetector": S(detectors.MimeTypeDetector, [T.Base64]),
+        "HumanNameDetector": S(detectors.HumanNameDetector, [T.Text]),
+        "NameEntityRecognizer": S(detectors.NameEntityRecognizer, [T.Text]),
+        # ---- dates -----------------------------------------------------
+        "TimePeriodTransformer": S(dates.TimePeriodTransformer, [T.Date]),
+        "DateToUnitCircleTransformer": S(dates.DateToUnitCircleTransformer,
+                                         [T.Date, T.Date]),
+        "DateListVectorizer": S(dates.DateListVectorizer, [T.DateList]),
+        # ---- hashing ---------------------------------------------------
+        "OpHashingTF": S(lambda: hashing.OpHashingTF(num_features=32),
+                         [T.TextList]),
+        "CollectionHashingVectorizer": S(
+            lambda: hashing.CollectionHashingVectorizer(num_features=32),
+            [T.TextList, T.TextList]),
+        "OPCollectionHashingVectorizer": S(
+            lambda: hashing.OPCollectionHashingVectorizer(num_features=32),
+            [T.TextList, T.TextList]),
+        # ---- geo -------------------------------------------------------
+        "GeolocationVectorizer": S(geo.GeolocationVectorizer,
+                                   [T.Geolocation]),
+        "GeolocationMapVectorizer": S(geo.GeolocationMapVectorizer,
+                                      [T.GeolocationMap]),
+        # ---- maps ------------------------------------------------------
+        "OPMapVectorizer": S(map_vectorizers.OPMapVectorizer, [T.RealMap]),
+        "TextMapPivotVectorizer": S(lambda: map_vectorizers.
+                                    TextMapPivotVectorizer(top_k=4,
+                                                           min_support=1),
+                                    [T.TextMap]),
+        "MultiPickListMapVectorizer": S(
+            lambda: map_vectorizers.MultiPickListMapVectorizer(
+                top_k=4, min_support=1), [T.MultiPickListMap]),
+        # ---- smart text ------------------------------------------------
+        "SmartTextVectorizer": S(lambda: smart_text.SmartTextVectorizer(
+            max_cardinality=4, num_hashes=16, min_support=1), [T.Text]),
+        "SmartTextMapVectorizer": S(
+            lambda: smart_text.SmartTextMapVectorizer(
+                max_cardinality=4, num_hashes=16, min_support=1), [T.TextMap]),
+        # ---- embeddings ------------------------------------------------
+        "OpWord2Vec": S(lambda: embeddings.OpWord2Vec(
+            vector_size=4, min_count=1, epochs=2), [T.TextList]),
+        "OpLDA": S(lambda: embeddings.OpLDA(k=2, max_iter=3), [_VEC],
+                   atol=1e-3),
+        # ---- preparators ----------------------------------------------
+        "SanityChecker": S(lambda: SanityChecker(check_sample=1.0),
+                           [_LABEL, _VEC]),
+        "MinVarianceFilter": S(MinVarianceFilter, [_VEC]),
+    })
+    return specs
+
+
+#: stages deliberately outside the harness, with reasons
+EXEMPT: Dict[str, str] = {
+    # abstract / base classes (no direct construction contract)
+    "PredictorEstimator": "abstract base of the predictor tier",
+    "PredictorModel": "fit product; covered via every predictor spec",
+    "OpOneHotVectorizer": "abstract base of OneHot/Set vectorizers",
+    # fit products — each covered through its estimator's spec
+    "DecisionTreeNumericBucketizerModel": "fit product",
+    "FillMissingWithMeanModel": "fit product",
+    "GeolocationMapVectorizerModel": "fit product",
+    "GeolocationVectorizerModel": "fit product",
+    "IsotonicRegressionCalibratorModel": "fit product",
+    "OPMapVectorizerModel": "fit product",
+    "OneHotVectorizerModel": "fit product",
+    "OpCountVectorizerModel": "fit product",
+    "OpLDAModel": "fit product",
+    "OpScalarStandardScalerModel": "fit product",
+    "OpStringIndexerModel": "fit product",
+    "OpWord2VecModel": "fit product",
+    "PercentileCalibratorModel": "fit product",
+    "RealVectorizerModel": "fit product",
+    "SanityCheckerModel": "fit product",
+    "SmartTextMapVectorizerModel": "fit product",
+    "SmartTextVectorizerModel": "fit product",
+    "StandardScalerModel": "fit product",
+    "TextMapPivotVectorizerModel": "fit product",
+    "SelectedModel": "fit product of ModelSelector",
+    "SelectedCombinerModel": "fit product of SelectedModelCombiner",
+    # composite stages with their own end-to-end suites
+    "ModelSelector": "whole-sweep stage; tests/test_model_selector.py + "
+                     "test_fused_sweep.py drive it end-to-end",
+    "SelectedModelCombiner": "needs two fitted SelectedModels; covered in "
+                             "tests/test_histogram_combiner.py",
+    "RecordInsightsLOCO": "needs a fitted model + vector metadata context; "
+                          "covered in tests/test_insights.py",
+    "RecordInsightsCorr": "same as RecordInsightsLOCO",
+    "PredictionDeIndexer": "needs a Prediction + indexer metadata pair; "
+                           "covered in tests/test_dsl_transformers.py",
+    "DropIndicesByTransformer": "needs vector-metadata predicate wiring; "
+                                "covered in tests/test_dsl_transformers.py",
+    "DescalerTransformer": "reads its sibling ScalerTransformer's metadata "
+                           "through the feature DAG; covered in "
+                           "tests/test_dsl_transformers.py",
+    "FeatureGeneratorStage": "raw-ingestion stage; driven by every reader "
+                             "test (tests/test_readers_avro_joined.py)",
+}
+
+
+def _discover() -> Dict[str, type]:
+    pkgs = ["transmogrifai_tpu.impl.feature",
+            "transmogrifai_tpu.impl.preparators",
+            "transmogrifai_tpu.impl.classification",
+            "transmogrifai_tpu.impl.regression",
+            "transmogrifai_tpu.impl.filters",
+            "transmogrifai_tpu.impl.selector",
+            "transmogrifai_tpu.impl.insights",
+            "transmogrifai_tpu.features"]
+    seen: Dict[str, type] = {}
+    for p in pkgs:
+        pkg = importlib.import_module(p)
+        mods = [p] + [f"{p}.{m.name}" for m in
+                      pkgutil.iter_modules(getattr(pkg, "__path__", []))]
+        for mn in mods:
+            mod = importlib.import_module(mn)
+            for name, cls in inspect.getmembers(mod, inspect.isclass):
+                if (issubclass(cls, PipelineStage) and cls.__module__ == mn
+                        and not name.startswith("_")):
+                    seen[name] = cls
+    return seen
+
+
+ALL_STAGES = _discover()
+SPECS = _specs()
+
+
+def test_every_stage_is_specced_or_exempt():
+    missing = sorted(set(ALL_STAGES) - set(SPECS) - set(EXEMPT))
+    assert not missing, f"stages with no contract spec or exemption: {missing}"
+    stale = sorted((set(SPECS) | set(EXEMPT)) - set(ALL_STAGES))
+    assert not stale, f"spec/exempt entries for unknown stages: {stale}"
+    overlap = sorted(set(SPECS) & set(EXEMPT))
+    assert not overlap, f"both specced and exempt: {overlap}"
+
+
+@pytest.mark.parametrize("name", sorted(SPECS))
+def test_stage_contract(name):
+    spec = SPECS[name]
+    rng = np.random.default_rng(hash(name) % (2 ** 31))
+    ds, feats = _build_dataset(spec.inputs, rng)
+    stage = spec.ctor()
+    stage.set_input(*feats)
+    if isinstance(stage, Estimator):
+        model = stage.fit(ds)
+    else:
+        model = stage
+    out_col = model.transform_dataset(ds)
+    assert len(out_col) == N
+
+    # 1. batch ≡ row (the OpTransformerSpec contract)
+    for i in range(N_ROW_CHECK):
+        row = {f.name: ds[f.name].to_scalar(i) for f in model.inputs}
+        row_out = model.transform_row(row)
+        batch_out = out_col.to_scalar(i)
+        assert _scalar_eq(batch_out, row_out, spec.atol), \
+            (name, i, batch_out, row_out)
+
+    # 2. serialization round-trip preserves the batch output
+    if spec.skip_serialization is None:
+        arrays: Dict[str, np.ndarray] = {}
+        enc = ser._encode_stage(model, arrays)
+        decoded = ser._decode_stage(enc, arrays)
+        decoded.inputs = model.inputs
+        out2 = decoded.transform_columns([ds[f.name] for f in model.inputs])
+        for i in range(N_ROW_CHECK):
+            assert _scalar_eq(out_col.to_scalar(i), out2.to_scalar(i),
+                              spec.atol), (name, i)

@@ -106,17 +106,27 @@ class FeatureGeneratorStage(PipelineStage):
         return self.extract_fn(record)
 
     def aggregate(self, events: Sequence[Event], cutoff_ms: Optional[int] = None,
-                  responses_after_cutoff: bool = False) -> T.FeatureType:
+                  responses_after_cutoff: bool = False,
+                  response_window_inclusive: bool = True) -> T.FeatureType:
         """GenericFeatureAggregator semantics (FeatureAggregator.scala:100):
         predictors aggregate events strictly *before* the cutoff, responses
         events *at/after* it; the optional window further restricts the range.
+
+        ``response_window_inclusive``: the plain aggregate path bounds the
+        response window INCLUSIVELY (date <= cutoff + window,
+        FeatureAggregator.scala:121) but the post-join aggregation uses an
+        EXCLUSIVE bound (timeStamp < cutOff + timeWindow,
+        JoinedDataReader.scala:434) — JoinedAggregateReader passes False.
         """
         sel = events
         if cutoff_ms is not None:
             if responses_after_cutoff:
                 sel = [e for e in events if e.time >= cutoff_ms]
                 if self.aggregate_window_ms is not None:
-                    sel = [e for e in sel if e.time < cutoff_ms + self.aggregate_window_ms]
+                    hi = cutoff_ms + self.aggregate_window_ms
+                    sel = [e for e in sel
+                           if (e.time <= hi if response_window_inclusive
+                               else e.time < hi)]
             else:
                 sel = [e for e in events if e.time < cutoff_ms]
                 if self.aggregate_window_ms is not None:

@@ -27,41 +27,38 @@ from ... import types as T
 from ...stages.base import UnaryTransformer
 
 # ---------------------------------------------------------------------------
-# Phone numbers
+# Phone numbers (metadata: models/phone_metadata — 48 calling regions)
 # ---------------------------------------------------------------------------
-# country code -> (dial prefix, national number lengths)
-_PHONE_REGIONS: Dict[str, Tuple[str, Set[int]]] = {
-    "US": ("1", {10}), "CA": ("1", {10}), "GB": ("44", {10}),
-    "FR": ("33", {9}), "DE": ("49", {10, 11}), "IN": ("91", {10}),
-    "AU": ("61", {9}), "JP": ("81", {9, 10}), "BR": ("55", {10, 11}),
-    "MX": ("52", {10}),
-}
+from ...models.phone_metadata import REGIONS as _PHONE_REGIONS
+from ...models.phone_metadata import valid_international as _valid_intl
+
 DEFAULT_REGION = "US"
 
 
 def parse_phone(raw: Optional[str], region: str = DEFAULT_REGION
                 ) -> Tuple[bool, Optional[str]]:
-    """(is_valid, normalized E.164) under simple region rules."""
+    """(is_valid, normalized E.164) under the bundled region metadata
+    (libphonenumber-lite; reference PhoneNumberParser.scala)."""
     if not raw:
         return False, None
     digits = re.sub(r"[^\d+]", "", raw)
-    prefix, lengths = _PHONE_REGIONS.get(region.upper(), _PHONE_REGIONS[DEFAULT_REGION])
+    meta = _PHONE_REGIONS.get(region.upper(), _PHONE_REGIONS[DEFAULT_REGION])
     if digits.startswith("+"):
         body = digits[1:]
-        if body.startswith(prefix) and (len(body) - len(prefix)) in lengths:
+        if body.startswith(meta.country_code) and \
+                (len(body) - len(meta.country_code)) in meta.lengths:
             return True, f"+{body}"
-        # any known region prefix
-        for p, ls in _PHONE_REGIONS.values():
-            if body.startswith(p) and (len(body) - len(p)) in ls:
-                return True, f"+{body}"
+        if _valid_intl(body):  # any known region's code + valid length
+            return True, f"+{body}"
         return False, None
-    # national format: regions outside NANP write a trunk '0' before the
-    # significant digits (e.g. GB 020..., FR 06...) — strip it first
-    if prefix != "1" and digits.startswith("0"):
-        digits = digits[1:]
-    if len(digits) in lengths:
-        return True, f"+{prefix}{digits}"
-    if digits.startswith(prefix) and (len(digits) - len(prefix)) in lengths:
+    # national format: strip the region's trunk prefix (e.g. GB/FR '0',
+    # RU '8', MX '01') before the significant digits
+    if meta.trunk_prefix and digits.startswith(meta.trunk_prefix):
+        digits = digits[len(meta.trunk_prefix):]
+    if len(digits) in meta.lengths:
+        return True, f"+{meta.country_code}{digits}"
+    if digits.startswith(meta.country_code) and \
+            (len(digits) - len(meta.country_code)) in meta.lengths:
         return True, f"+{digits}"
     return False, None
 
@@ -210,35 +207,14 @@ class MimeTypeDetector(UnaryTransformer):
 
 
 # ---------------------------------------------------------------------------
-# Human names (NameDetectUtils analog)
+# Human names (NameDetectUtils analog; gazetteer: models/name_dictionaries —
+# ~700 given names across 14 cultures with gender tags)
 # ---------------------------------------------------------------------------
-# high-frequency first names (census heads) — the reference ships large
-# dictionaries in models/; this is the seed gazetteer
-_FIRST_NAMES: Set[str] = {
-    "james", "mary", "john", "patricia", "robert", "jennifer", "michael",
-    "linda", "william", "elizabeth", "david", "barbara", "richard", "susan",
-    "joseph", "jessica", "thomas", "sarah", "charles", "karen", "christopher",
-    "nancy", "daniel", "lisa", "matthew", "margaret", "anthony", "betty",
-    "mark", "sandra", "donald", "ashley", "steven", "dorothy", "paul",
-    "kimberly", "andrew", "emily", "joshua", "donna", "kenneth", "michelle",
-    "kevin", "carol", "brian", "amanda", "george", "melissa", "edward",
-    "deborah", "ana", "maria", "jose", "juan", "luis", "carlos", "ahmed",
-    "mohammed", "fatima", "wei", "ming", "yuki", "hiroshi", "anna", "peter",
-    "hans", "pierre", "marie", "jean", "sophie", "ivan", "olga", "natasha",
-}
-_HONORIFICS = {"mr", "mrs", "ms", "miss", "dr", "prof", "rev", "sir", "madam",
-               "lady", "lord", "master", "mx"}
-_GENDER_HINT = {
-    "mary": "F", "patricia": "F", "jennifer": "F", "linda": "F",
-    "elizabeth": "F", "barbara": "F", "susan": "F", "jessica": "F",
-    "sarah": "F", "karen": "F", "maria": "F", "anna": "F", "marie": "F",
-    "fatima": "F", "olga": "F", "natasha": "F", "sophie": "F", "emily": "F",
-    "michelle": "F", "amanda": "F", "melissa": "F", "deborah": "F",
-    "james": "M", "john": "M", "robert": "M", "michael": "M", "william": "M",
-    "david": "M", "richard": "M", "joseph": "M", "thomas": "M", "charles": "M",
-    "jose": "M", "juan": "M", "luis": "M", "carlos": "M", "ahmed": "M",
-    "mohammed": "M", "pierre": "M", "jean": "M", "ivan": "M", "hans": "M",
-}
+from ...models.name_dictionaries import (GIVEN_NAMES as _GIVEN_NAMES,
+                                         HONORIFICS as _HONORIFICS,
+                                         SURNAME_PARTICLES as _PARTICLES)
+
+_FIRST_NAMES: Set[str] = set(_GIVEN_NAMES)  # detector + NER gazetteer
 
 
 def detect_name(text: Optional[str]) -> Dict[str, str]:
@@ -248,19 +224,32 @@ def detect_name(text: Optional[str]) -> Dict[str, str]:
         return {"isName": "false"}
     tokens = [t for t in re.split(r"[\s,]+", text.strip()) if t]
     words = [t.lower().strip(".") for t in tokens]
-    non_honorific = [w for w in words if w not in _HONORIFICS]
-    if not non_honorific or len(non_honorific) > 4:
+    # drop honorifics unless the word is also a given name ('Don Draper'
+    # keeps 'don'; 'Dr Smith' drops 'dr')
+    non_honorific = [w for w in words
+                     if w not in _HONORIFICS or w in _GIVEN_NAMES]
+    # surname particles (de, van, von, al, bin, ...) attach to the surname:
+    # they count toward neither the token cap nor the given-name lookup.
+    # A LEADING token is never treated as a particle — 'Ben', 'Al', 'Don'
+    # are given names in first position ('Al Gore') and particles only
+    # inside a surname ('Mohammed Al Fayed').
+    core = [w for i, w in enumerate(non_honorific)
+            if i == 0 or w not in _PARTICLES]
+    if not core or len(core) > 4:
         return {"isName": "false"}
-    shape_ok = all(t[:1].isupper() for t in tokens if t.lower().strip(".") not in _HONORIFICS)
-    dict_hit = any(w in _FIRST_NAMES for w in non_honorific)
-    is_name = dict_hit or (shape_ok and len(non_honorific) in (2, 3)
-                           and all(w.isalpha() for w in non_honorific))
+    # shape rule: capitalized tokens, allowing lowercase particles
+    shape_ok = all(t[:1].isupper() or t.lower().strip(".") in _PARTICLES
+                   for t in tokens if t.lower().strip(".") not in _HONORIFICS)
+    dict_hit = any(w in _GIVEN_NAMES for w in core)
+    is_name = dict_hit or (shape_ok and len(core) in (2, 3)
+                           and all(w.isalpha() for w in core))
     out = {"isName": "true" if is_name else "false"}
     if is_name:
-        first = next((w for w in non_honorific if w in _FIRST_NAMES), non_honorific[0])
+        first = next((w for w in core if w in _GIVEN_NAMES), core[0])
         out["firstName"] = first
-        if first in _GENDER_HINT:
-            out["gender"] = _GENDER_HINT[first]
+        gender = _GIVEN_NAMES.get(first)
+        if gender in ("M", "F"):
+            out["gender"] = gender
     return out
 
 

@@ -102,34 +102,16 @@ def analyze(text: Optional[str], language: str = DEFAULT_LANGUAGE,
 # ---------------------------------------------------------------------------
 # Language detection (optimaize langdetect analog — char-trigram profiles)
 # ---------------------------------------------------------------------------
-_LANG_PROFILES: Dict[str, Set[str]] = {
-    # top distinctive character trigrams per language (hand-built micro
-    # profiles — the reference wraps optimaize; LangDetector.scala:46)
-    "en": {"the", "and", "ing", "ion", "tio", "ent", "for", "hat", "her", "tha"},
-    "fr": {"les", "que", "des", "ent", "ais", "our", "ait", "eur", "une", "dan"},
-    "de": {"der", "die", "und", "ein", "ich", "sch", "den", "cht", "ung", "gen"},
-    "es": {"que", "los", "del", "ent", "cio", "ado", "par", "las", "una", "con"},
-}
-
-
 def detect_language(text: Optional[str]) -> Tuple[str, float]:
-    """(language, confidence) from character trigram overlap."""
+    """(language, confidence) from the bundled 25-language trigram profiles
+    (models/lang_profiles; the reference wraps optimaize's profile set —
+    LangDetector.scala:46)."""
+    from ...models import lang_profiles
+
     if not text:
         return DEFAULT_LANGUAGE, 0.0
-    s = re.sub(r"[^\w\s]", "", text.lower())
-    trigrams = Counter(s[i:i + 3] for i in range(max(0, len(s) - 2))
-                       if not s[i:i + 3].isspace())
-    if not trigrams:
-        return DEFAULT_LANGUAGE, 0.0
-    scores = {}
-    for lang, profile in _LANG_PROFILES.items():
-        scores[lang] = sum(c for t, c in trigrams.items() if t in profile)
-    best = max(scores, key=scores.get)
-    total = sum(trigrams.values())
-    conf = scores[best] / total if total else 0.0
-    if scores[best] == 0:
-        return DEFAULT_LANGUAGE, 0.0
-    return best, conf
+    lang, conf = lang_profiles.detect(text)
+    return (lang, conf) if conf > 0 else (DEFAULT_LANGUAGE, 0.0)
 
 
 class LangDetector(UnaryTransformer):

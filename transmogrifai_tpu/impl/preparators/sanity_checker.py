@@ -248,37 +248,50 @@ class SanityChecker(BinaryEstimator, AllowLabelAsInput):
             mesh = active_mesh()
             if mesh is None or int(mesh.shape.get(DATA_AXIS, 1)) <= 1:
                 mesh = data_mesh()
-            acc = DataShardedStats(X.shape[1], mesh=mesh)
-            full_stats = acc.moments(chunked(X)())
-            acc_c = DataShardedStats(len(corr_cols), mesh=mesh)
             ch = 1 << 18
             all_cols = len(corr_cols) == X.shape[1]
-            if method == "spearman":
-                # global rank transform on device (parallel/stats), then the
-                # SAME streaming Pearson passes run over the ranks — the
-                # Spark Statistics.corr("spearman") sort-then-Pearson scheme
-                from ...parallel.stats import rank_transform
+            if method == "pearson" and all_cols:
+                # ONE streaming pass: moments + constant-center Gram with an
+                # exact finalize correction — each chunk uploads once (the
+                # two-pass scheme re-uploaded the matrix; uploads dominate
+                # on a tunneled link)
+                from ...parallel.stats import fused_moments_and_correlations
 
-                Xs = rank_transform(X if all_cols else X[:, corr_cols])
-                ys = rank_transform(np.asarray(y, np.float32))
-                mean_c = np.full(len(corr_cols), (n + 1) / 2.0)
-                y_mean = (n + 1) / 2.0
+                full_stats, corr_label_sub, corr_matrix_sub = \
+                    fused_moments_and_correlations(
+                        chunked(X, y, chunk_rows=ch), X.shape[1], mesh=mesh,
+                        with_corr_matrix=with_corr)
             else:
-                Xs = X if all_cols else None
-                ys = y
-                mean_c = full_stats.mean[corr_cols]
-                y_mean = float(np.mean(y))
+                acc = DataShardedStats(X.shape[1], mesh=mesh)
+                full_stats = acc.moments(chunked(X)())
+                acc_c = DataShardedStats(len(corr_cols), mesh=mesh)
+                if method == "spearman":
+                    # global rank transform on device (parallel/stats), then
+                    # the SAME streaming Pearson passes run over the ranks —
+                    # the Spark Statistics.corr("spearman") sort-then-Pearson
+                    # scheme
+                    from ...parallel.stats import rank_transform
 
-            def xy_chunks():
-                for lo in range(0, n, ch):
-                    # avoid a per-chunk column-gather copy when nothing is
-                    # excluded (the common case at scale)
-                    Xc = (Xs[lo:lo + ch] if Xs is not None
-                          else X[lo:lo + ch][:, corr_cols])
-                    yield Xc, ys[lo:lo + ch]
+                    Xs = rank_transform(X if all_cols else X[:, corr_cols])
+                    ys = rank_transform(np.asarray(y, np.float32))
+                    mean_c = np.full(len(corr_cols), (n + 1) / 2.0)
+                    y_mean = (n + 1) / 2.0
+                else:
+                    Xs = X if all_cols else None
+                    ys = y
+                    mean_c = full_stats.mean[corr_cols]
+                    y_mean = float(np.mean(y))
 
-            corr_label_sub, corr_matrix_sub = acc_c.correlations_from(
-                xy_chunks, mean_c, y_mean, with_corr_matrix=with_corr)
+                def xy_chunks():
+                    for lo in range(0, n, ch):
+                        # avoid a per-chunk column-gather copy when nothing
+                        # is excluded (the common case at scale)
+                        Xc = (Xs[lo:lo + ch] if Xs is not None
+                              else X[lo:lo + ch][:, corr_cols])
+                        yield Xc, ys[lo:lo + ch]
+
+                corr_label_sub, corr_matrix_sub = acc_c.correlations_from(
+                    xy_chunks, mean_c, y_mean, with_corr_matrix=with_corr)
         else:
             _, corr_label_sub, corr_matrix_sub = S.correlations_with_label(
                 X[:, corr_cols], y, method=method, with_corr_matrix=with_corr)

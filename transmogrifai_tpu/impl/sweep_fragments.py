@@ -158,6 +158,39 @@ def _linreg_fragments(est, grids, pos: int, blob: _Blob) -> Optional[List]:
     return [("fista", cis, max(base_mi, 300), base_fi, off_l1, off_l2)]
 
 
+def _svc_fragments(est, grids, pos: int, blob: _Blob) -> Optional[List]:
+    for g in grids:
+        for k in g:
+            if k != "reg_param":
+                return None
+    l2 = [float(g.get("reg_param", est.get_param("reg_param", 0.0)))
+          for g in grids]
+    cis = tuple(range(pos, pos + len(grids)))
+    return [("svc", cis, max(int(est.get_param("max_iter", 100)), 200),
+             bool(est.get_param("fit_intercept", True)), blob.add(l2))]
+
+
+def _mlp_fragments(est, grids, pos: int, blob: _Blob, d: int) -> Optional[List]:
+    allowed = ("hidden_layers", "max_iter", "step_size", "seed")
+    for g in grids:
+        for k in g:
+            if k not in allowed:
+                return None
+    cands = [est.copy_with_params(dict(g)) for g in grids]
+    groups: Dict[tuple, List[int]] = {}
+    for i, c in enumerate(cands):
+        hl = tuple(int(h) for h in c.get_param("hidden_layers", (10,)))
+        groups.setdefault((hl, int(c.get_param("max_iter", 200))), []).append(i)
+    frags = []
+    for (hl, mi), idxs in groups.items():
+        layers = (d,) + hl + (2,)  # binary: builder guarantees 2 classes
+        lrs = [float(cands[i].get_param("step_size", 0.03)) for i in idxs]
+        seeds = [float(int(cands[i].get_param("seed", 42))) for i in idxs]
+        frags.append(("mlp", tuple(int(pos + i) for i in idxs), layers, mi,
+                      blob.add(lrs), blob.add(seeds)))
+    return frags
+
+
 def _forest_fragment(est, grids, pos: int, blob: _Blob, xbs, X, train_w,
                      classification: bool) -> Optional[List]:
     for g in grids:
@@ -257,6 +290,8 @@ def build_sweep_plan(candidates: Sequence[Tuple[Any, Sequence[Dict[str, Any]]]],
     and (for classification) a binary 0/1 label.
     """
     from .classification.logistic import OpLogisticRegression
+    from .classification.mlp import OpMultilayerPerceptronClassifier
+    from .classification.svc import OpLinearSVC
     from .classification.trees import (OpGBTClassifier,
                                        OpRandomForestClassifier,
                                        OpXGBoostClassifier)
@@ -307,6 +342,12 @@ def build_sweep_plan(candidates: Sequence[Tuple[Any, Sequence[Dict[str, Any]]]],
                 fr = _gbt_fragment(est, grids, pos, blob, xbs, X, train_w,
                                    loss="logistic")
                 s = 0  # _margins_to_preds uses p >= 0.5
+            elif isinstance(est, OpLinearSVC):
+                fr = _svc_fragments(est, grids, pos, blob)
+                s = 0  # 0/1 score; >= 0.5 picks exactly z >= 0
+            elif isinstance(est, OpMultilayerPerceptronClassifier):
+                fr = _mlp_fragments(est, grids, pos, blob, X.shape[1])
+                s = 1  # argmax(prob) ties to class 0
             else:
                 fr = None
                 s = 0

@@ -1,1 +1,22 @@
-"""Package."""
+"""Bundled text-intelligence data assets.
+
+Reference parity: the reference ships pretrained NLP artifacts under
+``models/src/main/resources`` — OpenNLP NER/sentence binaries, optimaize
+language profiles, and libphonenumber metadata — consumed by
+``LangDetector`` / ``HumanNameDetector`` / ``PhoneNumberParser``
+(core/.../impl/feature/, core/.../utils/text/).  JVM binaries cannot ride
+along here, so each asset is an ORIGINAL, self-contained table built for
+this package:
+
+- :mod:`lang_profiles` — character-trigram log-frequency profiles for 25
+  languages, derived at import time from bundled sample corpora
+  (optimaize-style profiles),
+- :mod:`phone_metadata` — dialing metadata (country code, trunk prefix,
+  national-number lengths) for 48 calling regions (libphonenumber-lite),
+- :mod:`name_dictionaries` — ~700 given names across 14 cultures with
+  gender tags, multi-script honorifics, and surname particles
+  (NameDetectUtils-scale gazetteer).
+"""
+from . import lang_profiles, name_dictionaries, phone_metadata  # noqa: F401
+
+__all__ = ["lang_profiles", "phone_metadata", "name_dictionaries"]

@@ -1,0 +1,234 @@
+"""Given-name gazetteer across 14 cultures (~700 names) with gender tags.
+
+Reference parity: ``NameDetectUtils.scala`` (513 LoC) ships large
+first-name dictionaries with per-name gender frequencies consumed by
+``HumanNameDetector``; this is the same shape — a flat name -> gender map
+("M" / "F" / "U" for unisex) spanning English, Spanish, Portuguese,
+French, German, Italian, Dutch, Scandinavian, Slavic, Greek, Turkish,
+Arabic, Hebrew, Persian, South-Asian, Chinese (romanized), Japanese
+(romanized), Korean (romanized), Vietnamese, and Swahili name stocks —
+plus honorifics and surname particles used by the detector's shape rules.
+"""
+from __future__ import annotations
+
+from typing import Dict, FrozenSet
+
+#: name (lowercase) -> predominant gender "M"/"F"/"U"
+GIVEN_NAMES: Dict[str, str] = {}
+
+
+def _add(gender: str, *names: str) -> None:
+    for n in names:
+        GIVEN_NAMES[n] = gender
+
+
+# English / Anglophone
+_add("M", "james", "john", "robert", "michael", "william", "david",
+     "richard", "joseph", "thomas", "charles", "christopher", "daniel",
+     "matthew", "anthony", "mark", "donald", "steven", "paul", "andrew",
+     "joshua", "kenneth", "kevin", "brian", "george", "edward", "ronald",
+     "timothy", "jason", "jeffrey", "ryan", "jacob", "gary", "nicholas",
+     "eric", "jonathan", "stephen", "larry", "justin", "scott", "brandon",
+     "benjamin", "samuel", "gregory", "frank", "alexander", "patrick",
+     "raymond", "jack", "dennis", "jerry", "tyler", "aaron", "henry",
+     "nathan", "peter", "zachary", "kyle", "walter", "harold", "ethan",
+     "oliver", "liam", "noah", "mason", "logan", "lucas", "owen", "caleb")
+_add("F", "mary", "patricia", "jennifer", "linda", "elizabeth", "barbara",
+     "susan", "jessica", "sarah", "karen", "nancy", "lisa", "margaret",
+     "betty", "sandra", "ashley", "dorothy", "kimberly", "emily", "donna",
+     "michelle", "carol", "amanda", "melissa", "deborah", "stephanie",
+     "rebecca", "laura", "sharon", "cynthia", "kathleen", "amy", "shirley",
+     "angela", "helen", "anna", "brenda", "pamela", "nicole", "ruth",
+     "katherine", "samantha", "christine", "emma", "catherine", "virginia",
+     "rachel", "carolyn", "janet", "maria", "heather", "diane", "julie",
+     "olivia", "sophia", "isabella", "ava", "mia", "charlotte", "amelia",
+     "harper", "abigail", "grace", "chloe", "hannah", "zoe", "lily")
+_add("U", "taylor", "jordan", "morgan", "casey", "riley", "avery", "quinn",
+     "rowan", "skyler", "cameron", "alexis", "dakota", "reese", "emerson")
+# short given names that double as surname particles in other positions
+# (the detector only treats non-leading tokens as particles)
+_add("M", "ben", "al", "don", "mac", "lee", "ray", "sam", "max", "leo")
+
+# Spanish / Latin American
+_add("M", "jose", "juan", "luis", "carlos", "jorge", "pedro", "manuel",
+     "francisco", "alejandro", "miguel", "rafael", "fernando", "sergio",
+     "diego", "andres", "javier", "ricardo", "eduardo", "roberto", "pablo",
+     "mario", "santiago", "mateo", "sebastian", "emilio", "ignacio",
+     "gustavo", "hector", "raul", "cesar", "hugo", "ivan", "oscar")
+_add("F", "guadalupe", "juana", "margarita", "josefina", "rosa", "teresa",
+     "francisca", "veronica", "alejandra", "leticia", "gabriela",
+     "yolanda", "elena", "carmen", "lucia", "isabel", "patricia",
+     "claudia", "adriana", "daniela", "mariana", "valentina", "camila",
+     "paula", "sofia", "ximena", "regina", "pilar", "dolores", "esperanza")
+
+# Portuguese / Brazilian
+_add("M", "joao", "antonio", "paulo", "tiago", "rui", "nuno", "goncalo",
+     "duarte", "vasco", "afonso", "caio", "thiago", "felipe", "gustavo",
+     "rodrigo", "marcelo", "leandro", "renato", "vinicius", "otavio")
+_add("F", "mariana", "beatriz", "ines", "catarina", "matilde", "leonor",
+     "madalena", "joana", "rita", "larissa", "leticia", "fernanda",
+     "juliana", "tatiana", "vitoria", "raquel", "marta", "iara")
+
+# French
+_add("M", "pierre", "jean", "michel", "alain", "philippe", "rene",
+     "louis", "nicolas", "laurent", "christophe", "julien", "mathieu",
+     "antoine", "hugo", "theo", "lucas", "gabriel", "arthur", "baptiste",
+     "olivier", "thierry", "pascal", "guillaume", "etienne", "yves")
+_add("F", "marie", "jeanne", "francoise", "monique", "catherine",
+     "nathalie", "isabelle", "sylvie", "valerie", "sandrine", "celine",
+     "aurelie", "camille", "lea", "manon", "chloe", "ines", "jade",
+     "louise", "alice", "juliette", "margaux", "amelie", "elodie",
+     "brigitte", "veronique", "dominique", "sophie", "pauline")
+
+# German / Austrian / Swiss
+_add("M", "hans", "peter", "wolfgang", "klaus", "juergen", "dieter",
+     "manfred", "uwe", "stefan", "andreas", "thomas", "markus", "florian",
+     "tobias", "sebastian", "lukas", "jonas", "felix", "maximilian",
+     "moritz", "till", "jan", "nico", "friedrich", "heinrich", "karl",
+     "otto", "gerhard", "helmut", "rainer", "dirk", "torsten")
+_add("F", "ursula", "monika", "petra", "sabine", "renate", "helga",
+     "karin", "brigitte", "ingrid", "erika", "claudia", "andrea",
+     "susanne", "martina", "silke", "katrin", "anja", "nadine",
+     "melanie", "lena", "leonie", "hannah", "mia", "lara", "greta",
+     "frieda", "marlene", "annika", "christa", "gisela", "heike")
+
+# Italian
+_add("M", "giuseppe", "giovanni", "antonio", "mario", "luigi", "angelo",
+     "vincenzo", "salvatore", "domenico", "francesco", "paolo", "marco",
+     "andrea", "alessandro", "matteo", "lorenzo", "davide", "simone",
+     "federico", "riccardo", "stefano", "giorgio", "enrico", "leonardo")
+_add("F", "giulia", "chiara", "francesca", "federica", "silvia", "elisa",
+     "paola", "laura", "martina", "alessia", "giorgia", "elena", "sara",
+     "valentina", "roberta", "simona", "caterina", "bianca", "aurora",
+     "ginevra", "beatrice", "camilla", "lucrezia", "serena", "ilaria")
+
+# Dutch / Flemish
+_add("M", "jan", "pieter", "kees", "hendrik", "willem", "joris", "sander",
+     "bram", "daan", "sem", "thijs", "ruben", "niels", "wouter", "gijs",
+     "maarten", "jeroen", "bas", "koen", "stijn", "sven", "floris")
+_add("F", "anna", "sanne", "fleur", "lotte", "femke", "maud", "roos",
+     "noor", "evi", "iris", "ilse", "marieke", "annelies", "lieke",
+     "tess", "jasmijn", "esmee", "nienke", "marloes", "saskia")
+
+# Scandinavian
+_add("M", "lars", "erik", "anders", "bjorn", "magnus", "nils", "olav",
+     "gunnar", "sven", "leif", "kjell", "henrik", "mikkel", "soren",
+     "rasmus", "emil", "axel", "oskar", "viggo", "eskil", "halvor")
+_add("F", "astrid", "ingrid", "sigrid", "kari", "liv", "solveig", "maja",
+     "freja", "alma", "saga", "elsa", "tuva", "thea", "hedda", "ronja",
+     "linnea", "vilde", "signe", "hilde", "randi", "britt", "pia")
+
+# Slavic (Russian / Ukrainian / Polish / Czech)
+_add("M", "ivan", "dmitri", "sergei", "alexei", "nikolai", "vladimir",
+     "andrei", "mikhail", "yuri", "boris", "pavel", "oleg", "igor",
+     "viktor", "anatoly", "stanislav", "bohdan", "taras", "piotr",
+     "krzysztof", "andrzej", "tomasz", "marek", "jakub", "mateusz",
+     "wojciech", "zbigniew", "vaclav", "jiri", "milos", "petr", "ondrej")
+_add("F", "olga", "natasha", "svetlana", "irina", "tatiana", "elena",
+     "ekaterina", "anastasia", "galina", "lyudmila", "vera", "nadia",
+     "oksana", "yulia", "polina", "ksenia", "agnieszka", "malgorzata",
+     "katarzyna", "magdalena", "zofia", "hanna", "jana", "lenka",
+     "tereza", "zuzana", "marketa", "eliska", "veronika", "darya")
+
+# Greek
+_add("M", "georgios", "dimitrios", "konstantinos", "nikolaos", "panagiotis",
+     "vasilis", "christos", "spyros", "theodoros", "stavros", "petros")
+_add("F", "eleni", "aikaterini", "sofia", "angeliki", "georgia",
+     "despina", "ioanna", "vasiliki", "athina", "zoi", "niki", "xenia")
+
+# Turkish
+_add("M", "mehmet", "mustafa", "ahmet", "ali", "huseyin", "hasan",
+     "ibrahim", "osman", "murat", "emre", "burak", "kerem", "arda",
+     "yusuf", "omer", "kemal", "serkan", "tolga", "baris", "deniz")
+_add("F", "fatma", "ayse", "emine", "hatice", "zeynep", "elif", "meryem",
+     "selin", "derya", "gul", "ebru", "pinar", "seda", "tugba", "esra")
+
+# Arabic
+_add("M", "mohammed", "ahmed", "mahmoud", "mustafa", "abdullah", "omar",
+     "khalid", "hassan", "hussein", "youssef", "karim", "tariq", "samir",
+     "nabil", "rashid", "faisal", "hamza", "bilal", "anwar", "ziad",
+     "waleed", "adel", "majid", "salim", "jamal", "fadi", "imad")
+_add("F", "fatima", "aisha", "maryam", "zainab", "khadija", "amina",
+     "layla", "noor", "huda", "salma", "rania", "dalia", "yasmin",
+     "nadia", "samira", "lina", "hanan", "abeer", "rim", "dina", "mona")
+
+# Hebrew
+_add("M", "avi", "moshe", "yosef", "david", "yaakov", "shlomo", "eitan",
+     "noam", "uri", "amir", "ronen", "gilad", "nadav", "oren", "tal")
+_add("F", "rivka", "sara", "leah", "rachel", "miriam", "esther", "noa",
+     "tamar", "yael", "shira", "michal", "ayelet", "orly", "dafna")
+
+# Persian
+_add("M", "reza", "hossein", "amir", "mehdi", "hamid", "saeed", "majid",
+     "behrouz", "farhad", "kaveh", "dariush", "arash", "babak", "navid")
+_add("F", "zahra", "maryam", "fatemeh", "narges", "shirin", "leila",
+     "parisa", "azadeh", "mina", "roya", "nasrin", "sahar", "golnaz")
+
+# South Asian (Indian / Pakistani / Bangladeshi)
+_add("M", "raj", "amit", "rahul", "sanjay", "vijay", "arjun", "rohan",
+     "aditya", "vikram", "anil", "suresh", "ramesh", "deepak", "manoj",
+     "ashok", "rakesh", "pradeep", "naveen", "karthik", "ganesh",
+     "harish", "dinesh", "imran", "asif", "tariq", "shahid", "kamal")
+_add("F", "priya", "anjali", "kavita", "sunita", "meena", "lakshmi",
+     "divya", "pooja", "neha", "shreya", "ananya", "aishwarya", "deepika",
+     "radha", "sita", "gita", "usha", "rekha", "shanti", "padma",
+     "nusrat", "farah", "sana", "hina", "rabia", "sadia", "tahira")
+
+# Chinese (romanized)
+_add("M", "wei", "ming", "jun", "feng", "lei", "hao", "bin", "tao",
+     "qiang", "peng", "gang", "bo", "dong", "liang", "jianguo", "zhiwei")
+_add("F", "fang", "xiu", "ying", "mei", "lan", "yan", "juan", "xia",
+     "hui", "na", "jing", "li", "hong", "yun", "qian", "xiaoyan")
+
+# Japanese (romanized)
+_add("M", "hiroshi", "takashi", "kenji", "akira", "satoshi", "kazuo",
+     "makoto", "haruto", "yuto", "sota", "riku", "daiki", "kaito",
+     "ren", "takumi", "shota", "kenta", "ryo", "naoki", "taro")
+_add("F", "yuki", "sakura", "hana", "aoi", "yui", "rin", "mio", "akari",
+     "miyu", "honoka", "ayaka", "nanami", "misaki", "kaori", "naoko",
+     "keiko", "yoko", "emi", "mariko", "tomoko", "chiyo", "haruka")
+
+# Korean (romanized)
+_add("M", "minjun", "seojun", "dohyun", "jihoon", "junseo", "hyunwoo",
+     "jisung", "sungmin", "taeyang", "jaewon", "donghyun", "kyungsoo")
+_add("F", "seoyeon", "jiwoo", "minseo", "hayoon", "soyeon", "yuna",
+     "chaewon", "eunji", "hyejin", "sujin", "jiyoung", "nayeon")
+
+# Vietnamese
+_add("M", "minh", "hung", "dung", "tuan", "duc", "quang", "khanh",
+     "phuc", "thanh", "trung", "bao", "long", "nam", "son", "hieu")
+_add("F", "linh", "huong", "thao", "trang", "ngoc", "nhung", "phuong",
+     "quynh", "van", "thu", "hanh", "mai", "lan", "dao", "hoa")
+
+# Swahili / East African
+_add("M", "juma", "baraka", "amani", "jabari", "kofi", "kwame", "sefu",
+     "daudi", "hamisi", "rashidi", "omari", "salim", "abasi")
+_add("F", "amara", "zawadi", "neema", "imani", "asha", "rehema",
+     "subira", "halima", "mwanaisha", "saida", "zuhura", "penda")
+
+#: honorifics across languages/scripts (lowercased, dots stripped)
+HONORIFICS: FrozenSet[str] = frozenset({
+    "mr", "mrs", "ms", "miss", "mx", "dr", "prof", "rev", "sir", "madam",
+    "lady", "lord", "master", "fr", "sr", "sra", "srta", "don", "dona",
+    "herr", "frau", "mme", "mlle", "monsieur", "madame", "signor",
+    "signora", "signorina", "dhr", "mevr", "pan", "pani", "gospodin",
+    "gospozha", "kyrios", "kyria", "bay", "bayan", "sheikh", "sayyid",
+    "ustad", "haji", "shri", "smt", "kumari", "sensei", "san",
+})
+
+#: surname particles that may be lowercase inside a valid full name
+SURNAME_PARTICLES: FrozenSet[str] = frozenset({
+    "de", "del", "de la", "da", "dos", "das", "van", "van der", "van den",
+    "von", "zu", "di", "della", "le", "la", "du", "des", "el", "al", "bin",
+    "ibn", "abu", "ben", "bat", "ter", "ten", "op", "af", "av", "mac", "mc",
+    "o", "san", "santa", "st",
+})
+
+
+def gender_of(name: str) -> str:
+    """'M' / 'F' / 'U' (unisex or unknown)."""
+    return GIVEN_NAMES.get(name.lower(), "U")
+
+
+def is_given_name(name: str) -> bool:
+    return name.lower() in GIVEN_NAMES

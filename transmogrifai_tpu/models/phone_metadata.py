@@ -1,0 +1,76 @@
+"""Dialing metadata for 48 calling regions (libphonenumber-lite).
+
+Reference parity: the reference's ``PhoneNumberParser`` rides Google's
+libphonenumber metadata (core/.../utils/text/, models/); this table keeps
+the subset its parsing actually needs — country calling code, trunk
+("national direct dialing") prefix, and valid national significant number
+lengths — for the reference test surface's regions plus the world's most
+common calling regions.  Lengths are the full valid sets for general
+subscriber numbers (fixed + mobile), per the ITU national numbering plans.
+"""
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, NamedTuple, Optional, Tuple
+
+
+class RegionMeta(NamedTuple):
+    country_code: str          # E.164 country calling code (no '+')
+    lengths: FrozenSet[int]    # valid national significant number lengths
+    trunk_prefix: str          # digits stripped from national format ("" = none)
+
+
+def _r(cc: str, lengths, trunk: str = "0") -> RegionMeta:
+    return RegionMeta(cc, frozenset(lengths), trunk)
+
+
+REGIONS: Dict[str, RegionMeta] = {
+    # North America (NANP: no trunk prefix; '1' sometimes written — handled
+    # by the country-code branch)
+    "US": _r("1", {10}, ""), "CA": _r("1", {10}, ""),
+    "MX": _r("52", {10}, "01"),
+    # South America
+    "BR": _r("55", {10, 11}), "AR": _r("54", {10}), "CL": _r("56", {9}, ""),
+    "CO": _r("57", {10}, ""), "PE": _r("51", {9}),
+    # Europe
+    "GB": _r("44", {9, 10}), "IE": _r("353", {7, 8, 9}),
+    "FR": _r("33", {9}), "DE": _r("49", {7, 8, 9, 10, 11}),
+    "ES": _r("34", {9}, ""), "PT": _r("351", {9}, ""),
+    "IT": _r("39", {8, 9, 10, 11}, ""), "NL": _r("31", {9}),
+    "BE": _r("32", {8, 9}), "CH": _r("41", {9}), "AT": _r("43", {7, 8, 9, 10, 11}),
+    "SE": _r("46", {7, 8, 9}), "NO": _r("47", {8}, ""), "DK": _r("45", {8}, ""),
+    "FI": _r("358", {6, 7, 8, 9, 10}), "PL": _r("48", {9}, ""),
+    "CZ": _r("420", {9}, ""), "RO": _r("40", {9}), "HU": _r("36", {8, 9}, "06"),
+    "GR": _r("30", {10}, ""), "TR": _r("90", {10}), "RU": _r("7", {10}, "8"),
+    "UA": _r("380", {9}),
+    # Middle East & Africa
+    "IL": _r("972", {8, 9}), "SA": _r("966", {8, 9}), "AE": _r("971", {8, 9}),
+    "EG": _r("20", {8, 9, 10}), "ZA": _r("27", {9}), "NG": _r("234", {8, 10}),
+    "KE": _r("254", {9}), "MA": _r("212", {9}),
+    # Asia-Pacific
+    "IN": _r("91", {10}), "PK": _r("92", {9, 10}), "BD": _r("880", {8, 9, 10}),
+    "CN": _r("86", {11}, ""), "JP": _r("81", {9, 10}), "KR": _r("82", {8, 9, 10}),
+    "SG": _r("65", {8}, ""), "ID": _r("62", {8, 9, 10, 11}),
+    "AU": _r("61", {9}), "NZ": _r("64", {8, 9, 10}),
+}
+
+#: longest-first country codes for '+'-prefixed matching
+_CODES_DESC: Tuple[Tuple[str, str], ...] = tuple(
+    sorted(((m.country_code, region) for region, m in REGIONS.items()),
+           key=lambda t: (-len(t[0]), t[0])))
+
+
+def region_of(country_code_digits: str) -> Optional[str]:
+    """First region whose country code prefixes ``country_code_digits``."""
+    for code, region in _CODES_DESC:
+        if country_code_digits.startswith(code):
+            return region
+    return None
+
+
+def valid_international(digits: str) -> bool:
+    """True when '+'-stripped ``digits`` = some region's code + valid length."""
+    for code, region in _CODES_DESC:
+        if digits.startswith(code) and \
+                (len(digits) - len(code)) in REGIONS[region].lengths:
+            return True
+    return False

@@ -28,6 +28,8 @@ hyperparameter ``blob``; ``xb_idx`` picks the pre-binned matrix in ``xbs``:
     problem ∈ {"binary", "regression"}
     frag = ("fista",  cis, max_iter, fit_intercept, off_l1, off_l2)
          | ("newton", cis, max_iter, fit_intercept, off_l2)
+         | ("svc",    cis, max_iter, fit_intercept, off_l2)
+         | ("mlp",    cis, layers, max_iter, off_lr, off_seed)
          | ("forest", out_c, groups)   # RF / DT
          | ("gbt", loss, out_c, groups)
     forest group = (cis, depth, n_trees, xb_idx, n_bins, frac, rate,
@@ -91,6 +93,32 @@ def _newton_scores(frag, X, y, train_w, blob):
                                            fit_intercept=fit_intercept)
     z = jnp.einsum("nd,fgd->fgn", X, fit.coef) + fit.intercept[..., :1]
     return jax.nn.sigmoid(z)
+
+
+def _svc_scores(frag, X, y, train_w, blob):
+    """Squared-hinge SVC: the host path emits raw margins but NO probability
+    (Spark LinearSVC parity), so its evaluator sees the HARD prediction as
+    the score — the fused score reproduces exactly that 0/1 score."""
+    _, cis, max_iter, fit_intercept, off_l2 = frag
+    l2 = blob[off_l2:off_l2 + len(cis)]
+    fit = L.fit_svc_grid_folds(X, y, train_w, l2, max_iter=max_iter,
+                               fit_intercept=fit_intercept)
+    z = jnp.einsum("nd,fgd->fgn", X, fit.coef) + fit.intercept[..., :1]
+    return (z >= 0.0).astype(jnp.float32)
+
+
+def _mlp_scores(frag, X, y, train_w, blob):
+    """Batched MLP: p(class 1) per (fold, candidate)."""
+    from . import mlp as M
+
+    _, cis, layers, max_iter, off_lr, off_seed = frag
+    G = len(cis)
+    lrs = blob[off_lr:off_lr + G]
+    seeds = blob[off_seed:off_seed + G].astype(jnp.int32)
+    params = M.fit_mlp_grid_folds(X, y, train_w, lrs, seeds,
+                                  layers=layers, max_iter=max_iter)
+    _, prob, _ = M.predict_mlp_grid(params, X)
+    return prob[..., 1]
 
 
 def _forest_group_scores(group, xbs, y, train_w, blob, out_c: int):
@@ -204,6 +232,10 @@ def _frag_scores(frag, X, xbs, y, train_w, blob, problem: str):
         return frag[1], _fista_scores(frag, X, y, train_w, blob, classification)
     if kind == "newton":
         return frag[1], _newton_scores(frag, X, y, train_w, blob)
+    if kind == "svc":
+        return frag[1], _svc_scores(frag, X, y, train_w, blob)
+    if kind == "mlp":
+        return frag[1], _mlp_scores(frag, X, y, train_w, blob)
     if kind == "forest":
         _, out_c, groups = frag
         cis_all, outs = [], []

@@ -173,6 +173,46 @@ def chunked(X: np.ndarray, y: Optional[np.ndarray] = None,
 
 
 @jax.jit
+def _fused_stats_step(carry, X, yv, m):
+    """ONE-pass moments + mean-centered Gram via Chan's pairwise merge.
+
+    carry: (n, mean[d], y_mean, mn, mx, G[d,d], gy[d], yy) where G/gy/yy are
+    centered at the CARRY means.  Each chunk is centered at its OWN means
+    and merged with the exact pairwise-update cross terms
+    (f = n0*nc/(n0+nc); G += Gc + f dx dx^T; gy += gyc + f dx dy;
+    yy += yyc + f dy^2), so no large-offset cancellation ever enters the
+    f32 accumulators — a constant-center scheme would cancel catastrophically
+    on row-ordered data whose mean drifts.  ONE pass means each chunk
+    uploads once: on a tunneled backend the second upload of the matrix was
+    the single largest cost of the two-pass scheme (round-5 measurement:
+    ~63 MB/s real upload bandwidth on incompressible data).
+    """
+    n0, mean0, ym0, mn, mx, G, gy, yy = carry
+    nc = m.sum()
+    ncs = jnp.maximum(nc, 1.0)
+    mc = (X * m[:, None]).sum(axis=0) / ncs
+    yc = (yv * m).sum() / ncs
+    Z = (X - mc[None, :]) * m[:, None]
+    zy = (yv - yc) * m
+    Gc = Z.T @ Z
+    gyc = Z.T @ zy
+    yyc = (zy * zy).sum()
+    nt = n0 + nc
+    f = jnp.where(nt > 0, n0 * nc / jnp.maximum(nt, 1.0), 0.0)
+    dx = mc - mean0
+    dy = yc - ym0
+    G = G + Gc + f * jnp.outer(dx, dx)
+    gy = gy + gyc + f * dx * dy
+    yy = yy + yyc + f * dy * dy
+    w = nc / jnp.maximum(nt, 1.0)
+    mean = mean0 + dx * w
+    ym = ym0 + dy * w
+    mn = jnp.minimum(mn, jnp.where(m[:, None] > 0, X, jnp.inf).min(axis=0))
+    mx = jnp.maximum(mx, jnp.where(m[:, None] > 0, X, -jnp.inf).max(axis=0))
+    return nt, mean, ym, mn, mx, G, gy, yy
+
+
+@jax.jit
 def _midrank_cols(Xb):
     """Per-column average-tie midranks (1-based): f32[n, k] -> f32[n, k]."""
 
@@ -199,6 +239,63 @@ def rank_transform(X: np.ndarray, block_cols: int = 128) -> np.ndarray:
         blk = np.ascontiguousarray(X[:, lo:lo + block_cols])
         out[:, lo:lo + block_cols] = np.asarray(_midrank_cols(jnp.asarray(blk)))
     return out
+
+
+def fused_moments_and_correlations(chunks_factory, d: int, mesh=None,
+                                   with_corr_matrix: bool = True
+                                   ) -> Tuple[ColStats, np.ndarray,
+                                              Optional[np.ndarray]]:
+    """ONE streaming pass: column moments AND label/feature correlations.
+
+    ``chunks_factory()`` yields (X_chunk [rows, d], y_chunk [rows]) pairs —
+    each chunk uploads ONCE (the two-pass scheme re-uploaded the whole
+    matrix for the Gram pass; uploads dominate on a tunneled link).  Gram,
+    mean, and variance accumulate with Chan's numerically-stable pairwise
+    merge (see _fused_stats_step); variance falls out of the centered
+    Gram's diagonal.
+    """
+    acc = DataShardedStats(d, mesh=mesh)
+    carry = None
+    for X, y in chunks_factory():
+        X = np.ascontiguousarray(np.asarray(X, np.float32))
+        y = np.asarray(y, np.float32)
+        rows = X.shape[0]
+        pad = (-rows) % acc.n_shards
+        m = np.ones(rows, np.float32)
+        if pad:
+            X = np.concatenate([X, np.zeros((pad, d), np.float32)])
+            y = np.concatenate([y, np.zeros(pad, np.float32)])
+            m = np.concatenate([m, np.zeros(pad, np.float32)])
+        if carry is None:
+            carry = (jnp.zeros(()), jnp.zeros(d), jnp.zeros(()),
+                     jnp.full(d, jnp.inf), jnp.full(d, -jnp.inf),
+                     jnp.zeros((d, d)), jnp.zeros(d), jnp.zeros(()))
+        carry = _fused_stats_step(carry, acc._place(X), acc._place(y),
+                                  acc._place(m))
+    if carry is None:
+        z = np.zeros(d)
+        return ColStats(0, z, z.copy(), z.copy(), z.copy()), \
+            np.full(d, np.nan), None
+    n_, mean, _ym, mn, mx, G, gy, yy = (np.asarray(c, np.float64)
+                                        for c in carry)
+    n = float(n_)
+    yy = float(yy)
+    # sample variance straight off the centered Gram's diagonal
+    var = np.maximum(np.diag(G), 0.0) / max(n - 1.0, 1.0)
+    stats = ColStats(count=int(n), mean=mean, variance=var, min=mn, max=mx)
+    diag = np.diag(G).copy()
+    zero = diag <= 0.0
+    denom = np.sqrt(np.maximum(diag, 1e-300))
+    with np.errstate(invalid="ignore", divide="ignore"):
+        corr_label = gy / (denom * np.sqrt(max(yy, 1e-300)))
+    corr_label[zero] = np.nan
+    corr_matrix = None
+    if with_corr_matrix:
+        corr_matrix = G / np.outer(denom, denom)
+        np.fill_diagonal(corr_matrix, 1.0)
+        corr_matrix[zero, :] = np.nan
+        corr_matrix[:, zero] = np.nan
+    return stats, corr_label, corr_matrix
 
 
 def sharded_correlations(X: np.ndarray, y: np.ndarray, mesh=None,

@@ -12,10 +12,12 @@ from .base import (
 )
 from .files import (
     AggregateAvroReader,
+    AggregateCSVCaseReader,
     AggregateCSVReader,
     AggregateParquetReader,
     AvroReader,
     ConditionalAvroReader,
+    ConditionalCSVCaseReader,
     ConditionalCSVReader,
     ConditionalParquetReader,
     CSVAutoReader,
@@ -40,11 +42,13 @@ class DataReaders:
 
     class Aggregate:
         csv = AggregateCSVReader
+        csv_case = AggregateCSVCaseReader
         avro = AggregateAvroReader
         parquet = AggregateParquetReader
 
     class Conditional:
         csv = ConditionalCSVReader
+        csv_case = ConditionalCSVCaseReader
         avro = ConditionalAvroReader
         parquet = ConditionalParquetReader
 

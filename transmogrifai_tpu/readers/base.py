@@ -77,17 +77,26 @@ class Reader:
         raise NotImplementedError
 
     # ---- join combinators (Reader.scala:112-134) ---------------------------
-    def inner_join(self, other: "Reader", on: str = KEY_FIELD) -> "JoinedReader":
+    # ``right_features`` names the raw features produced by ``other`` — the
+    # analog of the reference binding features to a source record type
+    # (needed when extractors carry no field name to route by)
+    def inner_join(self, other: "Reader", on: str = KEY_FIELD,
+                   right_features=None) -> "JoinedReader":
         from .joined import JoinedReader
-        return JoinedReader(self, other, how="inner", on=on)
+        return JoinedReader(self, other, how="inner", on=on,
+                            right_features=right_features)
 
-    def left_outer_join(self, other: "Reader", on: str = KEY_FIELD) -> "JoinedReader":
+    def left_outer_join(self, other: "Reader", on: str = KEY_FIELD,
+                        right_features=None) -> "JoinedReader":
         from .joined import JoinedReader
-        return JoinedReader(self, other, how="left", on=on)
+        return JoinedReader(self, other, how="left", on=on,
+                            right_features=right_features)
 
-    def outer_join(self, other: "Reader", on: str = KEY_FIELD) -> "JoinedReader":
+    def outer_join(self, other: "Reader", on: str = KEY_FIELD,
+                   right_features=None) -> "JoinedReader":
         from .joined import JoinedReader
-        return JoinedReader(self, other, how="outer", on=on)
+        return JoinedReader(self, other, how="outer", on=on,
+                            right_features=right_features)
 
 
 class DataReader(Reader):

@@ -127,6 +127,10 @@ def _with_conditional(reader_cls):
 
 
 AggregateCSVReader = _with_aggregate(CSVAutoReader)
+#: schema'd (headerless) variants — the reference's ``csvCase`` readers,
+#: whose schema comes from the case class (DataReaders.scala:44)
+AggregateCSVCaseReader = _with_aggregate(CSVReader)
+ConditionalCSVCaseReader = _with_conditional(CSVReader)
 AggregateParquetReader = _with_aggregate(ParquetReader)
 AggregateAvroReader = _with_aggregate(AvroReader)
 ConditionalCSVReader = _with_conditional(CSVAutoReader)

@@ -21,23 +21,39 @@ class JoinedReader(Reader):
     Each side generates its own feature columns; rows are aligned by key with
     pandas-style inner/left/outer semantics."""
 
-    def __init__(self, left: Reader, right: Reader, how: str = "inner", on: str = KEY_FIELD):
+    def __init__(self, left: Reader, right: Reader, how: str = "inner",
+                 on: str = KEY_FIELD,
+                 right_features: Optional[Sequence[str]] = None):
         self.left = left
         self.right = right
         self.how = how
         self.on = on
+        #: names of raw features produced by the RIGHT reader.  The
+        #: reference binds features to a source by record type
+        #: (FeatureBuilder.Real[Click] vs [Send]); fn-extractor features
+        #: carry no field name to route by, so joins of same-shaped event
+        #: tables declare the right side's features here.
+        self.right_features = set(right_features or ())
 
-    def generate_dataset(self, raw_features: Sequence[Feature],
-                         params: Optional[Dict[str, Any]] = None) -> Dataset:
-        # split features by which side can produce them: try left first
+    def _split_features(self, raw_features: Sequence[Feature]):
+        """Route features to the side that produces them: explicit
+        ``right_features`` first, then by extractor field name against the
+        left source's columns; unresolvable features default left."""
         left_feats, right_feats = [], []
         left_cols = self._side_columns(self.left)
         for f in raw_features:
             field = getattr(f.origin_stage.extract_fn, "field_name", None)
-            if left_cols is not None and field is not None:
+            if f.name in self.right_features:
+                right_feats.append(f)
+            elif left_cols is not None and field is not None:
                 (left_feats if field in left_cols else right_feats).append(f)
             else:
                 left_feats.append(f)
+        return left_feats, right_feats
+
+    def generate_dataset(self, raw_features: Sequence[Feature],
+                         params: Optional[Dict[str, Any]] = None) -> Dataset:
+        left_feats, right_feats = self._split_features(raw_features)
         lds = self.left.generate_dataset(left_feats, params)
         rds = self.right.generate_dataset(right_feats, params)
         lkey = {k: i for i, k in enumerate(lds.key)}
@@ -79,7 +95,8 @@ class JoinedReader(Reader):
         time window; left-side rows keep one copy per key (the reference's
         dummy aggregators)."""
         return JoinedAggregateReader(self.left, self.right, how=self.how,
-                                     on=self.on, time_filter=time_filter)
+                                     on=self.on, time_filter=time_filter,
+                                     right_features=self.right_features)
 
 
 class TimeBasedFilter:
@@ -99,8 +116,10 @@ class JoinedAggregateReader(JoinedReader):
     one-to-many joins resolve by aggregating the many side per key."""
 
     def __init__(self, left: Reader, right: Reader, how: str = "inner",
-                 on: str = KEY_FIELD, time_filter: Optional[TimeBasedFilter] = None):
-        super().__init__(left, right, how=how, on=on)
+                 on: str = KEY_FIELD, time_filter: Optional[TimeBasedFilter] = None,
+                 right_features: Optional[Sequence[str]] = None):
+        super().__init__(left, right, how=how, on=on,
+                         right_features=right_features)
         if time_filter is None:
             raise ValueError("JoinedAggregateReader needs a TimeBasedFilter")
         self.time_filter = time_filter
@@ -111,14 +130,7 @@ class JoinedAggregateReader(JoinedReader):
         from ..columns import column_from_scalars
         from ..features.generator import Event, FeatureGeneratorStage
 
-        left_feats, right_feats = [], []
-        left_cols = self._side_columns(self.left)
-        for f in raw_features:
-            field = getattr(f.origin_stage.extract_fn, "field_name", None)
-            if left_cols is not None and field is not None:
-                (left_feats if field in left_cols else right_feats).append(f)
-            else:
-                left_feats.append(f)
+        left_feats, right_feats = self._split_features(raw_features)
         lds = self.left.generate_dataset(left_feats, params)
 
         tf = self.time_filter
@@ -140,8 +152,11 @@ class JoinedAggregateReader(JoinedReader):
                         continue  # outside the aggregation window
                     events.append(Event(stage.extract(r), t))
                 events.sort(key=lambda e: e.time)
+                # post-join response windows are EXCLUSIVE at the upper bound
+                # (JoinedDataReader.scala:434), unlike the plain aggregate path
                 vals.append(stage.aggregate(events, cutoff_ms=tf.cutoff_time_ms,
-                                            responses_after_cutoff=f.is_response))
+                                            responses_after_cutoff=f.is_response,
+                                            response_window_inclusive=False))
             cols[f.name] = column_from_scalars(f.ftype, vals)
         rds = Dataset(cols, np.array([str(k) for k in keys], dtype=object))
 

@@ -159,13 +159,25 @@ def _fused_layer(ds: Dataset, fusables: Sequence[Transformer]) -> Dict[str, Any]
     return new_cols
 
 
+#: above this many rows the fused DEVICE layer is skipped in favor of the
+#: stages' host (numpy) batch functions: every fused output must come back
+#: to the host columnar store, and on a tunneled backend device->host reads
+#: run ~20 MB/s (round-5 link probe) — a 10M x 500 pull alone would cost
+#: ~18 min.  Co-located deployments can raise TMOG_FUSE_MAX_ROWS.
+def _fuse_max_rows() -> int:
+    import os
+
+    return int(os.environ.get("TMOG_FUSE_MAX_ROWS", 200_000))
+
+
 def _apply_layer_transforms(ds: Dataset, transformers: Sequence[Transformer]) -> Dataset:
     """Fused layer transform (applyOpTransformations analog,
     FitStagesUtil.scala:96): transformers implementing the ``jax_transform``
     protocol compile into ONE jitted computation per layer; the rest apply
     per stage off the same input batch."""
     new_cols = {}
-    fusables = [t for t in transformers if _fusable(t, ds)]
+    fusables = ([t for t in transformers if _fusable(t, ds)]
+                if len(ds) <= _fuse_max_rows() else [])
     rest = [t for t in transformers if t not in fusables]
     if len(fusables) == 1:  # no fusion win; avoid a second jit cache entry
         rest = list(transformers)
