@@ -15,8 +15,11 @@ Scale choices, stated honestly:
   ``max_training_sample`` (reference SplitterParamDefaults 1E6; default here
   500k so the sweep's X fits one chip's HBM comfortably) — the reference
   applies exactly this cap.
-- SanityChecker streams the FULL data (no 100k sampling) — beyond the
-  reference, to prove the sharded stats path at 10M rows.
+- SanityChecker keeps the reference's 100k sample cap
+  (``sample_upper_limit``, SanityChecker.scala:58-92) — identical
+  semantics; the UNCAPPED one-pass streaming stats path is proven
+  separately at multi-million-row scale
+  (tests/test_sharded_stats.py + the round-5 3M-row device measurement).
 - ``transmogrify`` runs without the label (no per-feature decision-tree
   bucketizers), matching the reference's plain ``.transmogrify()`` default.
 - Workflow-level CV is opted out (``with_selector_cv``) to bound wall-clock:
@@ -139,9 +142,12 @@ def build(df):
 
 
 def main():
-    from transmogrifai_tpu.utils.backend import ensure_backend
+    from transmogrifai_tpu.utils.backend import ensure_backend, start_keepalive
 
     platform, fallback = ensure_backend(fresh=True)
+    # the tunneled TPU worker idles out during the long host-only vectorizer
+    # phases at 10M rows; keep the session warm (utils/backend.start_keepalive)
+    start_keepalive(60.0)
     from transmogrifai_tpu.utils.listener import OpListener
 
     def log(msg):

@@ -152,3 +152,61 @@ def test_balancer_weights_fused(data):
                     assert a == pytest.approx(r.metric_value, abs=1e-4)
         finally:
             os.environ.pop("TMOG_FUSED_SWEEP", None)
+
+
+def test_multiclass_fused_matches_legacy():
+    """Multiclass sweeps (softmax LR, class-distribution forests, softmax
+    boosting, MLP) run fused with device F1/precision/recall/error."""
+    from transmogrifai_tpu.evaluators.classification import \
+        OpMultiClassificationEvaluator
+    from transmogrifai_tpu.impl.classification.mlp import \
+        OpMultilayerPerceptronClassifier
+    from transmogrifai_tpu.impl.classification.trees import OpXGBoostClassifier
+
+    rng = np.random.default_rng(21)
+    n, d, k = 300, 8, 3
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    centers = rng.normal(size=(k, d)) * 1.5
+    y = np.argmin(((X[:, None, :] - centers[None]) ** 2).sum(-1),
+                  axis=1).astype(np.float32)
+    cands = [
+        (OpLogisticRegression(max_iter=60),
+         [{"reg_param": 0.01, "elastic_net_param": 0.1},
+          {"reg_param": 0.1, "elastic_net_param": 0.5}]),
+        (OpRandomForestClassifier(num_trees=8),
+         [{"max_depth": 3}, {"max_depth": 5}]),
+        (OpXGBoostClassifier(num_round=8, max_depth=3), [{"eta": 0.3}]),
+        (OpMultilayerPerceptronClassifier(hidden_layers=(6,), max_iter=30),
+         [{"step_size": 0.05}]),
+    ]
+    fused, legacy = _summaries(OpCrossValidation,
+                               OpMultiClassificationEvaluator(), cands, X, y,
+                               num_folds=3)
+    assert fused.best.model_name == legacy.best.model_name
+    for rf, rl in zip(fused.results, legacy.results):
+        assert rf.grid == rl.grid
+        assert rf.metric_value == pytest.approx(rl.metric_value, abs=2e-3), \
+            (rf.model_name, rf.grid)
+
+
+def test_multiclass_k2_forest_fused(data):
+    """Binary labels under the MULTICLASS evaluator must still fuse: the
+    score buffer carries a trailing k=2 class axis, so forest fragments must
+    emit 2-channel distribution leaves (round-5 review finding)."""
+    from transmogrifai_tpu.evaluators.classification import \
+        OpMultiClassificationEvaluator
+    from transmogrifai_tpu.impl.sweep_fragments import build_sweep_plan
+
+    X, y, _ = data
+    cands = [(OpRandomForestClassifier(num_trees=6), [{"max_depth": 3}]),
+             (OpLogisticRegression(max_iter=40), [{"reg_param": 0.01}])]
+    v = OpCrossValidation(OpMultiClassificationEvaluator(), num_folds=2,
+                          seed=4, mesh=None)
+    train_w, _vm = v.make_folds(len(y), None)
+    plan = build_sweep_plan(cands, X, y, train_w, v.evaluator)
+    assert plan is not None and plan.spec[0] == ("multiclass", 2)
+    fused, legacy = _summaries(OpCrossValidation,
+                               OpMultiClassificationEvaluator(), cands, X, y,
+                               num_folds=2)
+    for rf, rl in zip(fused.results, legacy.results):
+        assert rf.metric_value == pytest.approx(rl.metric_value, abs=2e-3)

@@ -8,14 +8,17 @@ surface return None and the validator keeps its legacy per-family path, so
 custom estimators lose nothing.
 
 Supported families (the full reference DEFAULT sweeps,
-DefaultSelectorParams.scala:37-75):
+DefaultSelectorParams.scala:37-75) across all three problem types
+(binary / multiclass / regression):
 
-- OpLogisticRegression (binary; reg_param/elastic_net_param grids),
+- OpLogisticRegression (binary sigmoid or multinomial softmax grids),
 - OpLinearRegression (reg_param/elastic_net_param),
-- OpRandomForestClassifier / OpDecisionTreeClassifier (binary) and the
-  regressor twins — any grid over trees_common._FOREST_GRID_KEYS,
-- OpGBTClassifier / OpXGBoostClassifier (binary) and the regressor twins —
-  any grid over trees_common._DYNAMIC_BOOST_KEYS + static boosting shape.
+- OpLinearSVC (binary; reg_param) and
+  OpMultilayerPerceptronClassifier (hidden_layers/max_iter/step_size/seed),
+- OpRandomForestClassifier / OpDecisionTreeClassifier and the regressor
+  twins — any grid over trees_common._FOREST_GRID_KEYS,
+- OpGBTClassifier / OpXGBoostClassifier and the regressor twins — any grid
+  over trees_common._DYNAMIC_BOOST_KEYS + static boosting shape.
 
 Frontier sizing: with the bootstrap drawn on DEVICE the builder cannot read
 the realized Poisson weight sums, so it bounds them: mean + 5 sigma of the
@@ -32,7 +35,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..ops import trees as Tr
-from ..ops.metrics import BINARY_METRICS, REGRESSION_METRICS
+from ..ops.metrics import (BINARY_METRICS, MULTICLASS_METRICS,
+                           REGRESSION_METRICS)
 from ..utils import devcache
 from .trees_common import (DEFAULT_MAX_FRONTIER, DEFAULT_MAX_FRONTIER_BOOSTED,
                            _DYNAMIC_BOOST_KEYS, _FOREST_GRID_KEYS)
@@ -63,15 +67,19 @@ class _Blob:
 class SweepPlan:
     """A ready-to-run fused sweep: spec + arrays + metric bookkeeping."""
 
-    def __init__(self, spec, X, xbs, y, blob, problem: str):
+    def __init__(self, spec, X, xbs, y, blob, problem):
         self.spec = spec
         self.X = X
         self.xbs = xbs
         self.y = y
         self.blob = blob
         self.problem = problem
-        self.metric_names = (BINARY_METRICS if problem == "binary"
-                            else REGRESSION_METRICS)
+        if problem == "binary":
+            self.metric_names = BINARY_METRICS
+        elif isinstance(problem, tuple):  # ("multiclass", k)
+            self.metric_names = MULTICLASS_METRICS
+        else:
+            self.metric_names = REGRESSION_METRICS
 
     def run(self, train_w: np.ndarray, val_mask: np.ndarray) -> np.ndarray:
         """Execute; returns host metrics [F, C, M] (ONE device pull)."""
@@ -170,7 +178,8 @@ def _svc_fragments(est, grids, pos: int, blob: _Blob) -> Optional[List]:
              bool(est.get_param("fit_intercept", True)), blob.add(l2))]
 
 
-def _mlp_fragments(est, grids, pos: int, blob: _Blob, d: int) -> Optional[List]:
+def _mlp_fragments(est, grids, pos: int, blob: _Blob, d: int,
+                   n_classes: int = 2) -> Optional[List]:
     allowed = ("hidden_layers", "max_iter", "step_size", "seed")
     for g in grids:
         for k in g:
@@ -183,7 +192,7 @@ def _mlp_fragments(est, grids, pos: int, blob: _Blob, d: int) -> Optional[List]:
         groups.setdefault((hl, int(c.get_param("max_iter", 200))), []).append(i)
     frags = []
     for (hl, mi), idxs in groups.items():
-        layers = (d,) + hl + (2,)  # binary: builder guarantees 2 classes
+        layers = (d,) + hl + (n_classes,)
         lrs = [float(cands[i].get_param("step_size", 0.03)) for i in idxs]
         seeds = [float(int(cands[i].get_param("seed", 42))) for i in idxs]
         frags.append(("mlp", tuple(int(pos + i) for i in idxs), layers, mi,
@@ -192,7 +201,7 @@ def _mlp_fragments(est, grids, pos: int, blob: _Blob, d: int) -> Optional[List]:
 
 
 def _forest_fragment(est, grids, pos: int, blob: _Blob, xbs, X, train_w,
-                     classification: bool) -> Optional[List]:
+                     classification: bool, n_classes: int = 1) -> Optional[List]:
     for g in grids:
         for k in g:
             if k not in _FOREST_GRID_KEYS:
@@ -213,6 +222,11 @@ def _forest_fragment(est, grids, pos: int, blob: _Blob, xbs, X, train_w,
     fold_sum = float(tw.sum(axis=1).max())
     max_w = float(tw.max()) if tw.size else 1.0
     out_groups = []
+    # 1-channel leaves for binary AND k=2-multiclass (the variance kernel's
+    # splits are gini-identical and match the legacy path bit-for-bit; the
+    # interpreter expands p -> [1-p, p] for the k=2 score buffer); true
+    # multiclass gets class-distribution leaves
+    c = n_classes if (classification and n_classes > 2) else 1
     for (depth, ntrees, n_bins, frac, rate, bag, seed), idxs in groups.items():
         mcw = [float(cands[i].get_param("min_instances_per_node", 1))
                for i in idxs]
@@ -226,7 +240,6 @@ def _forest_fragment(est, grids, pos: int, blob: _Blob, xbs, X, train_w,
             total_weight=bound)
         exact = Tr.frontier_is_exact(n, depth, mcw_min, 1.0, frontier,
                                      total_weight=bound)
-        c = 1  # binary/regression both use 1-channel leaves
         F = train_w.shape[0]
         TT = F * len(idxs) * ntrees
         chunk = Tr.balanced_chunk(
@@ -236,11 +249,31 @@ def _forest_fragment(est, grids, pos: int, blob: _Blob, xbs, X, train_w,
             _xb_index(xbs, X, n_bins), n_bins, frac,
             rate if bag else 1.0, bag, seed, frontier, exact, chunk,
             blob.add(mcw), blob.add(mig)))
-    return [("forest", 1, tuple(out_groups))]
+    return [("forest", c, tuple(out_groups))]
+
+
+def _softmax_fragments(est, grids, pos: int, blob: _Blob) -> Optional[List]:
+    """Multinomial LR: every grid goes through the softmax kernel (matches
+    logistic.fit_grid_folds' multinomial branch)."""
+    base_mi = int(est.get_param("max_iter", 100))
+    base_fi = bool(est.get_param("fit_intercept", True))
+    for g in grids:
+        for k in g:
+            if k not in ("reg_param", "elastic_net_param"):
+                return None
+    reg = np.array([float(g.get("reg_param", est.get_param("reg_param", 0.0)))
+                    for g in grids], np.float32)
+    alpha = np.array([float(g.get("elastic_net_param",
+                                  est.get_param("elastic_net_param", 0.0)))
+                      for g in grids], np.float32)
+    cis = tuple(range(pos, pos + len(grids)))
+    off_l1 = blob.add(reg * alpha)
+    off_l2 = blob.add(reg * (1.0 - alpha))
+    return [("fista", cis, base_mi, base_fi, off_l1, off_l2)]
 
 
 def _gbt_fragment(est, grids, pos: int, blob: _Blob, xbs, X, train_w,
-                  loss: str) -> Optional[List]:
+                  loss: str, n_classes: int = 2) -> Optional[List]:
     static_keys = ("num_round", "max_iter", "max_depth", "max_bins",
                    "subsample", "subsampling_rate", "colsample_bytree")
     for g in grids:
@@ -257,7 +290,7 @@ def _gbt_fragment(est, grids, pos: int, blob: _Blob, xbs, X, train_w,
                int(cands[i].get_param("seed", 42)))
         groups.setdefault(key, []).append(i)
     fold_sum = float(np.asarray(train_w, np.float32).sum(axis=1).max())
-    h_max = 0.25 if loss == "logistic" else 1.0
+    h_max = 0.25 if loss in ("logistic", "softmax") else 1.0
     fold_base = loss == "squared"
     out_groups = []
     for (rounds, depth, n_bins, subsample, colsample, seed), idxs in groups.items():
@@ -278,7 +311,8 @@ def _gbt_fragment(est, grids, pos: int, blob: _Blob, xbs, X, train_w,
             blob.add([bps[i]["gamma"] for i in idxs]),
             blob.add([bps[i]["min_child_weight"] for i in idxs]),
             blob.add([bps[i].get("min_info_gain", 0.0) for i in idxs])))
-    return [("gbt", loss, 1, tuple(out_groups))]
+    out_c = n_classes if loss == "softmax" else 1
+    return [("gbt", loss, out_c, tuple(out_groups))]
 
 
 def build_sweep_plan(candidates: Sequence[Tuple[Any, Sequence[Dict[str, Any]]]],
@@ -300,7 +334,8 @@ def build_sweep_plan(candidates: Sequence[Tuple[Any, Sequence[Dict[str, Any]]]],
                                    OpXGBoostRegressor)
 
     from ..evaluators import _SingleMetric
-    from ..evaluators.classification import OpBinaryClassificationEvaluator
+    from ..evaluators.classification import (OpBinaryClassificationEvaluator,
+                                             OpMultiClassificationEvaluator)
     from ..evaluators.regression import OpRegressionEvaluator
 
     yv = np.asarray(y)
@@ -310,9 +345,21 @@ def build_sweep_plan(candidates: Sequence[Tuple[Any, Sequence[Dict[str, Any]]]],
     # _SingleMetric (the Evaluators.* factory wrapper) delegates verbatim to
     # its inner evaluator, so unwrap it and honor its chosen default metric.
     inner = evaluator.inner if type(evaluator) is _SingleMetric else evaluator
+    n_classes = 2
     if type(inner) is OpBinaryClassificationEvaluator and binary:
         problem = "binary"
         if evaluator.default_metric not in BINARY_METRICS:
+            return None
+    elif type(inner) is OpMultiClassificationEvaluator:
+        if len(yv) == 0 or not np.isin(yv, np.arange(64)).all():
+            return None
+        n_classes = max(int(yv.max()) + 1, 2)
+        problem = ("multiclass", n_classes)
+        if evaluator.default_metric not in MULTICLASS_METRICS:
+            return None
+        # the [F, C, n, k] probability tensor must stay HBM-friendly
+        n_cand = sum(max(len(list(g) or [{}]), 1) for _, g in candidates)
+        if 8 * n_cand * len(yv) * n_classes * 4 > 2e9:
             return None
     elif type(inner) is OpRegressionEvaluator:
         problem = "regression"
@@ -330,7 +377,11 @@ def build_sweep_plan(candidates: Sequence[Tuple[Any, Sequence[Dict[str, Any]]]],
     for est, grids in candidates:
         grids = [dict(g) for g in (list(grids) or [{}])]
         G = len(grids)
-        if problem == "binary":
+        # k=2 under the multiclass evaluator trains the SAME binary models
+        # the legacy path does (family=auto resolves to binomial at 2
+        # classes); the interpreter expands p1 -> [1-p1, p1] score planes
+        if problem == "binary" or (isinstance(problem, tuple)
+                                   and problem[1] == 2):
             if isinstance(est, OpLogisticRegression):
                 fr = _lr_fragments(est, grids, pos, blob, yv)
                 s = 0
@@ -351,6 +402,22 @@ def build_sweep_plan(candidates: Sequence[Tuple[Any, Sequence[Dict[str, Any]]]],
             else:
                 fr = None
                 s = 0
+        elif isinstance(problem, tuple):  # multiclass, k > 2
+            s = 0  # argmax semantics; strict flags unused
+            if isinstance(est, OpLogisticRegression):
+                fr = _softmax_fragments(est, grids, pos, blob)
+            elif isinstance(est, OpRandomForestClassifier):
+                fr = _forest_fragment(est, grids, pos, blob, xbs, X, train_w,
+                                      classification=True,
+                                      n_classes=n_classes)
+            elif isinstance(est, (OpGBTClassifier, OpXGBoostClassifier)):
+                fr = _gbt_fragment(est, grids, pos, blob, xbs, X, train_w,
+                                   loss="softmax", n_classes=n_classes)
+            elif isinstance(est, OpMultilayerPerceptronClassifier):
+                fr = _mlp_fragments(est, grids, pos, blob, X.shape[1],
+                                    n_classes=n_classes)
+            else:
+                fr = None
         else:
             if isinstance(est, OpLinearRegression):
                 fr = _linreg_fragments(est, grids, pos, blob)

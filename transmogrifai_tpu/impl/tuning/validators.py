@@ -224,14 +224,34 @@ class OpValidator:
         try:
             from ..sweep_fragments import build_sweep_plan
 
-            plan = build_sweep_plan(candidates, X, y, train_w, self.evaluator)
+            # HBM guard: one monolithic program holding every family's
+            # workspaces plus the [F, C, n] score block crashed the worker at
+            # 450k x 64 candidates (round-5) — bound the per-launch score
+            # bytes and run the sweep as a few candidate-chunk launches
+            budget = float(os.environ.get("TMOG_FUSED_SCORES_BYTES", 3e8))
+            per_cand = train_w.shape[0] * len(y) * 4.0
+            inner_ev = getattr(self.evaluator, "inner", self.evaluator)
+            if "Multi" in type(inner_ev).__name__:  # [F, C, n, k] scores
+                per_cand *= max(int(np.max(np.asarray(y))) + 1, 2)
+            chunks = _chunk_candidates(
+                candidates, max(int(budget // max(per_cand, 1.0)), 1))
+            # convert ONCE: devcache keys device buffers by host-array
+            # identity, so each chunk's plan must see the SAME ndarray or
+            # every chunk re-uploads and re-quantizes the matrix
+            X = np.ascontiguousarray(np.asarray(X, np.float32))
+            plans = []
+            for chunk in chunks:
+                plan = build_sweep_plan(chunk, X, y, train_w, self.evaluator)
+                if plan is None:
+                    return False
+                plans.append(plan)
         except Exception as e:
             log.warning("fused sweep build failed (%s); per-family path", e)
             return False
-        if plan is None:
-            return False
         try:
-            metrics = plan.run(train_w, val_mask)
+            metrics = np.concatenate([p.run(train_w, val_mask) for p in plans],
+                                     axis=1)
+            plan = plans[0]
         except Exception as e:
             log.warning("fused sweep run failed (%s); per-family path", e)
             return False
@@ -256,6 +276,28 @@ class OpValidator:
                     fold_metrics=fm, metric_value=value, error=err))
                 ci += 1
         return True
+
+
+def _chunk_candidates(candidates, max_cands: int):
+    """Partition (estimator, grids) pairs into chunks of <= max_cands
+    candidates, splitting a single family's grid list when necessary.
+    Chunk-local candidate order preserves the global order, so the
+    concatenated metrics line up with the flat candidate enumeration."""
+    chunks, cur, cur_n = [], [], 0
+    for est, grids in candidates:
+        grids = list(grids) or [{}]
+        lo = 0
+        while lo < len(grids):
+            take = min(len(grids) - lo, max(max_cands - cur_n, 1))
+            cur.append((est, grids[lo:lo + take]))
+            cur_n += take
+            lo += take
+            if cur_n >= max_cands:
+                chunks.append(cur)
+                cur, cur_n = [], 0
+    if cur:
+        chunks.append(cur)
+    return chunks
 
 
 class OpCrossValidation(OpValidator):

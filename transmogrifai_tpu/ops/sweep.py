@@ -25,7 +25,7 @@ fills in the GLOBAL candidate order; ``off_*`` index the dynamic f32
 hyperparameter ``blob``; ``xb_idx`` picks the pre-binned matrix in ``xbs``:
 
     spec = (problem, frags, strict)
-    problem ∈ {"binary", "regression"}
+    problem ∈ {"binary", "regression", ("multiclass", k)}
     frag = ("fista",  cis, max_iter, fit_intercept, off_l1, off_l2)
          | ("newton", cis, max_iter, fit_intercept, off_l2)
          | ("svc",    cis, max_iter, fit_intercept, off_l2)
@@ -59,10 +59,12 @@ from jax import lax
 from ..utils import flops
 from . import linear as L
 from . import trees as Tr
-from .metrics import (BINARY_METRICS, REGRESSION_METRICS,
-                      _binary_grid_metrics, _regression_grid_metrics)
+from .metrics import (BINARY_METRICS, MULTICLASS_METRICS, REGRESSION_METRICS,
+                      _binary_grid_metrics, _multiclass_grid_metrics,
+                      _regression_grid_metrics)
 
-__all__ = ["run_sweep", "BINARY_METRICS", "REGRESSION_METRICS"]
+__all__ = ["run_sweep", "BINARY_METRICS", "MULTICLASS_METRICS",
+           "REGRESSION_METRICS"]
 
 
 # ---------------------------------------------------------------------------
@@ -83,6 +85,19 @@ def _fista_scores(frag, X, y, train_w, blob, classification: bool):
                                         max_iter=max_iter,
                                         fit_intercept=fit_intercept)
     return jnp.einsum("nd,fgd->fgn", X, fit.coef) + fit.intercept[..., :1]
+
+
+def _softmax_scores(frag, X, y, train_w, blob, k: int):
+    """Multiclass logistic: class probabilities [F, G, n, k]."""
+    _, cis, max_iter, fit_intercept, off_l1, off_l2 = frag
+    G = len(cis)
+    l1 = blob[off_l1:off_l1 + G]
+    l2 = blob[off_l2:off_l2 + G]
+    fit = L.fit_softmax_grid_folds(X, y, train_w, l1, l2, num_classes=k,
+                                   max_iter=max_iter,
+                                   fit_intercept=fit_intercept)
+    z = jnp.einsum("nd,fgdk->fgnk", X, fit.coef) + fit.intercept[:, :, None, :]
+    return jax.nn.softmax(z, axis=-1)
 
 
 def _newton_scores(frag, X, y, train_w, blob):
@@ -107,8 +122,8 @@ def _svc_scores(frag, X, y, train_w, blob):
     return (z >= 0.0).astype(jnp.float32)
 
 
-def _mlp_scores(frag, X, y, train_w, blob):
-    """Batched MLP: p(class 1) per (fold, candidate)."""
+def _mlp_scores(frag, X, y, train_w, blob, full_prob: bool = False):
+    """Batched MLP: p(class 1) — or the full [F, G, n, k] distribution."""
     from . import mlp as M
 
     _, cis, layers, max_iter, off_lr, off_seed = frag
@@ -118,7 +133,7 @@ def _mlp_scores(frag, X, y, train_w, blob):
     params = M.fit_mlp_grid_folds(X, y, train_w, lrs, seeds,
                                   layers=layers, max_iter=max_iter)
     _, prob, _ = M.predict_mlp_grid(params, X)
-    return prob[..., 1]
+    return prob if full_prob else prob[..., 1]
 
 
 def _forest_group_scores(group, xbs, y, train_w, blob, out_c: int):
@@ -224,26 +239,36 @@ def _gbt_group_scores(group, xbs, y, train_w, blob, loss: str, out_c: int):
     return Fm.reshape(F, Gc, n, -1)
 
 
-def _frag_scores(frag, X, xbs, y, train_w, blob, problem: str):
-    """Returns (cis, scores [F, Gf, n]) for one fragment."""
+def _frag_scores(frag, X, xbs, y, train_w, blob, problem):
+    """Returns (cis, scores [F, Gf, n] — or [F, Gf, n, k] multiclass)."""
     kind = frag[0]
-    classification = problem == "binary"
+    multiclass = isinstance(problem, tuple)
+    classification = problem == "binary" or multiclass
     if kind == "fista":
+        if multiclass:
+            return frag[1], _softmax_scores(frag, X, y, train_w, blob,
+                                            problem[1])
         return frag[1], _fista_scores(frag, X, y, train_w, blob, classification)
     if kind == "newton":
         return frag[1], _newton_scores(frag, X, y, train_w, blob)
     if kind == "svc":
         return frag[1], _svc_scores(frag, X, y, train_w, blob)
     if kind == "mlp":
-        return frag[1], _mlp_scores(frag, X, y, train_w, blob)
+        return frag[1], _mlp_scores(frag, X, y, train_w, blob,
+                                    full_prob=multiclass)
     if kind == "forest":
         _, out_c, groups = frag
         cis_all, outs = [], []
         for grp in groups:
             dist = _forest_group_scores(grp, xbs, y, train_w, blob, out_c)
             # binary classification: 1-channel leaves ARE p(class=1);
-            # regression: mean leaves are the prediction
-            outs.append(dist[..., 0])
+            # regression: mean leaves are the prediction; multiclass keeps
+            # the class-distribution leaves (argmax-equivalent unnormalized);
+            # k=2-multiclass trains the SAME 1-channel binary kernel as the
+            # legacy path and expands p -> [1-p, p] here
+            if multiclass and dist.shape[-1] == 1:
+                dist = jnp.concatenate([1.0 - dist, dist], axis=-1)
+            outs.append(dist if multiclass else dist[..., 0])
             cis_all.extend(grp[0])
         return cis_all, jnp.concatenate(outs, axis=1)
     if kind == "gbt":
@@ -251,7 +276,9 @@ def _frag_scores(frag, X, xbs, y, train_w, blob, problem: str):
         cis_all, outs = [], []
         for grp in groups:
             Fm = _gbt_group_scores(grp, xbs, y, train_w, blob, loss, out_c)
-            if loss == "logistic":
+            if loss == "softmax":
+                outs.append(jax.nn.softmax(Fm, axis=-1))
+            elif loss == "logistic":
                 outs.append(jax.nn.sigmoid(Fm[..., 0]))
             else:  # squared: the margin IS the prediction
                 outs.append(Fm[..., 0])
@@ -260,20 +287,59 @@ def _frag_scores(frag, X, xbs, y, train_w, blob, problem: str):
     raise ValueError(f"unknown sweep fragment {kind!r}")
 
 
-@functools.partial(jax.jit, static_argnames=("spec",))
-def _run(spec, X, xbs, y, train_w, val_w, blob):
+def _all_scores(spec, X, xbs, y, train_w, blob):
     problem, frags, strict = spec
     n = y.shape[0]
     F = train_w.shape[0]
     C = len(strict)
-    scores = jnp.zeros((F, C, n), jnp.float32)
+    if isinstance(problem, tuple):  # ("multiclass", k)
+        scores = jnp.zeros((F, C, n, problem[1]), jnp.float32)
+    else:
+        scores = jnp.zeros((F, C, n), jnp.float32)
     for frag in frags:
         cis, sc = _frag_scores(frag, X, xbs, y, train_w, blob, problem)
+        if isinstance(problem, tuple) and sc.ndim == 3:
+            # binary-family fragment under a k=2 multiclass evaluator:
+            # expand the class-1 score to the [p0, p1] plane
+            sc = jnp.stack([1.0 - sc, sc], axis=-1)
         scores = scores.at[:, np.asarray(cis, np.int64)].set(sc)
+    return scores
+
+
+def _metrics_of(spec, y, scores, val_w):
+    problem, _, strict = spec
+    if isinstance(problem, tuple):
+        y1 = jax.nn.one_hot(y.astype(jnp.int32), problem[1],
+                            dtype=jnp.float32)
+        return _multiclass_grid_metrics(y1, scores, val_w)
     if problem == "binary":
         return _binary_grid_metrics(y, scores, val_w,
                                     jnp.asarray(strict, jnp.float32))
     return _regression_grid_metrics(y, scores, val_w)
+
+
+@functools.partial(jax.jit, static_argnames=("spec",))
+def _run(spec, X, xbs, y, train_w, val_w, blob):
+    return _metrics_of(spec, y, _all_scores(spec, X, xbs, y, train_w, blob),
+                       val_w)
+
+
+@functools.partial(jax.jit, static_argnames=("spec",))
+def _run_scores(spec, X, xbs, y, train_w, blob):
+    return _all_scores(spec, X, xbs, y, train_w, blob)
+
+
+@functools.partial(jax.jit, static_argnames=("spec",))
+def _run_metrics(spec, y, scores, val_w):
+    return _metrics_of(spec, y, scores, val_w)
+
+
+#: above this many score ELEMENTS the sweep runs as TWO launches (scores,
+#: then metrics): compiling family training together with the metric sort
+#: pipeline into one program killed the tunneled TPU worker at 500k x 33
+#: candidates even though each half runs fine alone (round-5 bisection); at
+#: small n the single launch saves a ~25 ms round trip.
+SPLIT_METRICS_ELEMS = 20_000_000
 
 
 def run_sweep(spec, X, xbs: Tuple, y, train_w, val_w, blob):
@@ -282,6 +348,18 @@ def run_sweep(spec, X, xbs: Tuple, y, train_w, val_w, blob):
     ``spec`` must be a hashable static tuple (see module docstring); arrays
     may be host or device (device-resident via utils.devcache recommended).
     """
+    C = len(spec[2])
+    n = int(np.asarray(y).shape[0] if not hasattr(y, "shape") else y.shape[0])
+    F = train_w.shape[0]
+    k = spec[0][1] if isinstance(spec[0], tuple) else 1
+    if F * C * n * k > SPLIT_METRICS_ELEMS:
+        scores = _run_scores(spec, X, tuple(xbs), y, train_w, blob)
+        out = _run_metrics(spec, y, scores, val_w)
+        flops.record("sweep.run_scores", _run_scores, spec, X, tuple(xbs), y,
+                     train_w, blob)
+        flops.record("sweep.run_metrics", _run_metrics, spec, y, scores,
+                     val_w)
+        return out
     out = _run(spec, X, tuple(xbs), y, train_w, val_w, blob)
     flops.record("sweep.run", _run, spec, X, tuple(xbs), y, train_w, val_w,
                  blob)
