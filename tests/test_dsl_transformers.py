@@ -188,3 +188,87 @@ def test_lda_topic_distributions():
     t1 = np.argmax(theta[:15].mean(axis=0))
     t2 = np.argmax(theta[15:].mean(axis=0))
     assert t1 != t2
+
+
+def test_dsl_numeric_math_tier():
+    """Round-5 DSL breadth: RichNumericFeature's math/scale/calibration
+    methods (abs/ceil/floor/round/exp/sqrt/log/power, scale+descale,
+    toPercentile, toIsotonicCalibrated, deindexed — RichNumericFeature.scala
+    :172-418)."""
+    import numpy as np
+
+    import transmogrifai_tpu.types as T
+    from transmogrifai_tpu import Dataset, FeatureBuilder
+    from transmogrifai_tpu.columns import NumericColumn
+    from transmogrifai_tpu.workflow.dag import compute_dag, fit_and_transform_dag
+
+    n = 40
+    rng = np.random.default_rng(1)
+    v = rng.uniform(1.0, 50.0, n)
+    y = (v > 25).astype(float)
+    ds = Dataset({"x": NumericColumn(T.Real, v, np.ones(n, bool)),
+                  "label": NumericColumn(T.RealNN, y, np.ones(n, bool))})
+    x = FeatureBuilder("x", T.Real).from_field().as_predictor()
+    lab = FeatureBuilder("label", T.RealNN).from_field().as_response()
+
+    scaled = x.scale(slope=3.0, intercept=-2.0)
+    feats = {
+        "abs": (x.abs(), np.abs(v)),
+        "sqrt": (x.sqrt(), np.sqrt(v)),
+        "log10": (x.log(10.0), np.log10(v)),
+        "pow2": (x.power(2.0), v ** 2),
+        "ceil": (x.ceil(), np.ceil(v)),
+        "floor": (x.floor(), np.floor(v)),
+        "round": (x.round(), np.round(v)),
+        "scale": (scaled, 3.0 * v - 2.0),
+        # descale unwinds the receiver through the scaled feature's args
+        "descale": (scaled.descale(scaled), v),
+    }
+    pct = x.to_percentile(10)
+    iso = x.to_isotonic_calibrated(lab)
+    all_feats = [f for f, _ in feats.values()] + [pct, iso]
+    out = fit_and_transform_dag(compute_dag(all_feats), ds).train
+    for name, (f, want) in feats.items():
+        np.testing.assert_allclose(out[f.name].values, want, atol=1e-4,
+                                   err_msg=name)
+    # percentile buckets within range; isotonic calibration is monotone in v
+    p = out[pct.name].values
+    assert p.min() >= 0 and p.max() <= 10
+    order = np.argsort(v)
+    iso_v = out[iso.name].values[order]
+    assert (np.diff(iso_v) >= -1e-9).all()
+    # ceil/floor/round output the Integral type (reference return types)
+    assert out[feats["ceil"][0].name].ftype is T.Integral
+
+
+def test_dsl_similarity_and_time_period():
+    import numpy as np
+
+    import transmogrifai_tpu.types as T
+    from transmogrifai_tpu import Dataset, FeatureBuilder
+    from transmogrifai_tpu.columns import NumericColumn, ObjectColumn
+    from transmogrifai_tpu.workflow.dag import compute_dag, fit_and_transform_dag
+
+    n = 6
+    a = ["hello world", "abcdef", "same text", "", "xyz", "night"]
+    b = ["hello word", "uvwxyz", "same text", "x", "xyz", "day"]
+    day_ms = 24 * 3600 * 1000
+    dates = np.array([3 * day_ms, 4 * day_ms, 5 * day_ms, 6 * day_ms,
+                      7 * day_ms, 8 * day_ms], np.float64)
+    ds = Dataset({
+        "a": ObjectColumn(T.Text, np.array(a, object)),
+        "b": ObjectColumn(T.Text, np.array(b, object)),
+        "d": NumericColumn(T.Date, dates, np.ones(n, bool)),
+    })
+    fa = FeatureBuilder("a", T.Text).from_field().as_predictor()
+    fb = FeatureBuilder("b", T.Text).from_field().as_predictor()
+    fd = FeatureBuilder("d", T.Date).from_field().as_predictor()
+    sim = fa.ngram_similarity(fb)
+    tp = fd.to_time_period()
+    out = fit_and_transform_dag(compute_dag([sim, tp]), ds).train
+    s = out[sim.name].values
+    assert s[2] == pytest.approx(1.0)      # identical strings
+    assert s[0] > 0.5                      # near-identical
+    assert s[1] < 0.2                      # disjoint
+    p = out[tp.name].values[out[tp.name].mask]
+    assert ((1 <= p) & (p <= 7)).all()  # Spark DayOfWeek ordinals are 1..7

@@ -15,6 +15,7 @@ like the reference's Titanic example (OpTitanicSimple.scala:77-130).
 """
 from __future__ import annotations
 
+import math
 from typing import Any, Callable, Optional, Sequence, Type
 
 from . import types as T
@@ -25,10 +26,17 @@ from .impl.feature.detectors import (EmailToPickList, HumanNameDetector,
                                      MimeTypeDetector, NameEntityRecognizer,
                                      PhoneNumberParser, UrlToPickList,
                                      ValidEmailTransformer)
-from .impl.feature.scalers import OpScalarStandardScaler
+from .impl.feature.dates import TimePeriodTransformer
+from .impl.feature.scalers import (DescalerTransformer,
+                                   IsotonicRegressionCalibrator,
+                                   OpScalarStandardScaler,
+                                   PercentileCalibrator, ScalerTransformer,
+                                   ScalingType)
 from .impl.feature.smart_text import SmartTextVectorizer
-from .impl.feature.text import (LangDetector, OpCountVectorizer, OpNGram,
-                                OpStopWordsRemover, TextLenTransformer, TextTokenizer)
+from .impl.feature.text import (JaccardSimilarity, LangDetector,
+                                NGramSimilarity, OpCountVectorizer,
+                                OpIndexToString, OpNGram, OpStopWordsRemover,
+                                TextLenTransformer, TextTokenizer)
 from .impl.feature.transformers import (AddTransformer, AliasTransformer,
                                         DivideTransformer, ExistsTransformer,
                                         FillMissingWithMean, FilterTransformer,
@@ -114,6 +122,84 @@ def z_normalize(self: Feature) -> Feature:
 
 def fill_missing_with_mean(self: Feature, default: float = 0.0) -> Feature:
     return _unary(FillMissingWithMean(default=default), self)
+
+
+def _scalar_math(op: str):
+    """No-argument unary math method (abs/exp/sqrt/ceil/floor — their
+    transformer ignores the scalar, so the DSL does not accept one)."""
+
+    def method(self: Feature) -> Feature:
+        return _unary(ScalarMathTransformer(op, 0.0), self)
+
+    method.__name__ = op
+    method.__doc__ = f"RichNumericFeature.{op} (ScalarMathTransformer)."
+    return method
+
+
+def power(self: Feature, exponent: float = 2.0) -> Feature:
+    """RichNumericFeature.power:228."""
+    return _unary(ScalarMathTransformer("power", exponent), self)
+
+
+def round_(self: Feature, digits: int = 0) -> Feature:
+    """RichNumericFeature.round:193-200 — half-up; digit-less rounds to
+    Integral, round(digits) stays Real."""
+    return _unary(ScalarMathTransformer("round", float(digits)), self)
+
+
+def log_base(self: Feature, base: float = math.e) -> Feature:
+    """RichNumericFeature.log(base):221 — ln(v) / ln(base) via the natural
+    log transformer composed with a scalar multiply."""
+    ln = _unary(ScalarMathTransformer("log", 0.0), self)
+    if abs(base - math.e) < 1e-12:
+        return ln
+    return _unary(ScalarMathTransformer("multiply", 1.0 / math.log(base)), ln)
+
+
+def scale(self: Feature, scaling_type=None, slope: float = 1.0,
+          intercept: float = 0.0) -> Feature:
+    """Invertible scaling (RichNumericFeature.scale:347); pair with
+    ``descale``."""
+    st = scaling_type if scaling_type is not None else ScalingType.Linear
+    return _unary(ScalerTransformer(scaling_type=st, slope=slope,
+                                    intercept=intercept), self)
+
+
+def descale(self: Feature, scaled: Feature) -> Feature:
+    """Invert a sibling ``scale`` using its recorded scaler args
+    (RichNumericFeature.descale:362): ``value.descale(scaled_origin)``."""
+    return DescalerTransformer().set_input(self, scaled).get_output()
+
+
+def to_percentile(self: Feature, buckets: int = 100) -> Feature:
+    """RichNumericFeature.toPercentile:387 (PercentileCalibrator)."""
+    return _unary(PercentileCalibrator(buckets=buckets), self)
+
+
+def to_isotonic_calibrated(self: Feature, label: Feature) -> Feature:
+    """RichNumericFeature.toIsotonicCalibrated:398."""
+    return IsotonicRegressionCalibrator().set_input(label, self).get_output()
+
+
+def deindexed(self: Feature, labels: Sequence[str]) -> Feature:
+    """Index -> original string label (RichNumericFeature.deindexed:418)."""
+    return _unary(OpIndexToString(labels=list(labels)), self)
+
+
+def to_time_period(self: Feature, time_period=None) -> Feature:
+    """Date -> calendar period ordinal (RichDateFeature.toTimePeriod)."""
+    tp = time_period if time_period is not None else TimePeriod.DayOfWeek
+    return _unary(TimePeriodTransformer(time_period=tp), self)
+
+
+def ngram_similarity(self: Feature, other: Feature, n: int = 3) -> Feature:
+    """Char-ngram Jaccard of two text features (RichTextFeature)."""
+    return NGramSimilarity(n=n).set_input(self, other).get_output()
+
+
+def jaccard_similarity(self: Feature, other: Feature) -> Feature:
+    """Token-set Jaccard of two MultiPickList features (RichSetFeature)."""
+    return JaccardSimilarity().set_input(self, other).get_output()
 
 
 # ---------------------------------------------------------------------------
@@ -214,6 +300,15 @@ _METHODS = {
     # numeric
     "vectorize": vectorize, "auto_bucketize": auto_bucketize, "bucketize": bucketize,
     "z_normalize": z_normalize, "fill_missing_with_mean": fill_missing_with_mean,
+    "abs": _scalar_math("abs"), "exp": _scalar_math("exp"),
+    "sqrt": _scalar_math("sqrt"), "log": log_base,
+    "power": power, "ceil": _scalar_math("ceil"),
+    "floor": _scalar_math("floor"), "round": round_,
+    "scale": scale, "descale": descale, "to_percentile": to_percentile,
+    "to_isotonic_calibrated": to_isotonic_calibrated, "deindexed": deindexed,
+    "to_time_period": to_time_period,
+    "ngram_similarity": ngram_similarity,
+    "jaccard_similarity": jaccard_similarity,
     # text
     "tokenize": tokenize, "smart_vectorize": smart_vectorize, "pivot": pivot,
     "detect_languages": detect_languages, "text_len": text_len,

@@ -104,11 +104,22 @@ class ScalarMathTransformer(UnaryTransformer):
 
     jax_output = "numeric"  # fused-layer protocol: returns (values, mask)
 
+    @staticmethod
+    def _is_integral(op: str, scalar: float) -> bool:
+        """ceil/floor and digit-less round produce whole numbers (the
+        reference types them Integral; round(digits) stays Real —
+        RichNumericFeature.scala:179-200)."""
+        return op in ("ceil", "floor") or (op == "round" and scalar == 0.0)
+
     def __init__(self, op: str, scalar: float, uid: Optional[str] = None):
         assert op in ("plus", "minus", "multiply", "divide", "power", "abs",
-                      "log", "exp", "sqrt", "rminus", "rdivide")
+                      "log", "exp", "sqrt", "rminus", "rdivide",
+                      "ceil", "floor", "round")
         super().__init__(operation_name=f"{op}Scalar", input_type=T.Real,
-                         output_type=T.Real, uid=uid, op=op, scalar=float(scalar))
+                         output_type=(T.Integral
+                                      if self._is_integral(op, float(scalar))
+                                      else T.Real),
+                         uid=uid, op=op, scalar=float(scalar))
 
     def _compute(self, xp, v, m):
         op, s = self.get_param("op"), float(self.get_param("scalar"))
@@ -119,6 +130,10 @@ class ScalarMathTransformer(UnaryTransformer):
             "log": lambda: xp.log(v), "exp": lambda: xp.exp(v),
             "sqrt": lambda: xp.sqrt(v),
             "rminus": lambda: s - v, "rdivide": lambda: s / v,
+            "ceil": lambda: xp.ceil(v), "floor": lambda: xp.floor(v),
+            # round(digits) scales by 10^digits; HALF-UP like the reference
+            # (scala.math.round = floor(x + 0.5)), not banker's rounding
+            "round": lambda: xp.floor(v * (10.0 ** s) + 0.5) / (10.0 ** s),
         }[op]()
         mask = m & xp.isfinite(vals)
         return xp.where(mask, vals, 0.0), mask
@@ -128,7 +143,7 @@ class ScalarMathTransformer(UnaryTransformer):
         assert isinstance(col, NumericColumn)
         with np.errstate(divide="ignore", invalid="ignore"):
             vals, mask = self._compute(np, col.values, col.mask)
-        return NumericColumn(T.Real, vals, mask)
+        return NumericColumn(self.output_type, vals, mask)
 
     def jax_transform(self, v, m):
         import jax.numpy as jnp
