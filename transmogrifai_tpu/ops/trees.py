@@ -788,7 +788,7 @@ def predict_forest(Xb, forest: Tree, max_depth: int) -> jax.Array:
 
 
 def forest_chunk_size(max_depth: int, n_bins: int, d: int, c: int,
-                      frontier: int, budget_bytes: float = 1.5e9,
+                      frontier: int, budget_bytes: float = 3e9,
                       n_rows: int = 0) -> int:
     """Trees per chunk so one chunk's level tensors fit the budget.
 
