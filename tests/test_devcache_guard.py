@@ -1,0 +1,60 @@
+"""utils/devcache.py opt-in mutation guard (TRANSMOG_DEVCACHE_CHECK=1):
+the documented must-not-mutate contract becomes an enforced invariant."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.utils import devcache
+
+
+@pytest.fixture(autouse=True)
+def clean_cache():
+    devcache.clear()
+    yield
+    devcache.clear()
+
+
+def test_mutation_detected_when_enabled(monkeypatch):
+    monkeypatch.setenv("TRANSMOG_DEVCACHE_CHECK", "1")
+    arr = np.arange(12, dtype=np.float64).reshape(3, 4)
+    devcache.device_array(arr)
+    arr[0, 0] = 999.0  # contract violation
+    with pytest.raises(devcache.DevCacheMutationError):
+        devcache.device_array(arr)
+
+
+def test_mutation_detected_in_last_row(monkeypatch):
+    monkeypatch.setenv("TRANSMOG_DEVCACHE_CHECK", "1")
+    arr = np.zeros((5, 3), dtype=np.float32)
+    devcache.derived(arr, ("bins", 8), lambda: "product")
+    arr[-1, 2] = 7.0
+    with pytest.raises(devcache.DevCacheMutationError):
+        devcache.derived(arr, ("bins", 8), lambda: "product")
+
+
+def test_clean_lookups_pass_when_enabled(monkeypatch):
+    monkeypatch.setenv("TRANSMOG_DEVCACHE_CHECK", "1")
+    arr = np.arange(6, dtype=np.float64)
+    a = devcache.device_array(arr)
+    b = devcache.device_array(arr)  # repeated lookups: same buffer, no raise
+    assert a is b
+    assert devcache.derived(arr, ("k",), lambda: 42) == 42
+    assert devcache.derived(arr, ("k",), lambda: 43) == 42  # cached
+
+
+def test_guard_off_by_default(monkeypatch):
+    monkeypatch.delenv("TRANSMOG_DEVCACHE_CHECK", raising=False)
+    arr = np.arange(8, dtype=np.float64)
+    devcache.device_array(arr)
+    arr[3] = -1.0  # violation goes unnoticed when the guard is off
+    devcache.device_array(arr)  # no raise
+
+
+def test_entry_created_while_off_adopts_fingerprint(monkeypatch):
+    monkeypatch.delenv("TRANSMOG_DEVCACHE_CHECK", raising=False)
+    arr = np.arange(8, dtype=np.float64)
+    devcache.device_array(arr)
+    monkeypatch.setenv("TRANSMOG_DEVCACHE_CHECK", "1")
+    devcache.device_array(arr)  # first checked access: adopt fingerprint
+    arr[0] = 123.0
+    with pytest.raises(devcache.DevCacheMutationError):
+        devcache.device_array(arr)

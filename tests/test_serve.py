@@ -1,0 +1,320 @@
+"""serve/ subsystem: bucket math, batch-vs-row parity, warmup, fallback,
+hot-swap registry, and the ≥5x micro-batching throughput acceptance bar."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu import OpWorkflow
+from transmogrifai_tpu.impl.classification.logistic import OpLogisticRegression
+from transmogrifai_tpu.impl.feature.vectorizers import (OneHotVectorizer,
+                                                        RealVectorizer,
+                                                        VectorsCombiner)
+from transmogrifai_tpu.impl.regression.linear import OpLinearRegression
+from transmogrifai_tpu.local import batch_score_function, score_function
+from transmogrifai_tpu.serve import (MicroBatcher, ModelRegistry, ShedError,
+                                     bucket_for, shape_buckets)
+from transmogrifai_tpu.testkit import TestFeatureBuilder
+
+
+def _features(x, cat):
+    return VectorsCombiner().set_input(
+        RealVectorizer().set_input(x).get_output(),
+        OneHotVectorizer(top_k=3, min_support=1).set_input(cat).get_output(),
+    ).get_output()
+
+
+def _train_classifier(n=80, seed_shift=0.0):
+    ds, (x, cat, y) = TestFeatureBuilder.of(
+        ("x", T.Real, list(np.linspace(-2 + seed_shift, 2 + seed_shift, n))),
+        ("cat", T.PickList, ["a", "b"] * (n // 2)),
+        ("y", T.RealNN, [float(i % 2) for i in range(n)]), response="y")
+    pred = OpLogisticRegression(reg_param=0.1).set_input(
+        y, _features(x, cat)).get_output()
+    model = OpWorkflow().set_input_dataset(ds).set_result_features(pred).train()
+    return model, pred
+
+
+def _train_regressor(n=80):
+    ds, (x, cat, y) = TestFeatureBuilder.of(
+        ("x", T.Real, list(np.linspace(-2, 2, n))),
+        ("cat", T.PickList, ["a", "b"] * (n // 2)),
+        ("y", T.RealNN, [float(2.5 * i / n + (i % 2)) for i in range(n)]),
+        response="y")
+    pred = OpLinearRegression().set_input(y, _features(x, cat)).get_output()
+    model = OpWorkflow().set_input_dataset(ds).set_result_features(pred).train()
+    return model, pred
+
+
+@pytest.fixture(scope="module")
+def classifier():
+    return _train_classifier()
+
+
+TEST_RECORDS = ([{"x": float(v), "cat": c}
+                 for v, c in zip(np.linspace(-3, 3, 23), "ab" * 12)]
+                + [{"x": None, "cat": None}, {}, {"x": 0.12, "cat": "zzz"}])
+
+
+# ---------------------------------------------------------------------------
+# bucket math
+# ---------------------------------------------------------------------------
+def test_shape_buckets():
+    assert shape_buckets(64) == [1, 2, 4, 8, 16, 32, 64]
+    assert shape_buckets(1) == [1]
+    assert shape_buckets(48) == [1, 2, 4, 8, 16, 32, 48]  # cap is a bucket
+
+
+def test_bucket_for():
+    buckets = shape_buckets(64)
+    assert bucket_for(1, buckets) == 1
+    assert bucket_for(3, buckets) == 4
+    assert bucket_for(33, buckets) == 64
+    assert bucket_for(64, buckets) == 64
+
+
+# ---------------------------------------------------------------------------
+# serve-vs-local parity (acceptance: 1e-6 per-record match)
+# ---------------------------------------------------------------------------
+def _assert_parity(model, records):
+    row_fn = score_function(model)
+    batch_fn = batch_score_function(model)
+    expected = [row_fn(r) for r in records]
+    got = batch_fn(records)
+    assert len(got) == len(expected)
+    for e, g in zip(expected, got):
+        assert set(e) == set(g)
+        for name in e:
+            assert set(e[name]) == set(g[name])
+            for k, v in e[name].items():
+                # nan_ok: both paths emit NaN for the all-null record
+                assert g[name][k] == pytest.approx(v, abs=1e-6, nan_ok=True), \
+                    (name, k)
+
+
+def test_batch_parity_classification(classifier):
+    model, _ = classifier
+    _assert_parity(model, TEST_RECORDS)
+
+
+def test_batch_parity_regression():
+    model, _ = _train_regressor()
+    _assert_parity(model, TEST_RECORDS)
+
+
+def test_batch_parity_through_batcher_buckets(classifier):
+    """Parity must survive bucket padding: odd batch sizes per dispatch."""
+    model, pred = classifier
+    row_fn = score_function(model)
+    registry = ModelRegistry(max_batch=8)
+    registry.deploy(model)
+    batcher = MicroBatcher(registry, max_batch=8, max_wait_ms=5.0,
+                           queue_size=64).start()
+    try:
+        futures = [batcher.submit(r) for r in TEST_RECORDS]
+        for r, f in zip(TEST_RECORDS, futures):
+            got = f.result(30).output
+            want = row_fn(r)
+            for k, v in want[pred.name].items():
+                assert got[pred.name][k] == pytest.approx(v, abs=1e-6,
+                                                          nan_ok=True)
+    finally:
+        batcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# registry: warmup + hot swap
+# ---------------------------------------------------------------------------
+def test_registry_warmup_and_versions(classifier):
+    model, _ = classifier
+    registry = ModelRegistry(max_batch=16)
+    entry = registry.deploy(model, version="prod-1")
+    assert entry.warmed
+    assert registry.active_version() == "prod-1"
+    assert registry.versions() == ["prod-1"]
+    with pytest.raises(ValueError):
+        registry.deploy(model, version="prod-1")  # duplicate version
+
+
+def test_registry_requires_deploy():
+    registry = ModelRegistry()
+    with pytest.raises(LookupError):
+        registry.active()
+
+
+def test_failed_warmup_leaves_active_model(classifier):
+    model, _ = classifier
+    registry = ModelRegistry(max_batch=4)
+    registry.deploy(model, version="v1")
+    # a broken candidate must abort BEFORE the swap
+    with pytest.raises(Exception):
+        registry.deploy(object(), version="v2")
+    assert registry.active_version() == "v1"
+
+
+def test_hot_swap_under_load(classifier):
+    """Acceptance: swap under concurrent load — zero failed requests, and
+    every request submitted after deploy() returns scores on the new
+    version."""
+    from transmogrifai_tpu.serve import ServeMetrics
+
+    model1, _ = classifier
+    model2, _ = _train_classifier(seed_shift=0.5)
+    registry = ModelRegistry(max_batch=16, metrics=ServeMetrics())
+    registry.deploy(model1, version="v1")
+    batcher = MicroBatcher(registry, max_batch=16, max_wait_ms=1.0,
+                           queue_size=2048).start()
+    swapped = threading.Event()
+    stop = threading.Event()
+    failures, post_swap_stale = [], []
+    lock = threading.Lock()
+
+    def client():
+        while not stop.is_set():
+            was_swapped = swapped.is_set()
+            try:
+                scored = batcher.submit({"x": 0.3, "cat": "a"}).result(30)
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    failures.append(e)
+                return
+            if was_swapped and scored.version != "v2":
+                with lock:
+                    post_swap_stale.append(scored.version)
+
+    threads = [threading.Thread(target=client) for _ in range(8)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.2)  # v1 traffic flowing
+        registry.deploy(model2, version="v2")  # load -> warm -> swap -> drain
+        swapped.set()
+        time.sleep(0.3)  # v2 traffic flowing
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(30)
+        batcher.stop()
+    assert not failures
+    assert not post_swap_stale
+    assert registry.active_version() == "v2"
+    assert batcher.metrics.snapshot()["swaps"] == 2
+
+
+# ---------------------------------------------------------------------------
+# graceful degradation: vectorized path errors -> numpy row path
+# ---------------------------------------------------------------------------
+def test_fallback_to_row_path(classifier):
+    model, pred = classifier
+    registry = ModelRegistry(max_batch=4)
+    entry = registry.deploy(model)
+
+    def broken_batch(records):
+        raise RuntimeError("device path exploded")
+
+    entry.batch = broken_batch
+    batcher = MicroBatcher(registry, max_batch=4, max_wait_ms=1.0,
+                           queue_size=16).start()
+    try:
+        out = batcher.score({"x": 0.5, "cat": "b"}, timeout_s=30)
+        assert "prediction" in out[pred.name]
+        snap = batcher.metrics.snapshot()
+        assert snap["fallback_batches"] >= 1
+        assert snap["fallback_records"] >= 1
+        assert snap["errors"] == 0
+    finally:
+        batcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# throughput acceptance: >= 5x per-record local path at concurrency 64
+# ---------------------------------------------------------------------------
+def test_throughput_vs_per_record_at_64(classifier):
+    model, _ = classifier
+    rec = {"x": 0.5, "cat": "a"}
+    row_fn = score_function(model)
+    row_fn(rec)  # warm the row path too (fair baseline)
+    n_base = 256
+    t0 = time.perf_counter()
+    for _ in range(n_base):
+        row_fn(rec)
+    base_rate = n_base / (time.perf_counter() - t0)
+
+    registry = ModelRegistry(max_batch=64)
+    registry.deploy(model)  # warms every bucket
+    batcher = MicroBatcher(registry, max_batch=64, max_wait_ms=2.0,
+                           queue_size=8192).start()
+    per_thread = 48  # ~3k records: long enough a window to be timing-stable
+    errors = []
+
+    def client():
+        try:
+            for _ in range(per_thread):
+                batcher.score(rec, timeout_s=60)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=client) for _ in range(64)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    serve_rate = 64 * per_thread / (time.perf_counter() - t0)
+    batcher.stop()
+    assert not errors
+    speedup = serve_rate / base_rate
+    assert speedup >= 5.0, (f"serve {serve_rate:.0f} rec/s vs per-record "
+                            f"{base_rate:.0f} rec/s = {speedup:.1f}x < 5x")
+
+
+# ---------------------------------------------------------------------------
+# overload: bounded queue sheds explicitly, counters match
+# ---------------------------------------------------------------------------
+def test_overload_sheds_never_hangs(classifier):
+    model, _ = classifier
+    registry = ModelRegistry(max_batch=2)
+    entry = registry.deploy(model)
+    real_batch = entry.batch
+
+    def slow_batch(records):
+        time.sleep(0.05)
+        return real_batch(records)
+
+    entry.batch = slow_batch
+    queue_size = 4
+    batcher = MicroBatcher(registry, max_batch=2, max_wait_ms=1.0,
+                           queue_size=queue_size).start()
+    n_clients = 32
+    shed, completed, hung = [], [], []
+    lock = threading.Lock()
+
+    def client():
+        try:
+            out = batcher.score({"x": 1.0, "cat": "a"}, timeout_s=30)
+            with lock:
+                completed.append(out)
+        except ShedError:
+            with lock:
+                shed.append(1)
+        except Exception as e:  # noqa: BLE001
+            with lock:
+                hung.append(e)
+
+    threads = [threading.Thread(target=client) for _ in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    try:
+        assert not hung
+        assert len(shed) + len(completed) == n_clients  # no silent drops
+        assert len(shed) >= 1  # 32 >> queue of 4: some MUST shed
+        snap = batcher.metrics.snapshot()
+        assert snap["shed"] == len(shed)  # /metrics counter matches exactly
+        assert snap["requests"] == n_clients
+        assert snap["responses"] == len(completed)
+    finally:
+        batcher.stop()

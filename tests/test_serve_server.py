@@ -1,0 +1,264 @@
+"""serve/server.py end-to-end over loopback HTTP: scoring, metrics,
+healthz, HTTP hot-swap, 429 load shedding, and the Serve run type."""
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu import OpWorkflow
+from transmogrifai_tpu.impl.classification.logistic import OpLogisticRegression
+from transmogrifai_tpu.impl.feature.vectorizers import (OneHotVectorizer,
+                                                        RealVectorizer,
+                                                        VectorsCombiner)
+from transmogrifai_tpu.local import score_function
+from transmogrifai_tpu.serve import ModelRegistry, ModelServer
+from transmogrifai_tpu.testkit import TestFeatureBuilder
+
+
+def _train(n=80):
+    ds, (x, cat, y) = TestFeatureBuilder.of(
+        ("x", T.Real, list(np.linspace(-2, 2, n))),
+        ("cat", T.PickList, ["a", "b"] * (n // 2)),
+        ("y", T.RealNN, [float(i % 2) for i in range(n)]), response="y")
+    feats = VectorsCombiner().set_input(
+        RealVectorizer().set_input(x).get_output(),
+        OneHotVectorizer(top_k=3, min_support=1).set_input(cat).get_output(),
+    ).get_output()
+    pred = OpLogisticRegression(reg_param=0.1).set_input(y, feats).get_output()
+    model = OpWorkflow().set_input_dataset(ds).set_result_features(pred).train()
+    return model, pred
+
+
+@pytest.fixture(scope="module")
+def trained():
+    return _train()
+
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(url, data=json.dumps(payload).encode(),
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _get(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+@pytest.fixture()
+def server(trained):
+    model, _ = trained
+    registry = ModelRegistry(max_batch=8)
+    registry.deploy(model, version="v1")
+    srv = ModelServer(registry, port=0, max_batch=8, max_wait_ms=1.0,
+                      queue_size=256).start()
+    yield srv
+    srv.stop()
+
+
+def test_score_single_and_list(server, trained):
+    model, pred = trained
+    row_fn = score_function(model)
+    rec = {"x": 1.5, "cat": "a"}
+    status, out = _post(server.url + "/score", rec)
+    assert status == 200 and out["model_version"] == "v1"
+    want = row_fn(rec)[pred.name]
+    for k, v in want.items():
+        assert out["score"][pred.name][k] == pytest.approx(v, abs=1e-6)
+
+    status, out = _post(server.url + "/score", {"records": [rec, {"x": None}]})
+    assert status == 200 and len(out["scores"]) == 2
+    status, out = _post(server.url + "/score", [rec, rec, rec])
+    assert status == 200 and len(out["scores"]) == 3
+
+
+def test_bad_requests(server):
+    req = urllib.request.Request(server.url + "/score", data=b"{not json")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=10)
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server.url + "/score", {"records": [1, 2]})
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(server.url + "/nope")
+    assert e.value.code == 404
+
+
+def test_healthz_and_metrics_endpoints(server):
+    status, health = _get(server.url + "/healthz")
+    assert status == 200 and health == {"status": "ok", "model": "v1"}
+    _post(server.url + "/score", {"x": 0.1, "cat": "b"})
+    status, m = _get(server.url + "/metrics")
+    assert status == 200
+    assert m["serve"]["responses"] >= 1
+    assert m["serve"]["batches"] >= 1
+    assert "p99_ms" in m["serve"]["request_latency"]
+    assert "queue_depth" in m["serve"]
+    assert m["registry"]["active"] == "v1"
+    assert m["registry"]["buckets"] == [1, 2, 4, 8]
+
+
+def test_http_hot_swap(server, trained, tmp_path):
+    """POST /models loads, warms, swaps; traffic never fails; responses flip
+    to the new version once the deploy call returns."""
+    model2, _ = _train(n=60)
+    model2.save(str(tmp_path / "m2"))
+    rec = {"x": 0.3, "cat": "a"}
+    stop = threading.Event()
+    failures = []
+
+    def client():
+        while not stop.is_set():
+            try:
+                _post(server.url + "/score", rec)
+            except Exception as e:  # noqa: BLE001
+                failures.append(e)
+                return
+
+    threads = [threading.Thread(target=client) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.2)
+        status, out = _post(server.url + "/models",
+                            {"path": str(tmp_path / "m2"), "version": "v2"})
+        assert status == 200 and out["active"] == "v2"
+        assert out["versions"] == ["v1", "v2"]
+        status, scored = _post(server.url + "/score", rec)
+        assert scored["model_version"] == "v2"  # no stale version post-swap
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(30)
+    assert not failures
+    status, m = _get(server.url + "/metrics")
+    assert m["serve"]["errors"] == 0
+
+
+def test_http_deploy_bad_path(server):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server.url + "/models", {"path": "/nonexistent/model"})
+    assert e.value.code == 400
+    status, health = _get(server.url + "/healthz")
+    assert status == 200 and health["model"] == "v1"  # still serving
+
+
+def test_http_overload_sheds_with_429(trained):
+    """Concurrent submissions beyond the bounded queue come back as explicit
+    429s (documented rejection), and the shed counter in /metrics matches."""
+    model, _ = trained
+    registry = ModelRegistry(max_batch=2)
+    entry = registry.deploy(model, version="v1")
+    real_batch = entry.batch
+
+    def slow_batch(records):
+        time.sleep(0.05)
+        return real_batch(records)
+
+    entry.batch = slow_batch
+    srv = ModelServer(registry, port=0, max_batch=2, max_wait_ms=1.0,
+                      queue_size=4).start()
+    n_clients = 24
+    shed, ok, other = [], [], []
+    lock = threading.Lock()
+
+    def client():
+        try:
+            status, out = _post(srv.url + "/score", {"x": 1.0, "cat": "a"},
+                                timeout=60)
+            with lock:
+                ok.append(status)
+        except urllib.error.HTTPError as e:
+            body = json.loads(e.read() or b"{}")
+            with lock:
+                (shed if e.code == 429 else other).append((e.code, body))
+        except Exception as e:  # noqa: BLE001
+            with lock:
+                other.append(e)
+
+    threads = [threading.Thread(target=client) for _ in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    try:
+        assert not other
+        assert len(shed) + len(ok) == n_clients  # nothing hung or vanished
+        assert len(shed) >= 1
+        assert all(body.get("shed") for _, body in shed)
+        status, m = _get(srv.url + "/metrics")
+        assert m["serve"]["shed"] == len(shed)
+    finally:
+        srv.stop()
+
+
+def test_serve_run_type(trained, tmp_path):
+    """OpWorkflowRunner dispatches Serve: serves HTTP for the configured
+    duration and exports ServeMetrics into AppMetrics.custom."""
+    import socket
+
+    from transmogrifai_tpu.runner import (OpWorkflowRunner, OpWorkflowRunType)
+    from transmogrifai_tpu.workflow.params import OpParams
+    from transmogrifai_tpu.workflow.workflow import OpWorkflow as WF
+
+    model, pred = trained
+    model.save(str(tmp_path / "m"))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    params = OpParams(model_location=str(tmp_path / "m"),
+                      metrics_location=str(tmp_path / "metrics"))
+    params.custom_params["serve"] = {"port": port, "max_batch": 4,
+                                     "duration_s": 3.0, "version": "it-1"}
+    runner = OpWorkflowRunner(workflow=WF())
+    result_box = {}
+
+    def run():
+        result_box["result"] = runner.run(OpWorkflowRunType.Serve, params)
+
+    t = threading.Thread(target=run)
+    t.start()
+    url = f"http://127.0.0.1:{port}"
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            status, health = _get(url + "/healthz", timeout=2)
+            if status == 200:
+                break
+        except Exception:  # noqa: BLE001 — server still starting
+            time.sleep(0.05)
+    else:
+        pytest.fail("serve run never became healthy")
+    status, out = _post(url + "/score", {"x": 0.4, "cat": "b"})
+    assert status == 200 and out["model_version"] == "it-1"
+    t.join(60)
+    result = result_box["result"]
+    assert result.run_type is OpWorkflowRunType.Serve
+    assert result.n_scored >= 1
+    assert result.metrics["serve"]["responses"] >= 1
+    # ServeMetrics surfaced through the AppMetrics listener machinery
+    assert result.app_metrics.custom["serve"]["responses"] >= 1
+    assert result.app_metrics.custom["serve_registry"]["active"] == "it-1"
+    saved = json.load(open(os.path.join(str(tmp_path / "metrics"),
+                                        "app_metrics.json")))
+    assert saved["custom"]["serve"]["responses"] >= 1
+
+
+def test_cli_serve_help():
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "-m", "transmogrifai_tpu.cli", "serve", "--help"],
+        capture_output=True, text=True, cwd=repo)
+    assert out.returncode == 0, out.stderr
+    assert "--max-batch" in out.stdout

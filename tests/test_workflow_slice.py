@@ -49,6 +49,14 @@ def test_dag_construction(titanic_features):
 
 
 def test_train_score_evaluate(titanic_df, titanic_features):
+    from tests.conftest import TITANIC_CSV
+
+    if not os.path.exists(TITANIC_CSV):
+        # the synthetic fallback has RANDOM labels — the AuROC floor below is
+        # unreachable by construction, so the quality assertions only make
+        # sense against the real reference dataset
+        pytest.skip("reference Titanic CSV not available; synthetic labels "
+                    "are random so the AuROC assertion is meaningless")
     survived, features, pred = _build_prediction(titanic_features)
     wf = OpWorkflow().set_result_features(pred).set_input_dataset(titanic_df,
                                                                  key="PassengerId")

@@ -1,0 +1,158 @@
+"""Loopback load generator for the serve/ subsystem (BENCH rounds).
+
+Trains a small testkit model in-process (or loads --model-location), starts
+a ModelServer on an ephemeral port, and hammers it with N client threads for
+a fixed duration.  Prints one JSON line: throughput, client-side
+p50/p95/p99 latency, and the server's own /metrics snapshot (batch
+occupancy, shed/fallback counters) — comparable across rounds.
+
+    python tools/probe_serve.py --concurrency 64 --duration 10
+    python tools/probe_serve.py --model-location /tmp/m --record '{"x": 1.0}'
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _train_demo_model():
+    """Tiny logistic model over (real, picklist) testkit features."""
+    import numpy as np
+
+    import transmogrifai_tpu.types as T
+    from transmogrifai_tpu import OpWorkflow
+    from transmogrifai_tpu.impl.classification.logistic import OpLogisticRegression
+    from transmogrifai_tpu.impl.feature.vectorizers import (
+        OneHotVectorizer, RealVectorizer, VectorsCombiner)
+    from transmogrifai_tpu.testkit import TestFeatureBuilder
+
+    n = 256
+    ds, (x, cat, y) = TestFeatureBuilder.of(
+        ("x", T.Real, list(np.linspace(-2, 2, n))),
+        ("cat", T.PickList, ["a", "b", "c", "d"] * (n // 4)),
+        ("y", T.RealNN, [float(i % 2) for i in range(n)]), response="y")
+    feats = VectorsCombiner().set_input(
+        RealVectorizer().set_input(x).get_output(),
+        OneHotVectorizer(top_k=5, min_support=1).set_input(cat).get_output(),
+    ).get_output()
+    pred = OpLogisticRegression(reg_param=0.1).set_input(y, feats).get_output()
+    return OpWorkflow().set_input_dataset(ds).set_result_features(pred).train()
+
+
+def _percentile(sorted_ms, p):
+    if not sorted_ms:
+        return 0.0
+    i = min(len(sorted_ms) - 1, int(p / 100.0 * len(sorted_ms)))
+    return sorted_ms[i]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--model-location", default=None,
+                   help="saved model dir (default: train a demo model)")
+    p.add_argument("--record", default=None,
+                   help="JSON record to score (default matches demo model)")
+    p.add_argument("--concurrency", type=int, default=64)
+    p.add_argument("--duration", type=float, default=10.0, help="seconds")
+    p.add_argument("--max-batch", type=int, default=64)
+    p.add_argument("--max-wait-ms", type=float, default=2.0)
+    p.add_argument("--queue-size", type=int, default=1024)
+    args = p.parse_args(argv)
+
+    from transmogrifai_tpu.serve import ModelRegistry, ModelServer
+
+    if args.model_location:
+        from transmogrifai_tpu.workflow.model import load_model
+
+        model = load_model(args.model_location)
+    else:
+        model = _train_demo_model()
+    record = json.loads(args.record) if args.record else {"x": 0.7, "cat": "b"}
+
+    registry = ModelRegistry(max_batch=args.max_batch)
+    server = ModelServer(registry, port=0, max_batch=args.max_batch,
+                         max_wait_ms=args.max_wait_ms,
+                         queue_size=args.queue_size)
+    t_warm = time.perf_counter()
+    registry.deploy(model)
+    warm_s = time.perf_counter() - t_warm
+    server.start()
+    url = f"{server.url}/score"
+    payload = json.dumps(record).encode()
+
+    latencies_ms: list = []
+    shed = [0]
+    errors = [0]
+    count = [0]
+    lock = threading.Lock()
+    stop_at = time.monotonic() + args.duration
+
+    def client():
+        local_lat, local_shed, local_err, local_n = [], 0, 0, 0
+        while time.monotonic() < stop_at:
+            t0 = time.perf_counter()
+            try:
+                req = urllib.request.Request(url, data=payload,
+                                             headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    resp.read()
+                local_lat.append((time.perf_counter() - t0) * 1000.0)
+                local_n += 1
+            except urllib.error.HTTPError as e:
+                if e.code == 429:
+                    local_shed += 1
+                    time.sleep(0.001)  # back off briefly on shed
+                else:
+                    local_err += 1
+            except Exception:
+                local_err += 1
+        with lock:
+            latencies_ms.extend(local_lat)
+            shed[0] += local_shed
+            errors[0] += local_err
+            count[0] += local_n
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(args.concurrency)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t0
+
+    with urllib.request.urlopen(f"{server.url}/metrics", timeout=10) as resp:
+        server_metrics = json.loads(resp.read())
+    server.stop()
+
+    latencies_ms.sort()
+    out = {
+        "probe": "serve",
+        "concurrency": args.concurrency,
+        "duration_s": round(elapsed, 3),
+        "warmup_s": round(warm_s, 3),
+        "responses": count[0],
+        "throughput_rps": round(count[0] / elapsed, 1) if elapsed else 0.0,
+        "client_shed": shed[0],
+        "client_errors": errors[0],
+        "p50_ms": round(_percentile(latencies_ms, 50), 3),
+        "p95_ms": round(_percentile(latencies_ms, 95), 3),
+        "p99_ms": round(_percentile(latencies_ms, 99), 3),
+        "batch_occupancy_mean": server_metrics["serve"]["batch_occupancy_mean"],
+        "server_metrics": server_metrics["serve"],
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,8 +6,16 @@ from .gen import generate_project
 
 
 def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "serve":
+        # delegates to the standalone server entry (own argparse/flags)
+        from .serve import main as serve_main
+
+        return serve_main(argv[1:])
     p = argparse.ArgumentParser(prog="transmogrifai_tpu.cli")
     sub = p.add_subparsers(dest="command", required=True)
+    sub.add_parser("serve", help="serve a saved model over HTTP "
+                                 "(see transmogrifai-tpu-serve --help)")
     gen = sub.add_parser("gen", help="generate a runnable project from a CSV")
     gen.add_argument("project", help="project name / output directory")
     gen.add_argument("--input", required=True, help="training CSV path")

@@ -7,7 +7,12 @@ no Spark (here: no batch Dataset, no device math) in the loop: every stage
 runs through its row-wise ``transform_row`` path (``transformKeyValue``
 analog), so a fitted model can serve single records inside any Python
 process with numpy-only latency.
-"""
-from .scoring import ScoreFunction, load_model_local, score_function
 
-__all__ = ["ScoreFunction", "load_model_local", "score_function"]
+``batch_score_function`` is the vectorized sibling used by the serve/
+subsystem: many record dicts scored through ONE batch DAG pass.
+"""
+from .scoring import (BatchScoreFunction, ScoreFunction, batch_score_function,
+                      load_model_local, score_function)
+
+__all__ = ["BatchScoreFunction", "ScoreFunction", "batch_score_function",
+           "load_model_local", "score_function"]

@@ -1,21 +1,47 @@
-"""Per-record scoring function (OpWorkflowModelLocal.scala:42-80).
+"""Per-record and batched scoring functions (OpWorkflowModelLocal.scala:42-80).
 
 The fitted DAG is walked once to precompute stage order; each call then
 threads a plain dict through every stage's ``transform_row`` — the reference
 runs OP stages via ``transformKeyValue`` and converts Spark-wrapped stages to
 MLeap row functions; here every stage already has a row path by construction
 (stages/base.py derives it from the batch path).
+
+``BatchScoreFunction`` is the vectorized sibling (the serve/ subsystem's
+bucket-scoring path): the same record dicts are assembled into a columnar
+``Dataset`` and pushed through the model's batch ``transform`` DAG in ONE
+pass, so N records share every stage launch (and, on device, one fused XLA
+computation per layer) instead of paying N per-record Python walks.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List
+from typing import Any, Callable, Dict, List, Sequence
 
 import numpy as np
 
 from .. import types as T
+from ..columns import Dataset, column_from_scalars
 from ..features.generator import FeatureGeneratorStage
 from ..stages.base import Model, PipelineStage, Transformer
+from ..workflow import dag as dag_util
 from ..workflow.model import OpWorkflowModel, load_model
+
+
+def _emit(v: Any) -> Any:
+    """Scored FeatureType -> plain JSON-able value (shared by row/batch paths)."""
+    if isinstance(v, T.Prediction):
+        return v.to_dict()
+    if isinstance(v, T.FeatureType):
+        val = v.value
+        return val.tolist() if isinstance(val, np.ndarray) else val
+    return v
+
+
+def _check_fitted(model: OpWorkflowModel) -> None:
+    for layer in model.dag:
+        for stage in layer:
+            if not isinstance(stage, Transformer):
+                raise TypeError(
+                    f"Model contains unfitted estimator {stage}; train first")
 
 
 class ScoreFunction:
@@ -23,13 +49,8 @@ class ScoreFunction:
 
     def __init__(self, model: OpWorkflowModel):
         self._raw_features = list(model.raw_features)
-        self._schedule: List[Transformer] = []
-        for layer in model.dag:
-            for stage in layer:
-                if not isinstance(stage, Transformer):
-                    raise TypeError(
-                        f"Model contains unfitted estimator {stage}; train first")
-                self._schedule.append(stage)
+        _check_fitted(model)
+        self._schedule: List[Transformer] = [s for layer in model.dag for s in layer]
         self._result_names = [f.name for f in model.result_features]
 
     def __call__(self, record: Dict[str, Any]) -> Dict[str, Any]:
@@ -54,19 +75,59 @@ class ScoreFunction:
             v = row.get(name)
             if v is None:
                 continue
-            if isinstance(v, T.Prediction):
-                out[name] = v.to_dict()
-            elif isinstance(v, T.FeatureType):
-                val = v.value
-                out[name] = val.tolist() if isinstance(val, np.ndarray) else val
-            else:
-                out[name] = v
+            out[name] = _emit(v)
         return out
+
+
+class BatchScoreFunction:
+    """Callable records -> list of score dicts, vectorized.
+
+    Record dicts are assembled into a columnar ``Dataset`` (same per-feature
+    extraction contract as ``ScoreFunction``) and scored through the fitted
+    DAG's batch transform path once for the whole batch.  Output dicts match
+    ``ScoreFunction``'s format element-for-element, so the two paths are
+    interchangeable (serve/ falls back from this to the row path on error).
+    """
+
+    def __init__(self, model: OpWorkflowModel):
+        self._raw_features = list(model.raw_features)
+        _check_fitted(model)
+        self._dag = model.dag
+        self._result_names = [f.name for f in model.result_features]
+
+    def records_to_dataset(self, records: Sequence[Dict[str, Any]]) -> Dataset:
+        """Record dicts -> raw-feature Dataset (the reader-less ingest path)."""
+        cols: Dict[str, Any] = {}
+        for f in self._raw_features:
+            stage = f.origin_stage
+            if isinstance(stage, FeatureGeneratorStage):
+                vals = [stage.extract(r) for r in records]
+            else:
+                vals = [v if isinstance(v, T.FeatureType) else T.make(f.ftype, v)
+                        for v in (r.get(f.name) for r in records)]
+            cols[f.name] = column_from_scalars(f.ftype, vals)
+        keys = np.arange(len(records)).astype(str).astype(object)
+        return Dataset(cols, keys)
+
+    def __call__(self, records: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+        records = list(records)
+        if not records:
+            return []
+        raw = self.records_to_dataset(records)
+        full = dag_util.apply_transformations_dag(raw, self._dag)
+        out_cols = [(n, full[n]) for n in self._result_names if n in full.columns]
+        return [{n: _emit(col.to_scalar(i)) for n, col in out_cols}
+                for i in range(len(records))]
 
 
 def score_function(model: OpWorkflowModel) -> ScoreFunction:
     """model.scoreFunction analog."""
     return ScoreFunction(model)
+
+
+def batch_score_function(model: OpWorkflowModel) -> BatchScoreFunction:
+    """Vectorized many-records scorer (the serve/ bucket path)."""
+    return BatchScoreFunction(model)
 
 
 def load_model_local(path: str) -> ScoreFunction:

@@ -37,13 +37,14 @@ from .workflow.workflow import OpWorkflow
 
 
 class OpWorkflowRunType(str, enum.Enum):
-    """OpWorkflowRunner.scala:358-365."""
+    """OpWorkflowRunner.scala:358-365, plus the online ``Serve`` type."""
 
     Train = "train"
     Score = "score"
     StreamingScore = "streamingScore"
     Features = "features"
     Evaluate = "evaluate"
+    Serve = "serve"
 
 
 @dataclass
@@ -95,6 +96,7 @@ class OpWorkflowRunner:
                 OpWorkflowRunType.StreamingScore: self._streaming_score,
                 OpWorkflowRunType.Features: self._features,
                 OpWorkflowRunType.Evaluate: self._evaluate,
+                OpWorkflowRunType.Serve: self._serve,
             }
             result = dispatch[run_type](params, listener)
         result.app_metrics = listener.metrics
@@ -210,6 +212,44 @@ class OpWorkflowRunner:
         return OpWorkflowRunnerResult(OpWorkflowRunType.Features,
                                       score_location=path, n_scored=len(data))
 
+    def _serve(self, params: OpParams, listener: OpListener) -> OpWorkflowRunnerResult:
+        """Online serving: load -> deploy (warm) -> HTTP until stopped.
+
+        Settings come from ``params.custom_params["serve"]`` (populated by the
+        CLI flags): host, port, max_batch, max_wait_ms, queue_size,
+        duration_s (None = serve until Ctrl-C; tests set a finite duration).
+        """
+        from .serve import ModelRegistry, ModelServer, ServeMetrics
+
+        model = self._load_model(params, listener)
+        cfg = dict(params.custom_params.get("serve", {}))
+        metrics = ServeMetrics()
+        registry = ModelRegistry(max_batch=int(cfg.get("max_batch", 64)),
+                                 metrics=metrics)
+        server = ModelServer(
+            registry,
+            host=cfg.get("host", "127.0.0.1"),
+            port=int(cfg.get("port", 8123)),
+            max_batch=int(cfg.get("max_batch", 64)),
+            max_wait_ms=float(cfg.get("max_wait_ms", 2.0)),
+            queue_size=int(cfg.get("queue_size", 1024)),
+            metrics=metrics)
+        listener.add_custom_provider("serve", metrics.snapshot)
+        listener.add_custom_provider("serve_registry", registry.info)
+        with listener.step(OpStep.Scoring):
+            registry.deploy(model, version=cfg.get("version"))
+            server.start()
+            print(f"Serving model at {server.url}/score "
+                  f"(metrics: {server.url}/metrics)", file=sys.stderr)
+            duration = cfg.get("duration_s")
+            server.wait(None if duration is None else float(duration))
+            server.stop()
+        snapshot = metrics.snapshot()
+        return OpWorkflowRunnerResult(OpWorkflowRunType.Serve,
+                                      model_location=params.model_location,
+                                      metrics={"serve": snapshot},
+                                      n_scored=snapshot["responses"])
+
     def _evaluate(self, params: OpParams, listener: OpListener) -> OpWorkflowRunnerResult:
         if self.evaluator is None:
             raise ValueError("Evaluate requires an evaluator")
@@ -262,6 +302,17 @@ class OpApp:
                             "--process-id or JAX_NUM_PROCESSES/JAX_PROCESS_ID)")
         p.add_argument("--num-processes", type=int, default=None)
         p.add_argument("--process-id", type=int, default=None)
+        serve = p.add_argument_group("serve", "options for --run-type=serve")
+        serve.add_argument("--host", default="127.0.0.1")
+        serve.add_argument("--port", type=int, default=8123)
+        serve.add_argument("--max-batch", type=int, default=64,
+                           help="largest micro-batch / shape bucket")
+        serve.add_argument("--max-wait-ms", type=float, default=2.0,
+                           help="max time a request waits for batchmates")
+        serve.add_argument("--queue-size", type=int, default=1024,
+                           help="admission queue bound (beyond it: HTTP 429)")
+        serve.add_argument("--serve-duration", type=float, default=None,
+                           help="seconds to serve (default: until Ctrl-C)")
         return p
 
     def parse_params(self, args: argparse.Namespace) -> OpParams:
@@ -274,6 +325,13 @@ class OpApp:
             params.reader_params["path"] = args.read_location
         if args.collect_stage_metrics:
             params.collect_stage_metrics = True
+        if args.run_type == OpWorkflowRunType.Serve.value:
+            params.custom_params.setdefault("serve", {}).update({
+                "host": args.host, "port": args.port,
+                "max_batch": args.max_batch, "max_wait_ms": args.max_wait_ms,
+                "queue_size": args.queue_size,
+                "duration_s": args.serve_duration,
+            })
         return params
 
     def main(self, argv: Optional[List[str]] = None) -> OpWorkflowRunnerResult:

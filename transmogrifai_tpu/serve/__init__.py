@@ -1,0 +1,31 @@
+"""serve — online scoring: micro-batched, shape-bucketed model serving.
+
+The TPU-shaped layer above ``local/`` (which proves the row-path contract):
+concurrent requests are micro-batched into padded power-of-two shape buckets
+so jit'd XLA computations are reused across requests, models hot-swap
+through a versioned registry (load -> warm -> swap -> drain), and overload
+sheds explicitly (bounded queue + HTTP 429) instead of degrading latency for
+everyone.
+
+Layering::
+
+    server.py    HTTP front end (stdlib ThreadingHTTPServer), load shedding
+    batcher.py   bounded admission queue -> padded bucket batches
+    registry.py  versioned models, atomic hot-swap, warmup
+    metrics.py   latency histograms / counters, exported via /metrics and
+                 the runner's AppMetrics (utils/listener.py)
+
+Entry points: the ``Serve`` run type on ``OpWorkflowRunner``, the
+``transmogrifai-tpu-serve`` console script, and this module's classes for
+in-process embedding (tests, notebooks).
+"""
+from .batcher import MicroBatcher, Scored, ShedError
+from .metrics import LatencyHistogram, ServeMetrics
+from .registry import (ModelRegistry, ServingModel, bucket_for, shape_buckets)
+from .server import ModelServer
+
+__all__ = [
+    "LatencyHistogram", "MicroBatcher", "ModelRegistry", "ModelServer",
+    "Scored", "ServeMetrics", "ServingModel", "ShedError", "bucket_for",
+    "shape_buckets",
+]

@@ -1,0 +1,169 @@
+"""Dynamic micro-batcher: requests -> padded shape-bucket batches.
+
+Requests enter a BOUNDED admission queue (overflow is shed immediately with
+``ShedError`` — never a hang, never a silent drop).  A single dispatcher
+thread collects up to ``max_batch`` requests or until ``max_wait_ms``
+elapses after the first one, pads the batch with null records to the nearest
+power-of-two bucket, and scores it through the active model's vectorized
+bucket path (records -> columnar Dataset -> batch transform DAG).  Padding
+canonicalizes shapes so every jit'd XLA computation is reused across
+requests — the registry warmup has already compiled each bucket, so no
+request pays first-compile latency.
+
+Scoring happens ONLY on the dispatcher thread, so model code never sees
+concurrent calls.  If the vectorized path errors, the batch degrades
+gracefully to the per-record numpy row path (per-record, so one poisonous
+record fails alone rather than failing its batchmates).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, NamedTuple, Optional
+
+from .metrics import ServeMetrics
+from .registry import ModelRegistry, bucket_for
+
+
+class ShedError(RuntimeError):
+    """Admission queue full — request rejected (HTTP 429 analog)."""
+
+    status = 429
+
+
+class Scored(NamedTuple):
+    """What a request's future resolves to."""
+
+    version: str
+    output: Dict[str, Any]
+
+
+class _Pending(NamedTuple):
+    record: Dict[str, Any]
+    future: Future
+    enqueued_at: float
+
+
+class MicroBatcher:
+    """Bounded-queue micro-batcher over a ``ModelRegistry``."""
+
+    def __init__(self, registry: ModelRegistry, max_batch: int = 64,
+                 max_wait_ms: float = 2.0, queue_size: int = 1024,
+                 metrics: Optional[ServeMetrics] = None):
+        if max_batch > registry.buckets[-1]:
+            raise ValueError(f"max_batch {max_batch} exceeds the registry's "
+                             f"largest bucket {registry.buckets[-1]}")
+        self.registry = registry
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_ms) / 1000.0
+        # one shared sink: prefer the explicit one, else the registry's, and
+        # wire the registry in so its swap counter lands in the same place
+        self.metrics = metrics or registry.metrics or ServeMetrics()
+        if registry.metrics is None:
+            registry.metrics = self.metrics
+        self._queue: "queue.Queue[_Pending]" = queue.Queue(maxsize=int(queue_size))
+        self.metrics.add_gauge("queue_depth", self._queue.qsize)
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- lifecycle ---------------------------------------------------------
+    def start(self) -> "MicroBatcher":
+        if self._running:
+            return self
+        self._running = True
+        self._thread = threading.Thread(target=self._loop,
+                                        name="serve-dispatcher", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+            self._thread = None
+        # fail whatever is still queued rather than leaving callers hanging
+        while True:
+            try:
+                pending = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            pending.future.set_exception(RuntimeError("server shutting down"))
+
+    # ---- admission ---------------------------------------------------------
+    def submit(self, record: Dict[str, Any]) -> "Future[Scored]":
+        """Enqueue one record; sheds with ``ShedError`` when the queue is full."""
+        self.metrics.inc("requests")
+        future: "Future[Scored]" = Future()
+        try:
+            self._queue.put_nowait(_Pending(record, future, time.monotonic()))
+        except queue.Full:
+            self.metrics.inc("shed")
+            raise ShedError(
+                f"admission queue full ({self._queue.maxsize} pending); retry later")
+        return future
+
+    def score(self, record: Dict[str, Any],
+              timeout_s: Optional[float] = 30.0) -> Dict[str, Any]:
+        """Submit + wait: the blocking single-record convenience API."""
+        return self.submit(record).result(timeout_s).output
+
+    # ---- dispatch ----------------------------------------------------------
+    def _loop(self) -> None:
+        while self._running:
+            try:
+                first = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            batch = [first]
+            deadline = time.monotonic() + self.max_wait_s
+            while len(batch) < self.max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self._queue.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            self._dispatch(batch)
+
+    def _dispatch(self, batch: List[_Pending]) -> None:
+        try:
+            entry = self.registry.active()
+        except LookupError as e:
+            for p in batch:
+                p.future.set_exception(e)
+            self.metrics.inc("errors", len(batch))
+            return
+        n = len(batch)
+        bucket = bucket_for(n, entry.buckets)
+        records = [p.record for p in batch] + [{} for _ in range(bucket - n)]
+        t0 = time.monotonic()
+        with entry.in_flight():
+            try:
+                outputs = entry.batch(records)[:n]
+            except Exception:
+                outputs = self._fallback(entry, batch)
+        batch_ms = (time.monotonic() - t0) * 1000.0
+        self.metrics.observe_batch(batch_ms, n, bucket)
+        done = time.monotonic()
+        for p, out in zip(batch, outputs):
+            if isinstance(out, Exception):
+                self.metrics.inc("errors")
+                p.future.set_exception(out)
+            else:
+                self.metrics.observe_request((done - p.enqueued_at) * 1000.0)
+                p.future.set_result(Scored(entry.version, out))
+
+    def _fallback(self, entry, batch: List[_Pending]) -> List[Any]:
+        """Vectorized path failed: numpy row path, one record at a time."""
+        self.metrics.inc("fallback_batches")
+        outputs: List[Any] = []
+        for p in batch:
+            try:
+                outputs.append(entry.row(p.record))
+                self.metrics.inc("fallback_records")
+            except Exception as e:  # noqa: BLE001 — isolate the poisonous record
+                outputs.append(e)
+        return outputs

@@ -1,0 +1,153 @@
+"""Serving metrics: latency histograms, counters, and gauges.
+
+Exported two ways:
+
+- as the JSON payload of the server's ``/metrics`` endpoint, and
+- into the runner's ``AppMetrics.custom`` through the existing
+  ``utils/listener.py`` machinery (``OpListener.add_custom_provider``), so a
+  ``Serve`` run writes the same numbers into ``app_metrics.json`` as every
+  other run type.
+
+All mutators take one lock; the snapshot is a consistent point-in-time copy.
+"""
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+
+class LatencyHistogram:
+    """Log-spaced latency histogram (milliseconds).
+
+    64 buckets geometric from 0.05 ms with ratio 1.25 (~60 s span, ~12%
+    resolution) — coarse enough to be free, fine enough for p99 reporting.
+    Percentiles interpolate to the geometric midpoint of the hit bucket.
+    """
+
+    BASE_MS = 0.05
+    RATIO = 1.25
+    N_BUCKETS = 64
+
+    def __init__(self):
+        self.counts = [0] * self.N_BUCKETS
+        self.n = 0
+        self.sum_ms = 0.0
+        self.max_ms = 0.0
+
+    def _bucket(self, ms: float) -> int:
+        if ms <= self.BASE_MS:
+            return 0
+        i = int(math.log(ms / self.BASE_MS) / math.log(self.RATIO)) + 1
+        return min(i, self.N_BUCKETS - 1)
+
+    def record(self, ms: float) -> None:
+        self.counts[self._bucket(ms)] += 1
+        self.n += 1
+        self.sum_ms += ms
+        if ms > self.max_ms:
+            self.max_ms = ms
+
+    def percentile(self, p: float) -> float:
+        """p in [0, 100]; 0.0 when empty."""
+        if self.n == 0:
+            return 0.0
+        target = p / 100.0 * self.n
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= target:
+                lo = self.BASE_MS * self.RATIO ** (i - 1) if i else 0.0
+                hi = self.BASE_MS * self.RATIO ** i
+                return math.sqrt(max(lo, self.BASE_MS * 0.5) * hi) if lo else hi
+        return self.max_ms
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "count": self.n,
+            "mean_ms": (self.sum_ms / self.n) if self.n else 0.0,
+            "max_ms": self.max_ms,
+            "p50_ms": self.percentile(50),
+            "p95_ms": self.percentile(95),
+            "p99_ms": self.percentile(99),
+        }
+
+
+class ServeMetrics:
+    """Counters + histograms for the serving subsystem.
+
+    ``requests`` counts admissions attempts, ``shed`` the rejected ones
+    (bounded-queue overflow), ``responses`` the completed scores,
+    ``fallback_records`` the records that degraded to the numpy row path,
+    ``errors`` the requests that failed outright.  Batch-side:
+    ``batches``, per-bucket dispatch counts, occupancy (real records per
+    dispatched batch) and padded-row totals.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.responses = 0
+        self.shed = 0
+        self.errors = 0
+        self.fallback_records = 0
+        self.fallback_batches = 0
+        self.batches = 0
+        self.occupancy_sum = 0
+        self.padded_rows = 0
+        self.bucket_counts: Dict[int, int] = {}
+        self.swaps = 0
+        self.request_latency = LatencyHistogram()
+        self.batch_latency = LatencyHistogram()
+        #: gauges polled at snapshot time (e.g. live queue depth)
+        self._gauges: Dict[str, Callable[[], Any]] = {}
+
+    # ---- mutators ----------------------------------------------------------
+    def inc(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + by)
+
+    def observe_request(self, ms: float) -> None:
+        with self._lock:
+            self.responses += 1
+            self.request_latency.record(ms)
+
+    def observe_batch(self, ms: float, n_records: int, bucket: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.occupancy_sum += n_records
+            self.padded_rows += bucket - n_records
+            self.bucket_counts[bucket] = self.bucket_counts.get(bucket, 0) + 1
+            self.batch_latency.record(ms)
+
+    def add_gauge(self, name: str, fn: Callable[[], Any]) -> None:
+        with self._lock:
+            self._gauges[name] = fn
+
+    # ---- export ------------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Any] = {
+                "requests": self.requests,
+                "responses": self.responses,
+                "shed": self.shed,
+                "errors": self.errors,
+                "fallback_records": self.fallback_records,
+                "fallback_batches": self.fallback_batches,
+                "batches": self.batches,
+                "swaps": self.swaps,
+                "batch_occupancy_mean": (self.occupancy_sum / self.batches
+                                         if self.batches else 0.0),
+                "padded_rows": self.padded_rows,
+                "bucket_counts": {str(k): v for k, v in
+                                  sorted(self.bucket_counts.items())},
+                "request_latency": self.request_latency.to_json(),
+                "batch_latency": self.batch_latency.to_json(),
+            }
+            gauges = dict(self._gauges)
+        for name, fn in gauges.items():
+            try:
+                out[name] = fn()
+            except Exception:
+                out[name] = None
+        return out

@@ -1,0 +1,157 @@
+"""Versioned model registry with atomic hot-swap.
+
+Deploy discipline: **load -> warm -> swap -> drain**.
+
+1. *load*: the candidate ``OpWorkflowModel`` is wrapped into a
+   ``ServingModel`` (vectorized bucket scorer + numpy row fallback);
+2. *warm*: every shape bucket is scored once with null records so all jit'd
+   XLA computations compile BEFORE the model takes traffic — no request ever
+   pays first-compile latency (the TpuGraphs lesson: recompilation dominates
+   unless shapes are canonicalized up front);
+3. *swap*: one reference assignment under the registry lock — requests
+   dispatched after this point score on the new version;
+4. *drain*: the deploy call blocks until the outgoing version's in-flight
+   batches complete, so the old model's resources can be released and the
+   caller knows no stale-version response is still being produced for
+   post-swap submissions.
+
+A failed warmup aborts the deploy and leaves the active model untouched.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ..local.scoring import BatchScoreFunction, ScoreFunction
+from ..workflow.model import OpWorkflowModel
+from .metrics import ServeMetrics
+
+DEFAULT_MAX_BATCH = 64
+
+
+def shape_buckets(max_batch: int) -> List[int]:
+    """Power-of-two padding targets up to (and including) ``max_batch``."""
+    buckets = []
+    b = 1
+    while b < max_batch:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_batch)
+    return buckets
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= n (callers never exceed the largest bucket)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+class ServingModel:
+    """One deployed model version: bucket scorer, row fallback, drain state."""
+
+    def __init__(self, version: str, model: OpWorkflowModel,
+                 buckets: Sequence[int]):
+        self.version = version
+        self.model = model
+        self.batch = BatchScoreFunction(model)
+        self.row = ScoreFunction(model)
+        self.buckets = list(buckets)
+        self.deployed_at_ms: Optional[int] = None
+        self.warmed = False
+        self._cond = threading.Condition()
+        self._inflight = 0
+
+    def warmup(self) -> None:
+        """Score null records at every bucket size (compiles all shapes)."""
+        for b in self.buckets:
+            self.batch([{} for _ in range(b)])
+        self.warmed = True
+
+    @contextlib.contextmanager
+    def in_flight(self):
+        with self._cond:
+            self._inflight += 1
+        try:
+            yield self
+        finally:
+            with self._cond:
+                self._inflight -= 1
+                self._cond.notify_all()
+
+    @property
+    def inflight(self) -> int:
+        with self._cond:
+            return self._inflight
+
+    def drain(self, timeout_s: Optional[float] = 30.0) -> bool:
+        """Block until no batch is scoring on this version; True if drained."""
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        with self._cond:
+            while self._inflight > 0:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+        return True
+
+
+class ModelRegistry:
+    """Holds the active ``ServingModel`` plus deploy history."""
+
+    def __init__(self, max_batch: int = DEFAULT_MAX_BATCH,
+                 metrics: Optional[ServeMetrics] = None):
+        self.buckets = shape_buckets(max_batch)
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._active: Optional[ServingModel] = None
+        self._history: List[str] = []
+
+    def deploy(self, model: OpWorkflowModel, version: Optional[str] = None,
+               warm: bool = True, drain_timeout_s: Optional[float] = 30.0
+               ) -> ServingModel:
+        """load -> warm -> swap -> drain; returns the now-active version."""
+        with self._lock:
+            version = version or f"v{len(self._history) + 1}"
+            if version in self._history:
+                raise ValueError(f"Version {version!r} already deployed")
+        entry = ServingModel(version, model, self.buckets)
+        if warm:
+            entry.warmup()  # raises -> deploy aborted, active model untouched
+        with self._lock:
+            old, self._active = self._active, entry
+            entry.deployed_at_ms = int(time.time() * 1000)
+            self._history.append(version)
+        if self.metrics is not None:
+            self.metrics.inc("swaps")
+        if old is not None:
+            old.drain(drain_timeout_s)
+        return entry
+
+    def active(self) -> ServingModel:
+        with self._lock:
+            if self._active is None:
+                raise LookupError("No model deployed; call registry.deploy first")
+            return self._active
+
+    def active_version(self) -> Optional[str]:
+        with self._lock:
+            return None if self._active is None else self._active.version
+
+    def versions(self) -> List[str]:
+        with self._lock:
+            return list(self._history)
+
+    def info(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "active": None if self._active is None else self._active.version,
+                "warmed": bool(self._active and self._active.warmed),
+                "deployed_at_ms": (None if self._active is None
+                                   else self._active.deployed_at_ms),
+                "versions": list(self._history),
+                "buckets": list(self.buckets),
+            }

@@ -1,0 +1,202 @@
+"""Stdlib-only JSON scoring endpoint over the micro-batcher.
+
+``ThreadingHTTPServer`` (one thread per connection) in front of the bounded
+admission queue: handler threads only parse JSON, submit to the batcher, and
+block on their futures — ALL scoring work happens on the single dispatcher
+thread.  When the queue is full the request is rejected immediately with
+HTTP 429 (load shedding — overload degrades explicitly, never by hanging).
+
+Endpoints:
+
+- ``POST /score``   — body: one record object, a list of records, or
+  ``{"records": [...]}``; response carries the scoring model's version.
+- ``POST /models``  — hot-swap: ``{"path": "<saved model dir>",
+  "version": "v2"?}`` loads, warms and atomically swaps via the registry.
+- ``GET /metrics``  — serve metrics snapshot + registry/queue state.
+- ``GET /models``   — registry info (active version, history, buckets).
+- ``GET /healthz``  — 200 once a warmed model is active, else 503.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from .batcher import MicroBatcher, ShedError
+from .metrics import ServeMetrics
+from .registry import ModelRegistry
+
+
+class ModelServer:
+    """Owns the batcher + HTTP front end; start()/stop() or serve_forever()."""
+
+    def __init__(self, registry: ModelRegistry, host: str = "127.0.0.1",
+                 port: int = 0, max_batch: int = 64, max_wait_ms: float = 2.0,
+                 queue_size: int = 1024, request_timeout_s: float = 30.0,
+                 metrics: Optional[ServeMetrics] = None):
+        self.registry = registry
+        self.metrics = metrics or registry.metrics or ServeMetrics()
+        if registry.metrics is None:
+            registry.metrics = self.metrics
+        self.batcher = MicroBatcher(registry, max_batch=max_batch,
+                                    max_wait_ms=max_wait_ms,
+                                    queue_size=queue_size, metrics=self.metrics)
+        self.request_timeout_s = float(request_timeout_s)
+        self._host, self._port = host, int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+
+    # ---- lifecycle ---------------------------------------------------------
+    @property
+    def port(self) -> int:
+        return self._port
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self._port}"
+
+    def start(self) -> "ModelServer":
+        if self._httpd is not None:
+            return self
+        self.batcher.start()
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer((self._host, self._port), handler)
+        self._httpd.daemon_threads = True
+        self._port = self._httpd.server_address[1]
+        self._stopped.clear()
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="serve-http", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(10.0)
+            self._thread = None
+        self.batcher.stop()
+        self._stopped.set()
+
+    def wait(self, duration_s: Optional[float] = None) -> None:
+        """Block until ``stop()`` (or for ``duration_s``); Ctrl-C stops cleanly."""
+        try:
+            self._stopped.wait(duration_s)
+        except KeyboardInterrupt:
+            pass
+
+    def serve_forever(self, duration_s: Optional[float] = None) -> None:
+        self.start()
+        try:
+            self.wait(duration_s)
+        finally:
+            self.stop()
+
+
+def _make_handler(server: "ModelServer"):
+    """Handler class closed over the ModelServer (avoids globals)."""
+
+    class ServeHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        # ---- plumbing ------------------------------------------------------
+        def log_message(self, fmt, *args):  # quiet: metrics are the log
+            pass
+
+        def _reply(self, status: int, payload: Dict[str, Any]) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _body_json(self) -> Any:
+            length = int(self.headers.get("Content-Length") or 0)
+            return json.loads(self.rfile.read(length) or b"null")
+
+        # ---- GET -----------------------------------------------------------
+        def do_GET(self):
+            if self.path == "/metrics":
+                self._reply(200, {"serve": server.metrics.snapshot(),
+                                  "registry": server.registry.info()})
+            elif self.path == "/models":
+                self._reply(200, server.registry.info())
+            elif self.path == "/healthz":
+                info = server.registry.info()
+                ok = info["active"] is not None and info["warmed"]
+                self._reply(200 if ok else 503,
+                            {"status": "ok" if ok else "no model",
+                             "model": info["active"]})
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+        # ---- POST ----------------------------------------------------------
+        def do_POST(self):
+            if self.path == "/score":
+                self._score()
+            elif self.path == "/models":
+                self._deploy()
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def _score(self):
+            try:
+                body = self._body_json()
+            except (ValueError, json.JSONDecodeError):
+                self._reply(400, {"error": "invalid JSON body"})
+                return
+            single = isinstance(body, dict) and "records" not in body
+            records = [body] if single else \
+                (body["records"] if isinstance(body, dict) else body)
+            if not isinstance(records, list) or \
+                    not all(isinstance(r, dict) for r in records):
+                self._reply(400, {"error": "expected a record object, a list "
+                                           "of records, or {\"records\": [...]}"})
+                return
+            try:
+                futures = [server.batcher.submit(r) for r in records]
+            except ShedError as e:
+                self._reply(429, {"error": str(e), "shed": True})
+                return
+            try:
+                scored = [f.result(server.request_timeout_s) for f in futures]
+            except (FutureTimeoutError, TimeoutError):
+                self._reply(503, {"error": "scoring timed out"})
+                return
+            except Exception as e:  # noqa: BLE001 — surface scoring errors as 500
+                self._reply(500, {"error": str(e)})
+                return
+            version = scored[-1].version if scored else None
+            if single:
+                self._reply(200, {"score": scored[0].output,
+                                  "model_version": version})
+            else:
+                self._reply(200, {"scores": [s.output for s in scored],
+                                  "model_version": version})
+
+        def _deploy(self):
+            try:
+                body = self._body_json()
+                path = body["path"]
+            except Exception:
+                self._reply(400, {"error": "expected {\"path\": ..., "
+                                           "\"version\"?: ...}"})
+                return
+            try:
+                from ..workflow.model import load_model
+
+                entry = server.registry.deploy(load_model(path),
+                                               version=body.get("version"))
+            except Exception as e:  # noqa: BLE001 — bad model must not kill serving
+                self._reply(400, {"error": f"deploy failed: {e}"})
+                return
+            self._reply(200, {"active": entry.version,
+                              "versions": server.registry.versions()})
+
+    return ServeHandler

@@ -14,14 +14,39 @@ be reused).  Arrays that refuse weakrefs are simply not cached.
 
 Caveat (documented contract): callers must not MUTATE a cached array in
 place — the framework's columnar pipeline never does (transforms build new
-arrays).
+arrays).  Set ``TRANSMOG_DEVCACHE_CHECK=1`` to enforce it: a cheap
+fingerprint (shape, dtype, first/last-row checksum) is stored at insert and
+re-verified at every lookup; a mismatch raises ``DevCacheMutationError``
+instead of silently serving stale device buffers.
 """
 from __future__ import annotations
 
+import os
 import weakref
+import zlib
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
+
+
+class DevCacheMutationError(RuntimeError):
+    """A host array was mutated in place after its device copy was cached."""
+
+
+def _check_enabled() -> bool:
+    return os.environ.get("TRANSMOG_DEVCACHE_CHECK", "") == "1"
+
+
+def _fingerprint(arr: np.ndarray) -> Optional[Tuple]:
+    """(shape, dtype, crc(first row), crc(last row)) — O(row width), not O(n)."""
+    try:
+        first = np.ascontiguousarray(arr[:1])
+        last = np.ascontiguousarray(arr[-1:])
+        return (arr.shape, arr.dtype.str,
+                zlib.crc32(first.tobytes()), zlib.crc32(last.tobytes()))
+    except Exception:  # non-bytes-able contents (object arrays): skip the check
+        return None
+
 
 _entries: Dict[int, Dict[str, Any]] = {}
 
@@ -32,13 +57,26 @@ def _slot(arr: np.ndarray) -> Optional[Dict[Any, Any]]:
     key = id(arr)
     ent = _entries.get(key)
     if ent is not None:
+        if _check_enabled():
+            fp = _fingerprint(arr)
+            old = ent.get("fp")
+            if old is None:
+                ent["fp"] = fp  # inserted while the check was off: adopt now
+            elif fp is not None and fp != old:
+                raise DevCacheMutationError(
+                    f"devcache: host array id={key} was mutated in place after "
+                    f"caching (fingerprint {old} -> {fp}); cached device "
+                    f"buffers would be stale. Build a new array instead.")
         return ent["products"]
     try:
         ref = weakref.ref(arr, lambda _r, k=key: _entries.pop(k, None))
     except TypeError:  # exotic ndarray subclass without weakref support
         return None
     products: Dict[Any, Any] = {}
-    _entries[key] = {"_ref": ref, "products": products}
+    ent = {"_ref": ref, "products": products}
+    if _check_enabled():
+        ent["fp"] = _fingerprint(arr)
+    _entries[key] = ent
     return products
 
 

@@ -98,6 +98,7 @@ class OpListener:
         self.collect_stage_metrics = collect_stage_metrics
         self._step: OpStep = OpStep.Other
         self._end_handlers: List[Callable[[AppMetrics], None]] = []
+        self._custom_providers: Dict[str, Callable[[], Any]] = {}
 
     # ---- phase tagging (JobGroupUtil.withJobGroup analog) ------------------
     @contextlib.contextmanager
@@ -133,8 +134,21 @@ class OpListener:
         """OpWorkflowRunner.addApplicationEndHandler:145."""
         self._end_handlers.append(fn)
 
+    def add_custom_provider(self, name: str, fn: Callable[[], Any]) -> None:
+        """Register a snapshot fn polled at ``end()`` into ``metrics.custom``.
+
+        Subsystems with their own counters (e.g. serve/'s ServeMetrics) hook
+        in here so their final state lands in app_metrics.json alongside the
+        stage metrics without the runner knowing their internals."""
+        self._custom_providers[name] = fn
+
     def end(self) -> AppMetrics:
         self.metrics.ended_at_ms = int(time.time() * 1000)
+        for name, provider in self._custom_providers.items():
+            try:
+                self.metrics.custom[name] = provider()
+            except Exception:  # snapshots must not break the run
+                pass
         for fn in self._end_handlers:
             try:
                 fn(self.metrics)
