@@ -220,6 +220,12 @@ def main():
     acct = flops.totals()
     flops.disable()
 
+    # sweep-launch telemetry (reset per validate: this is the LAST rep's),
+    # so a multi-chip run shows its shard count + per-shard wall/compile —
+    # the aggregate models/s above already spans all shards
+    from transmogrifai_tpu.ops import sweep as sweep_ops
+    sweep_stats = sweep_ops.run_stats()
+
     models_per_sec = n_models / dt
     base, base_src = baseline_models_per_sec()
     out = {
@@ -235,7 +241,15 @@ def main():
                  "(LR 8 + RF 18 + XGB 2 reference defaults)",
         "warmup_s": round(warm, 2),
         "steady_s": round(dt, 2),
+        "sweep_shards": sweep_stats["sweep_shards"],
     }
+    per_shard = [s for l in sweep_stats["launches"] if l["shards"] > 1
+                 for s in l["per_shard"]]
+    if per_shard:
+        out["sweep_per_shard"] = per_shard
+    if acct.get("by_device"):
+        out["flops_by_device"] = {k: round(v["flops"] / reps)
+                                  for k, v in acct["by_device"].items()}
     if acct["calls"]:
         flops_per_rep = acct["flops"] / reps
         out["flops_per_rep"] = round(flops_per_rep)

@@ -1,0 +1,156 @@
+"""The ``SPLIT_METRICS_ELEMS`` two-launch path must match the single launch.
+
+Above the element threshold ``run_sweep`` runs as TWO programs (scores, then
+metrics) instead of one fused ``_run`` — a round-5 workaround for a worker
+OOM; until now that branch had no direct coverage.  Forcing the threshold to
+0 must reproduce the single-launch metrics to 1e-6 for binary and
+regression specs, both on the single-device path and per shard inside the
+partitioned multi-device path; the split also has to keep utils/flops
+honest (per-shape call counts, satellite of the multi-chip PR).
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from transmogrifai_tpu.evaluators.classification import \
+    OpBinaryClassificationEvaluator
+from transmogrifai_tpu.evaluators.regression import OpRegressionEvaluator
+from transmogrifai_tpu.impl.classification.logistic import OpLogisticRegression
+from transmogrifai_tpu.impl.classification.trees import (
+    OpRandomForestClassifier, OpXGBoostClassifier)
+from transmogrifai_tpu.impl.regression.linear import OpLinearRegression
+from transmogrifai_tpu.impl.regression.trees import OpRandomForestRegressor
+from transmogrifai_tpu.impl.sweep_fragments import build_sweep_plan
+from transmogrifai_tpu.impl.tuning.validators import OpCrossValidation
+from transmogrifai_tpu.ops import sweep as sweep_ops
+from transmogrifai_tpu.utils import flops
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(17)
+    n, d = 160, 8
+    X = np.ascontiguousarray(rng.normal(size=(n, d)).astype(np.float32))
+    beta = rng.normal(size=d)
+    z = X @ beta
+    y_bin = (z + 0.3 * rng.normal(size=n) > 0).astype(np.float32)
+    y_reg = (z + 0.3 * rng.normal(size=n)).astype(np.float32)
+    return X, y_bin, y_reg
+
+
+def _plan(cands, X, y, ev, F=2, seed=13):
+    cv = OpCrossValidation(ev, num_folds=F, seed=seed, mesh=None)
+    train_w, val_mask = cv.make_folds(len(y), None)
+    plan = build_sweep_plan(cands, X, y, train_w, ev)
+    assert plan is not None
+    return plan, train_w, val_mask
+
+
+def _binary_plan(data):
+    X, y, _ = data
+    cands = [
+        (OpLogisticRegression(max_iter=30),
+         [{"reg_param": 0.01, "elastic_net_param": 0.2},
+          {"reg_param": 0.1, "elastic_net_param": 0.0}]),
+        (OpRandomForestClassifier(num_trees=6), [{"max_depth": 3}]),
+        (OpXGBoostClassifier(num_round=5, max_depth=3), [{"eta": 0.3}]),
+    ]
+    return _plan(cands, X, y, OpBinaryClassificationEvaluator())
+
+
+def _regression_plan(data):
+    X, _, y = data
+    cands = [
+        (OpLinearRegression(),
+         [{"reg_param": 0.01, "elastic_net_param": 0.1},
+          {"reg_param": 0.1, "elastic_net_param": 0.5}]),
+        (OpRandomForestRegressor(num_trees=6), [{"max_depth": 3}]),
+    ]
+    return _plan(cands, X, y, OpRegressionEvaluator())
+
+
+@pytest.mark.parametrize("build", [_binary_plan, _regression_plan],
+                         ids=["binary", "regression"])
+def test_two_launch_matches_single_launch(data, build, monkeypatch):
+    plan, train_w, val_mask, = build(data)
+    sweep_ops.reset_run_stats()
+    single = plan.run(train_w, val_mask)
+    assert sweep_ops.run_stats()["launches"][-1]["split"] is False
+    monkeypatch.setattr(sweep_ops, "SPLIT_METRICS_ELEMS", 0)
+    split = plan.run(train_w, val_mask)
+    assert sweep_ops.run_stats()["launches"][-1]["split"] is True
+    assert split.shape == single.shape
+    assert np.max(np.abs(split - single)) <= 1e-6
+
+
+def test_partitioned_shards_apply_split(data, monkeypatch):
+    """Each shard applies the two-launch split to its OWN candidate count;
+    the gathered metrics still match the unsplit single launch."""
+    plan, train_w, val_mask = _binary_plan(data)
+    devs = jax.devices()[:4]
+    if len(devs) < 2:
+        pytest.skip("needs >= 2 devices")
+    single = plan.run(train_w, val_mask)
+    monkeypatch.setattr(sweep_ops, "SPLIT_METRICS_ELEMS", 0)
+    sweep_ops.reset_run_stats()
+    sharded = plan.run_sharded(train_w, val_mask, devs)
+    launch = sweep_ops.run_stats()["launches"][-1]
+    assert launch["shards"] == len(devs)
+    assert all(s["split"] for s in launch["per_shard"])
+    assert np.max(np.abs(sharded - single)) <= 1e-6
+
+
+def test_split_flops_call_counts(data, monkeypatch):
+    """satellite: the split path records run_scores/run_metrics once per
+    launch under the call's OWN shape signature — per-shape call counts in
+    ``by_fn`` must sum to the entry's total calls."""
+    plan, train_w, val_mask = _binary_plan(data)
+    monkeypatch.setattr(sweep_ops, "SPLIT_METRICS_ELEMS", 0)
+    flops.enable()
+    flops.reset()
+    try:
+        plan.run(train_w, val_mask)
+        plan.run(train_w, val_mask)
+        acct = flops.totals()
+    finally:
+        flops.disable()
+        flops.reset()
+    if not acct["calls"]:
+        pytest.skip("cost_analysis unavailable on this backend")
+    for name in ("sweep.run_scores", "sweep.run_metrics"):
+        entry = acct["by_fn"][name]
+        assert entry["calls"] == 2
+        assert sum(s["calls"] for s in entry["by_shape"].values()) \
+            == entry["calls"]
+
+
+def test_partitioned_flops_by_device(data):
+    """Per-device attribution: a partitioned sweep splits its FLOPs across
+    the shard devices and per-shard shapes stay distinguishable."""
+    plan, train_w, val_mask = _binary_plan(data)
+    devs = jax.devices()[:2]
+    if len(devs) < 2:
+        pytest.skip("needs >= 2 devices")
+    # warm up OUTSIDE accounting: tracing a new program while accounting is
+    # on also records the inner wrapped family kernels (same caveat as the
+    # bench, which enables flops only after its warmup rep)
+    plan.run_sharded(train_w, val_mask, devs)
+    flops.enable()
+    flops.reset()
+    try:
+        plan.run_sharded(train_w, val_mask, devs)
+        acct = flops.totals()
+    finally:
+        flops.disable()
+        flops.reset()
+    if not acct["calls"]:
+        pytest.skip("cost_analysis unavailable on this backend")
+    assert set(acct["by_device"]) == {str(d) for d in devs}
+    assert all(v["calls"] >= 1 for v in acct["by_device"].values())
+    total_dev = sum(v["flops"] for v in acct["by_device"].values())
+    assert total_dev == pytest.approx(acct["flops"])
+    # one "sweep.run" record per shard, each under its own shape signature
+    entry = acct["by_fn"]["sweep.run"]
+    assert entry["calls"] == len(devs)
+    assert len(entry["by_shape"]) == len(devs)

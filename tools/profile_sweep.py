@@ -1,10 +1,24 @@
-"""Per-family wall-clock profile of the Titanic default sweep (dev tool)."""
+"""Per-family wall-clock profile of the Titanic default sweep (dev tool).
+
+``--shards N`` instead partitions the default fused spec with the SAME cost
+model the multi-chip sweep uses (parallel/spec_partition) and prints
+predicted vs MEASURED per-shard cost — each shard run sequentially on one
+device — so partitioner balance regressions are diagnosable without a pod.
+"""
+import argparse
 import os, sys, time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
 from bench import init_backend, titanic_arrays
+
+args = argparse.ArgumentParser(description=__doc__)
+args.add_argument("--shards", type=int, default=0,
+                  help="partition the default grid into N cost-balanced "
+                       "shards and print predicted vs measured per-shard "
+                       "cost (0 = legacy per-family profile)")
+args = args.parse_args()
 
 platform, fb = init_backend()
 print("platform:", platform, fb)
@@ -37,6 +51,57 @@ def timed(name, candidates, reps=3):
           f"  ({3*n/dt:8.1f} models/s)")
     return dt
 
+
+def profile_shards(n_shards: int, reps: int = 3) -> None:
+    """Predicted vs measured per-shard cost of the default 28-candidate grid."""
+    import jax
+
+    from transmogrifai_tpu.impl.sweep_fragments import build_sweep_plan
+    from transmogrifai_tpu.ops.sweep import run_sweep
+    from transmogrifai_tpu.parallel.spec_partition import (partition_spec,
+                                                           predicted_balance)
+
+    cands = [(OpLogisticRegression(max_iter=50), D.logistic_regression_grid()),
+             (OpRandomForestClassifier(), D.random_forest_grid()),
+             (OpXGBoostClassifier(), D.xgboost_grid())]
+    F = 3
+    cv = OpCrossValidation(ev, num_folds=F, seed=42)
+    train_w, val_mask = cv.make_folds(len(y), None)
+    plan = build_sweep_plan(cands, np.ascontiguousarray(X, np.float32), y,
+                            train_w, ev)
+    if plan is None:
+        print("default grid did not build a fused plan; nothing to profile")
+        return
+    shards = partition_spec(plan.spec, plan.blob, n_shards, plan.n_rows,
+                            plan.n_features, F)
+    mx, mean = predicted_balance(shards)
+    print(f"shards={len(shards)} predicted max/mean={mx / max(mean, 1e-9):.3f}")
+    tw = np.asarray(train_w, np.float32)
+    vw = np.asarray(val_mask, np.float32)
+    walls = []
+    for i, sh in enumerate(shards):
+        # sequential, all on the default device: isolates per-shard COST
+        # (the thing the partitioner predicts) from device contention
+        out = run_sweep(sh.spec, plan.X, plan.xbs, plan.y, tw, vw, sh.blob)
+        np.asarray(out)  # warm (compile)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            np.asarray(run_sweep(sh.spec, plan.X, plan.xbs, plan.y, tw, vw,
+                                 sh.blob))
+        walls.append((time.perf_counter() - t0) / reps)
+    wmean = float(np.mean(walls))
+    print(f"{'shard':>5s} {'cands':>5s} {'predicted':>12s} {'pred/mean':>9s} "
+          f"{'measured_s':>10s} {'meas/mean':>9s}")
+    for i, (sh, w) in enumerate(zip(shards, walls)):
+        print(f"{i:5d} {sh.n_candidates:5d} {sh.cost:12.3e} "
+              f"{sh.cost / max(mean, 1e-9):9.3f} {w:10.4f} "
+              f"{w / max(wmean, 1e-9):9.3f}")
+    print(f"measured max/mean={max(walls) / max(wmean, 1e-9):.3f}")
+
+
+if args.shards > 0:
+    profile_shards(args.shards)
+    sys.exit(0)
 
 rf = D.random_forest_grid()
 by_depth = {}

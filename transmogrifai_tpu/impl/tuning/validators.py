@@ -210,25 +210,32 @@ class OpValidator:
         upload, and metric pull — tens of ms each over a tunneled backend;
         the fused program costs one upload + one launch + one [F, C, M]
         metrics pull regardless of grid size.  Disable with
-        TMOG_FUSED_SWEEP=0.  Multi-device meshes keep the legacy path, which
-        shards the candidate axis (parallel/mesh.shard_candidates).
+        TMOG_FUSED_SWEEP=0.  Under a multi-device mesh the spec is
+        partitioned over the ``model``-axis devices by predicted cost
+        (parallel/spec_partition), one fused program per device, dispatched
+        asynchronously and gathered (SweepPlan.run_sharded).
         """
         import os
 
+        from ...ops import sweep as sweep_ops
+        from ...parallel.mesh import model_devices, model_shards
+
         if os.environ.get("TMOG_FUSED_SWEEP", "1") == "0":
             return False
-        from ...parallel.mesh import model_shards
-
-        if model_shards() > 1:
-            return False
+        n_shards = max(model_shards(), 1)
+        sweep_ops.reset_run_stats()
         try:
             from ..sweep_fragments import build_sweep_plan
 
             # HBM guard: one monolithic program holding every family's
             # workspaces plus the [F, C, n] score block crashed the worker at
             # 450k x 64 candidates (round-5) — bound the per-launch score
-            # bytes and run the sweep as a few candidate-chunk launches
+            # bytes and run the sweep as a few candidate-chunk launches.
+            # The budget is PER SHARD: each device holds only its sub-spec's
+            # [F, C_s, n] block, so k shards fit a k-times-bigger grid per
+            # launch.
             budget = float(os.environ.get("TMOG_FUSED_SCORES_BYTES", 3e8))
+            budget *= n_shards
             per_cand = train_w.shape[0] * len(y) * 4.0
             inner_ev = getattr(self.evaluator, "inner", self.evaluator)
             if "Multi" in type(inner_ev).__name__:  # [F, C, n, k] scores
@@ -249,8 +256,14 @@ class OpValidator:
             log.warning("fused sweep build failed (%s); per-family path", e)
             return False
         try:
-            metrics = np.concatenate([p.run(train_w, val_mask) for p in plans],
-                                     axis=1)
+            if n_shards > 1:
+                devs = model_devices()
+                metrics = np.concatenate(
+                    [p.run_sharded(train_w, val_mask, devs) for p in plans],
+                    axis=1)
+            else:
+                metrics = np.concatenate(
+                    [p.run(train_w, val_mask) for p in plans], axis=1)
             plan = plans[0]
         except Exception as e:
             log.warning("fused sweep run failed (%s); per-family path", e)

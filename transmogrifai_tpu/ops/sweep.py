@@ -48,7 +48,10 @@ REGRESSION_METRICS).
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -56,14 +59,15 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..utils import flops
+from ..utils import devcache, flops
 from . import linear as L
 from . import trees as Tr
 from .metrics import (BINARY_METRICS, MULTICLASS_METRICS, REGRESSION_METRICS,
                       _binary_grid_metrics, _multiclass_grid_metrics,
                       _regression_grid_metrics)
 
-__all__ = ["run_sweep", "BINARY_METRICS", "MULTICLASS_METRICS",
+__all__ = ["run_sweep", "run_sweep_partitioned", "reset_run_stats",
+           "run_stats", "BINARY_METRICS", "MULTICLASS_METRICS",
            "REGRESSION_METRICS"]
 
 
@@ -352,7 +356,10 @@ def run_sweep(spec, X, xbs: Tuple, y, train_w, val_w, blob):
     n = int(np.asarray(y).shape[0] if not hasattr(y, "shape") else y.shape[0])
     F = train_w.shape[0]
     k = spec[0][1] if isinstance(spec[0], tuple) else 1
-    if F * C * n * k > SPLIT_METRICS_ELEMS:
+    split = F * C * n * k > SPLIT_METRICS_ELEMS
+    _run_stats["launches"].append(
+        {"shards": 1, "candidates": C, "split": bool(split)})
+    if split:
         scores = _run_scores(spec, X, tuple(xbs), y, train_w, blob)
         out = _run_metrics(spec, y, scores, val_w)
         flops.record("sweep.run_scores", _run_scores, spec, X, tuple(xbs), y,
@@ -364,3 +371,153 @@ def run_sweep(spec, X, xbs: Tuple, y, train_w, val_w, blob):
     flops.record("sweep.run", _run, spec, X, tuple(xbs), y, train_w, val_w,
                  blob)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Multi-chip execution: one sub-spec program per mesh ``model`` device
+# ---------------------------------------------------------------------------
+#: sweep launch telemetry since the last ``reset_run_stats`` — one entry per
+#: ``run_sweep`` ({"shards": 1, ...}) / ``run_sweep_partitioned`` call
+#: ({"shards": k, "per_shard": [...], ...}); the bench and the multichip
+#: dryrun read it to report ``sweep_shards`` + per-shard wall/compile times.
+_run_stats: Dict[str, List[Dict[str, Any]]] = {"launches": []}
+
+#: per-(name, spec, device, arg-signature) AOT executables.  jit's own cache
+#: would recompile nothing either, but going through ``.lower().compile()``
+#: explicitly (a) lets the thread pool compile the per-shard programs
+#: CONCURRENTLY — the warmup is one compile's wall, not the sum (the 8.1 s
+#: single-chip warmup of BENCH_r05 was the sum of fragment compiles) — and
+#: (b) gives an executable whose ``cost_analysis`` flops.record_compiled can
+#: read without re-lowering.
+_aot_cache: Dict[Tuple, Any] = {}
+_aot_lock = threading.Lock()
+
+
+def reset_run_stats() -> None:
+    _run_stats["launches"] = []
+
+
+def run_stats() -> Dict[str, Any]:
+    """Aggregate view of launches since the last reset (host-side stats)."""
+    launches = [dict(e) for e in _run_stats["launches"]]
+    return {"launches": launches,
+            "sweep_shards": max((e["shards"] for e in launches), default=0)}
+
+
+def _aot(name: str, fn, spec, device, dyn_args) -> Tuple[Any, float]:
+    """AOT executable of ``fn`` for ``spec`` at these (device-committed)
+    arguments + compile seconds (0.0 on cache hit).  All ``dyn_args`` must be
+    committed to ``device`` so lowering bakes the placement in."""
+    key = (name, spec, device, flops._signature(dyn_args, {}))
+    with _aot_lock:
+        hit = _aot_cache.get(key)
+    if hit is not None:
+        return hit, 0.0
+    t0 = time.perf_counter()
+    compiled = fn.lower(spec, *dyn_args).compile()
+    dt = time.perf_counter() - t0
+    with _aot_lock:
+        # a racing thread may have compiled the same key; keep the first
+        hit = _aot_cache.setdefault(key, compiled)
+    return hit, dt
+
+
+def _shard_arrays(shard, dev, X, xbs, y, X_host, y_host, xb_bins):
+    """Per-device copies of the shard's static arrays.
+
+    With host identities available the copies go through utils.devcache
+    (keyed per device), so repeated sweeps on the same dataset re-upload
+    nothing; the binned matrices are a deterministic function of
+    (X identity, n_bins), which is exactly their cache key.
+    """
+    if X_host is not None:
+        Xd = devcache.device_array(X_host, np.float32, device=dev)
+    else:
+        Xd = jax.device_put(X, dev)
+    if y_host is not None:
+        yd = devcache.device_array(y_host, np.float32, device=dev)
+    else:
+        yd = jax.device_put(y, dev)
+    xbs_d = []
+    for i, xb in enumerate(xbs):
+        if X_host is not None and xb_bins is not None:
+            xbs_d.append(devcache.derived(
+                X_host, ("sweep_xb_dev", int(xb_bins[i]), str(dev)),
+                lambda xb=xb: jax.device_put(xb, dev)))
+        else:
+            xbs_d.append(jax.device_put(xb, dev))
+    return Xd, tuple(xbs_d), yd
+
+
+def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
+                          n_candidates: int, devices,
+                          X_host: Optional[np.ndarray] = None,
+                          y_host: Optional[np.ndarray] = None,
+                          xb_bins: Optional[Tuple[int, ...]] = None
+                          ) -> np.ndarray:
+    """Execute cost-balanced sub-spec programs, one per device, and gather.
+
+    ``shards`` are ``parallel.spec_partition.ShardSpec``s (shard ``i`` runs
+    on ``devices[i]``).  Each worker thread AOT-compiles its shard's program
+    (concurrently — distinct cache keys never serialize on the lock) and
+    dispatches it; JAX async dispatch overlaps execution across distinct
+    devices with no SPMD constraint, so the heterogeneous per-shard fragment
+    mixes are fine.  Each shard applies the ``SPLIT_METRICS_ELEMS``
+    two-launch split to its OWN candidate count.  Returns host metrics
+    [F, n_candidates, M] in the GLOBAL candidate order.
+    """
+    F = int(train_w.shape[0])
+    n = int(X_host.shape[0]) if X_host is not None else int(X.shape[0])
+    k = shards[0].spec[0][1] if isinstance(shards[0].spec[0], tuple) else 1
+    t_all = time.perf_counter()
+
+    def worker(shard, dev):
+        t0 = time.perf_counter()
+        Xd, xbs_d, yd = _shard_arrays(shard, dev, X, xbs, y,
+                                      X_host, y_host, xb_bins)
+        tw = jax.device_put(jnp.asarray(train_w), dev)
+        vw = jax.device_put(jnp.asarray(val_w), dev)
+        bl = jax.device_put(jnp.asarray(shard.blob), dev)
+        C_s = len(shard.cis)
+        split = F * C_s * n * k > SPLIT_METRICS_ELEMS
+        records = []
+        if split:
+            args_s = (Xd, xbs_d, yd, tw, bl)
+            cs, dt_s = _aot("sweep.run_scores", _run_scores, shard.spec,
+                            dev, args_s)
+            scores = cs(*args_s)
+            args_m = (yd, scores, vw)
+            cm, dt_m = _aot("sweep.run_metrics", _run_metrics, shard.spec,
+                            dev, args_m)
+            out = cm(*args_m)
+            compile_s = dt_s + dt_m
+            records = [("sweep.run_scores", cs, args_s),
+                       ("sweep.run_metrics", cm, args_m)]
+        else:
+            args = (Xd, xbs_d, yd, tw, vw, bl)
+            c, compile_s = _aot("sweep.run", _run, shard.spec, dev, args)
+            out = c(*args)
+            records = [("sweep.run", c, args)]
+        # block in THIS thread only: other shards keep dispatching/running
+        out = np.asarray(out)
+        return out, {"device": str(dev), "candidates": C_s,
+                     "predicted_cost": float(shard.cost),
+                     "compile_s": round(compile_s, 4), "split": bool(split),
+                     "wall_s": round(time.perf_counter() - t0, 4)}, records
+
+    with ThreadPoolExecutor(max_workers=len(shards)) as pool:
+        results = list(pool.map(worker, shards, devices))
+
+    M = results[0][0].shape[-1]
+    metrics = np.zeros((F, n_candidates, M), np.float32)
+    per_shard = []
+    for (out, stat, records), shard, dev in zip(results, shards, devices):
+        metrics[:, np.asarray(shard.cis, np.int64), :] = out
+        per_shard.append(stat)
+        for name, compiled, args in records:
+            flops.record_compiled(name, compiled, args, device=dev)
+    _run_stats["launches"].append(
+        {"shards": len(shards), "candidates": int(n_candidates),
+         "wall_s": round(time.perf_counter() - t_all, 4),
+         "per_shard": per_shard})
+    return metrics

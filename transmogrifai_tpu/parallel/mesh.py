@@ -58,6 +58,22 @@ def model_shards() -> int:
     return int(m.shape[MODEL_AXIS]) if m is not None else 1
 
 
+def model_devices(mesh: Optional[Mesh] = None) -> list:
+    """The devices along the ``model`` axis of ``mesh`` (default: the active
+    mesh) — one per candidate shard of the partitioned fused sweep.  Taken at
+    data-row 0: the fused sweep replicates rows, so each model shard runs on
+    exactly one device and any extra ``data``-axis rows are unused by it.
+    Falls back to the first local device when no mesh is active."""
+    m = mesh if mesh is not None else _ACTIVE_MESH
+    if m is None:
+        return [jax.devices()[0]]
+    grid = np.asarray(m.devices)
+    ax = list(m.axis_names).index(MODEL_AXIS)
+    index = [0] * grid.ndim
+    index[ax] = slice(None)
+    return list(grid[tuple(index)])
+
+
 def auto_mesh() -> Optional[Mesh]:
     """All local devices on the ``model`` axis (the OpValidator default) —
     the TPU replacement for the reference's 8-thread sweep pool

@@ -80,24 +80,30 @@ def _slot(arr: np.ndarray) -> Optional[Dict[Any, Any]]:
     return products
 
 
-def device_array(arr, dtype=None, tag: str = "base"):
+def device_array(arr, dtype=None, tag: str = "base", device=None):
     """Device-resident copy of ``arr`` (cached by host-array identity).
 
     Already-on-device jax arrays pass through untouched.  ``tag`` separates
     derived variants (e.g. different dtypes) of the same host array.
+    ``device`` pins the copy to a specific ``jax.Device`` (cached per device)
+    — the multi-chip sweep uses this to keep one resident X/y per shard.
     """
+    import jax
     import jax.numpy as jnp
 
     def build():
-        return jnp.asarray(arr) if dtype is None \
+        a = jnp.asarray(arr) if dtype is None \
             else jnp.asarray(np.asarray(arr, dtype))
+        return a if device is None else jax.device_put(a, device)
 
     if not isinstance(arr, np.ndarray):  # jax array (or scalar): no caching
-        return jnp.asarray(arr) if dtype is None else jnp.asarray(arr, dtype)
+        a = jnp.asarray(arr) if dtype is None else jnp.asarray(arr, dtype)
+        return a if device is None else jax.device_put(a, device)
     products = _slot(arr)
     if products is None:
         return build()
-    key = (tag, None if dtype is None else np.dtype(dtype).str)
+    key = (tag, None if dtype is None else np.dtype(dtype).str,
+           None if device is None else str(device))
     dev = products.get(key)
     if dev is None:
         dev = build()

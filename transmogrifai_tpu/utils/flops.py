@@ -30,7 +30,8 @@ import jax
 
 _enabled: bool = bool(int(os.environ.get("TMOG_COUNT_FLOPS", "0") or 0))
 _totals: Dict[str, float] = {"flops": 0.0, "bytes_accessed": 0.0, "calls": 0.0}
-_by_fn: Dict[str, Dict[str, float]] = {}
+_by_fn: Dict[str, Dict[str, Any]] = {}
+_by_device: Dict[str, Dict[str, float]] = {}
 _cost_cache: Dict[Tuple, Optional[Dict[str, float]]] = {}
 
 
@@ -51,12 +52,25 @@ def enabled() -> bool:
 def reset() -> None:
     _totals.update(flops=0.0, bytes_accessed=0.0, calls=0.0)
     _by_fn.clear()
+    _by_device.clear()
 
 
 def totals() -> Dict[str, Any]:
-    """{"flops": total, "bytes_accessed": total, "calls": n, "by_fn": {...}}"""
+    """{"flops", "bytes_accessed", "calls", "by_fn": {...}, "by_device": {...}}
+
+    Each ``by_fn`` entry carries a ``by_shape`` sub-dict mapping a compact
+    shape signature -> {"flops", "calls"}, so a kernel recorded once per
+    shard/per chunk under DIFFERENT shapes (the partitioned sweep does
+    exactly this) stays auditable: sum of by_shape calls == entry calls.
+    ``by_device`` splits the same totals by the device label the caller
+    attributed the launch to (multi-chip runs; empty on unattributed runs).
+    """
     out: Dict[str, Any] = dict(_totals)
-    out["by_fn"] = {k: dict(v) for k, v in _by_fn.items()}
+    out["by_fn"] = {
+        k: {"flops": v["flops"], "calls": v["calls"],
+            "by_shape": {s: dict(c) for s, c in v["by_shape"].items()}}
+        for k, v in _by_fn.items()}
+    out["by_device"] = {k: dict(v) for k, v in _by_device.items()}
     return out
 
 
@@ -70,6 +84,39 @@ def _signature(args, kwargs) -> Tuple:
         else:
             sig.append(("s", repr(leaf)))
     return (str(treedef), tuple(sig))
+
+
+def _shape_key(args, kwargs) -> str:
+    """Compact human-auditable shape signature, e.g. "(240,20)|(240,)|s3"."""
+    leaves, _ = jax.tree.flatten((args, kwargs))
+    parts = []
+    n_static = 0
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        if shape is not None:
+            parts.append("(" + ",".join(str(s) for s in shape) + ")")
+        else:
+            n_static += 1
+    if n_static:
+        parts.append(f"s{n_static}")
+    return "|".join(parts)
+
+
+def _accumulate(name: str, cost: Dict[str, float], shape_key: str,
+                device: Optional[str]) -> None:
+    _totals["flops"] += cost["flops"]
+    _totals["bytes_accessed"] += cost["bytes_accessed"]
+    _totals["calls"] += 1
+    agg = _by_fn.setdefault(name, {"flops": 0.0, "calls": 0.0, "by_shape": {}})
+    agg["flops"] += cost["flops"]
+    agg["calls"] += 1
+    sh = agg["by_shape"].setdefault(shape_key, {"flops": 0.0, "calls": 0.0})
+    sh["flops"] += cost["flops"]
+    sh["calls"] += 1
+    if device is not None:
+        dv = _by_device.setdefault(str(device), {"flops": 0.0, "calls": 0.0})
+        dv["flops"] += cost["flops"]
+        dv["calls"] += 1
 
 
 def _cost(fn, args, kwargs) -> Optional[Dict[str, float]]:
@@ -114,9 +161,41 @@ def record(name: str, fn, *args, **kwargs) -> None:
     cost = _cost_cache[key]
     if cost is None:
         return
-    _totals["flops"] += cost["flops"]
-    _totals["bytes_accessed"] += cost["bytes_accessed"]
-    _totals["calls"] += 1
-    agg = _by_fn.setdefault(name, {"flops": 0.0, "calls": 0.0})
-    agg["flops"] += cost["flops"]
-    agg["calls"] += 1
+    _accumulate(name, cost, _shape_key(args, kwargs), None)
+
+
+def record_device(name: str, device, fn, *args, **kwargs) -> None:
+    """:func:`record`, attributing the call to ``device`` in ``by_device``."""
+    if not _enabled:
+        return
+    key = (name, _signature(args, kwargs))
+    if key not in _cost_cache:
+        _cost_cache[key] = _cost(fn, args, kwargs)
+    cost = _cost_cache[key]
+    if cost is None:
+        return
+    _accumulate(name, cost, _shape_key(args, kwargs), str(device))
+
+
+def record_compiled(name: str, compiled, args: Tuple, device=None) -> None:
+    """Accumulate ONE call of an already-AOT-compiled executable.
+
+    The multi-chip sweep compiles its per-shard programs itself (concurrent
+    AOT, ops/sweep.py) — re-lowering them here just to read a cost would
+    double every shard's compile, so this variant reads ``cost_analysis()``
+    straight off the executable.  ``args`` are the call's dynamic arguments
+    (shape-signature bookkeeping only).
+    """
+    if not _enabled:
+        return
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):  # older jax returns [dict]
+            ca = ca[0] if ca else {}
+        cost = {"flops": float(ca.get("flops", 0.0)),
+                "bytes_accessed": float(ca.get("bytes accessed",
+                                               ca.get("bytes_accessed", 0.0)))}
+    except Exception:
+        return
+    _accumulate(name, cost, _shape_key(args, {}),
+                None if device is None else str(device))
