@@ -242,11 +242,35 @@ def main():
         "warmup_s": round(warm, 2),
         "steady_s": round(dt, 2),
         "sweep_shards": sweep_stats["sweep_shards"],
+        "data_shards": sweep_stats["data_shards"],
     }
     per_shard = [s for l in sweep_stats["launches"] if l["shards"] > 1
                  for s in l["per_shard"]]
     if per_shard:
         out["sweep_per_shard"] = per_shard
+    # row-sharded launches: per-axis collective traffic + the memory story
+    # (peak per-device X/y bytes vs what full replication would have held)
+    coll_axes = {}
+    for l in sweep_stats["launches"]:
+        for ax, c in (l.get("collectives") or {}).items():
+            agg = coll_axes.setdefault(ax, {"count": 0, "bytes": 0})
+            agg["count"] += c["count"]
+            agg["bytes"] += c["bytes"]
+    if coll_axes:
+        out["collective_bytes_by_axis"] = coll_axes
+    pdb = next((l["per_device_bytes"] for l in reversed(sweep_stats["launches"])
+                if l.get("rowsharded")), None)
+    if pdb:
+        out["per_device_bytes"] = pdb
+        out["per_device_bytes_vs_replicated"] = round(
+            (pdb["X"] + pdb["y"]) / max(pdb["X_replicated"] + pdb["y_replicated"], 1), 4)
+    # per-rep collective accounting from the flops bucket (count + bytes per
+    # axis, psum/all_gather split) — the communication half of MFU honesty
+    if acct.get("collectives"):
+        out["collectives_per_rep"] = {
+            ax: {k: (round(v / reps) if isinstance(v, (int, float)) else v)
+                 for k, v in c.items()}
+            for ax, c in acct["collectives"].items()}
     if acct.get("by_device"):
         out["flops_by_device"] = {k: round(v["flops"] / reps)
                                   for k, v in acct["by_device"].items()}

@@ -130,6 +130,29 @@ class SweepPlan:
             len(self.spec[2]), devices[:len(shards)],
             X_host=self.X_host, y_host=self.y_host, xb_bins=self.xb_bins)
 
+    def run_rowsharded(self, train_w: np.ndarray, val_mask: np.ndarray,
+                       mesh) -> np.ndarray:
+        """Execute on a 2-D (data, model) mesh: the spec is cost-partitioned
+        over the model axis exactly as :meth:`run_sharded` partitions it over
+        devices, and each sub-spec program runs row-sharded over its model
+        column's data-axis devices (one row shard per chip, psum'd
+        reductions).  A 1-wide model axis degenerates to one row-sharded
+        program over the whole spec."""
+        from ..ops.sweep import run_sweep_rowsharded
+        from ..parallel.mesh import MODEL_AXIS
+        from ..parallel.spec_partition import partition_spec
+
+        n_model = int(mesh.shape[MODEL_AXIS])
+        shards = partition_spec(self.spec, self.blob, n_model,
+                                self.n_rows, self.n_features,
+                                int(train_w.shape[0]))
+        return run_sweep_rowsharded(
+            shards, self.X, self.xbs, self.y,
+            np.asarray(train_w, np.float32),
+            np.asarray(val_mask, np.float32),
+            len(self.spec[2]), mesh,
+            X_host=self.X_host, y_host=self.y_host, xb_bins=self.xb_bins)
+
 
 # ---------------------------------------------------------------------------
 # Per-fragment cost model + candidate-granular split(cis)

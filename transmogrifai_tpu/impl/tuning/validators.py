@@ -110,10 +110,13 @@ class OpValidator:
         self.mesh = mesh
 
     def _resolve_mesh(self):
-        from ...parallel.mesh import auto_mesh
+        from ...parallel.mesh import auto_mesh, env_mesh
 
         if isinstance(self.mesh, str) and self.mesh == "auto":
-            return auto_mesh()
+            # TMOG_MESH ("2x4" = data x model) overrides the all-model-axis
+            # default; unset/unsatisfiable requests fall through to auto
+            m = env_mesh()
+            return m if m is not None else auto_mesh()
         return self.mesh
 
     # ---- folds -------------------------------------------------------------
@@ -213,17 +216,32 @@ class OpValidator:
         TMOG_FUSED_SWEEP=0.  Under a multi-device mesh the spec is
         partitioned over the ``model``-axis devices by predicted cost
         (parallel/spec_partition), one fused program per device, dispatched
-        asynchronously and gathered (SweepPlan.run_sharded).
+        asynchronously and gathered (SweepPlan.run_sharded).  When the mesh
+        also has a ``data`` axis > 1 and the row count clears the per-shard
+        floor, each model column's program additionally runs ROW-SHARDED
+        over its column devices (SweepPlan.run_rowsharded) — otherwise the
+        launch degrades to the replicated path and records why in
+        ``ops.sweep.run_stats()['fallbacks']``.
         """
         import os
 
         from ...ops import sweep as sweep_ops
-        from ...parallel.mesh import model_devices, model_shards
+        from ...parallel.mesh import (active_mesh, data_shards,
+                                      min_rows_per_shard, model_devices,
+                                      model_shards, rowshard_viable)
 
         if os.environ.get("TMOG_FUSED_SWEEP", "1") == "0":
             return False
         n_shards = max(model_shards(), 1)
+        n_data = max(data_shards(), 1)
         sweep_ops.reset_run_stats()
+        rowsharded = n_data > 1
+        if rowsharded and not rowshard_viable(len(y), n_data):
+            sweep_ops.record_fallback(
+                "too_few_rows_for_data_axis", rows=len(y),
+                data_shards=n_data,
+                min_rows_per_shard=min_rows_per_shard())
+            rowsharded = False
         try:
             from ..sweep_fragments import build_sweep_plan
 
@@ -233,10 +251,12 @@ class OpValidator:
             # bytes and run the sweep as a few candidate-chunk launches.
             # The budget is PER SHARD: each device holds only its sub-spec's
             # [F, C_s, n] block, so k shards fit a k-times-bigger grid per
-            # launch.
+            # launch.  Row-sharded, each device further holds only
+            # rows/data_shards of that block.
             budget = float(os.environ.get("TMOG_FUSED_SCORES_BYTES", 3e8))
             budget *= n_shards
-            per_cand = train_w.shape[0] * len(y) * 4.0
+            rows_local = -(-len(y) // n_data) if rowsharded else len(y)
+            per_cand = train_w.shape[0] * rows_local * 4.0
             inner_ev = getattr(self.evaluator, "inner", self.evaluator)
             if "Multi" in type(inner_ev).__name__:  # [F, C, n, k] scores
                 per_cand *= max(int(np.max(np.asarray(y))) + 1, 2)
@@ -250,13 +270,25 @@ class OpValidator:
             for chunk in chunks:
                 plan = build_sweep_plan(chunk, X, y, train_w, self.evaluator)
                 if plan is None:
+                    if n_data > 1:
+                        # a custom estimator (or unsupported grid) blocks
+                        # fusion entirely — the data axis sits idle and the
+                        # per-family path runs replicated; auditable, not
+                        # fatal
+                        sweep_ops.record_fallback(
+                            "unfusable_candidates_block_data_axis")
                     return False
                 plans.append(plan)
         except Exception as e:
             log.warning("fused sweep build failed (%s); per-family path", e)
             return False
         try:
-            if n_shards > 1:
+            if rowsharded:
+                mesh = active_mesh()
+                metrics = np.concatenate(
+                    [p.run_rowsharded(train_w, val_mask, mesh)
+                     for p in plans], axis=1)
+            elif n_shards > 1:
                 devs = model_devices()
                 metrics = np.concatenate(
                     [p.run_sharded(train_w, val_mask, devs) for p in plans],

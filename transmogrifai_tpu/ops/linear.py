@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..parallel.mesh import mesh_psum
+
 
 class LinearFit(NamedTuple):
     """Fitted linear parameters: coefficients [d, k] and intercept [k]."""
@@ -46,9 +48,11 @@ def _soft_threshold(x, thr):
 # Reference analog: OpLogisticRegression (impl/classification/OpLogisticRegression.scala)
 # wrapping Spark's LogisticRegression (regParam, elasticNetParam, maxIter, tol).
 # ---------------------------------------------------------------------------
-@functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept"))
+@functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept",
+                                             "axis_name"))
 def fit_logistic_newton(X, y, sample_weight, l2, max_iter: int = 25,
-                        fit_intercept: bool = True) -> LinearFit:
+                        fit_intercept: bool = True,
+                        axis_name: Optional[str] = None) -> LinearFit:
     """Weighted binary logistic regression with L2, full-batch Newton.
 
     X: f32[n, d]; y: f32[n] in {0, 1}; sample_weight: f32[n]; l2: scalar
@@ -57,11 +61,16 @@ def fit_logistic_newton(X, y, sample_weight, l2, max_iter: int = 25,
     Iteration count is fixed (static shape for vmap across a grid); there is
     deliberately no data-dependent convergence break — Newton on these convex
     objectives converges well inside ``max_iter``.
+
+    With ``axis_name`` set (row-sharded launch under shard_map) the rows of
+    X/y/sample_weight are one data shard and every cross-row reduction —
+    weight total, gradient, Hessian — is a psum over that axis, so each step
+    solves the GLOBAL normal equations while touching only local rows.
     """
     n, d = X.shape
     X1 = jnp.concatenate([X, jnp.ones((n, 1), X.dtype)], axis=1) if fit_intercept else X
     p = X1.shape[1]
-    w_sum = jnp.maximum(sample_weight.sum(), 1e-12)
+    w_sum = jnp.maximum(mesh_psum(sample_weight.sum(), axis_name), 1e-12)
 
     reg = jnp.full((p,), l2, X.dtype)
     if fit_intercept:
@@ -71,8 +80,9 @@ def fit_logistic_newton(X, y, sample_weight, l2, max_iter: int = 25,
         z = X1 @ beta
         mu = jax.nn.sigmoid(z)
         wvar = jnp.maximum(mu * (1.0 - mu), 1e-6) * sample_weight
-        grad = X1.T @ (sample_weight * (mu - y)) / w_sum + reg * beta
-        H = (X1.T * wvar) @ X1 / w_sum + jnp.diag(reg) + 1e-8 * jnp.eye(p, dtype=X.dtype)
+        grad = mesh_psum(X1.T @ (sample_weight * (mu - y)), axis_name) / w_sum + reg * beta
+        H = (mesh_psum((X1.T * wvar) @ X1, axis_name) / w_sum + jnp.diag(reg)
+             + 1e-8 * jnp.eye(p, dtype=X.dtype))
         delta = jnp.linalg.solve(H, grad)
         return beta - delta, None
 
@@ -83,16 +93,18 @@ def fit_logistic_newton(X, y, sample_weight, l2, max_iter: int = 25,
     return LinearFit(coef=beta, intercept=jnp.zeros((1,), X.dtype))
 
 
-def _logistic_loss_grad(beta, X1, y, sample_weight, l2_vec, w_sum):
+def _logistic_loss_grad(beta, X1, y, sample_weight, l2_vec, w_sum, axis_name):
     z = X1 @ beta
     mu = jax.nn.sigmoid(z)
-    grad = X1.T @ (sample_weight * (mu - y)) / w_sum + l2_vec * beta
+    grad = mesh_psum(X1.T @ (sample_weight * (mu - y)), axis_name) / w_sum + l2_vec * beta
     return grad
 
 
-@functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept"))
+@functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept",
+                                             "axis_name"))
 def fit_logistic_fista(X, y, sample_weight, l1, l2, max_iter: int = 200,
-                       fit_intercept: bool = True) -> LinearFit:
+                       fit_intercept: bool = True,
+                       axis_name: Optional[str] = None) -> LinearFit:
     """Elastic-net logistic regression via FISTA proximal gradient.
 
     Matches Spark's (regParam, elasticNetParam) parameterization when called
@@ -101,19 +113,20 @@ def fit_logistic_fista(X, y, sample_weight, l1, l2, max_iter: int = 200,
     n, d = X.shape
     X1 = jnp.concatenate([X, jnp.ones((n, 1), X.dtype)], axis=1) if fit_intercept else X
     p = X1.shape[1]
-    w_sum = jnp.maximum(sample_weight.sum(), 1e-12)
+    w_sum = jnp.maximum(mesh_psum(sample_weight.sum(), axis_name), 1e-12)
     l2_vec = jnp.full((p,), l2, X.dtype)
     l1_vec = jnp.full((p,), l1, X.dtype)
     if fit_intercept:
         l2_vec = l2_vec.at[-1].set(0.0)
         l1_vec = l1_vec.at[-1].set(0.0)
     # Lipschitz bound for the logistic loss: ||X||^2/(4*w_sum) weighted
-    L = 0.25 * jnp.sum((X1 * X1).T * sample_weight) / w_sum + l2 + 1e-6
+    L = (0.25 * mesh_psum(jnp.sum((X1 * X1).T * sample_weight), axis_name) / w_sum
+         + l2 + 1e-6)
     step = 1.0 / L
 
     def body(carry, _):
         beta, z, t = carry
-        grad = _logistic_loss_grad(z, X1, y, sample_weight, l2_vec, w_sum)
+        grad = _logistic_loss_grad(z, X1, y, sample_weight, l2_vec, w_sum, axis_name)
         beta_next = _soft_threshold(z - step * grad, step * l1_vec)
         t_next = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
         z_next = beta_next + ((t - 1.0) / t_next) * (beta_next - beta)
@@ -130,29 +143,32 @@ def fit_logistic_fista(X, y, sample_weight, l1, l2, max_iter: int = 200,
 # ---------------------------------------------------------------------------
 # Multinomial softmax regression (multiclass LR)
 # ---------------------------------------------------------------------------
-@functools.partial(jax.jit, static_argnames=("num_classes", "max_iter", "fit_intercept"))
+@functools.partial(jax.jit, static_argnames=("num_classes", "max_iter",
+                                             "fit_intercept", "axis_name"))
 def fit_softmax(X, y, sample_weight, l2, num_classes: int, max_iter: int = 100,
-                fit_intercept: bool = True, l1=0.0) -> LinearFit:
+                fit_intercept: bool = True, l1=0.0,
+                axis_name: Optional[str] = None) -> LinearFit:
     """Weighted multinomial logistic regression, elastic net, accelerated
     proximal gradient (FISTA; soft-threshold prox handles the L1 term).
     """
     n, d = X.shape
     X1 = jnp.concatenate([X, jnp.ones((n, 1), X.dtype)], axis=1) if fit_intercept else X
     p = X1.shape[1]
-    w_sum = jnp.maximum(sample_weight.sum(), 1e-12)
+    w_sum = jnp.maximum(mesh_psum(sample_weight.sum(), axis_name), 1e-12)
     Y = jax.nn.one_hot(y.astype(jnp.int32), num_classes, dtype=X.dtype)
     l2m = jnp.full((p, num_classes), l2, X.dtype)
     l1m = jnp.full((p, num_classes), l1, X.dtype)
     if fit_intercept:
         l2m = l2m.at[-1, :].set(0.0)
         l1m = l1m.at[-1, :].set(0.0)
-    L = 0.5 * jnp.sum((X1 * X1).T * sample_weight) / w_sum + l2 + 1e-6
+    L = (0.5 * mesh_psum(jnp.sum((X1 * X1).T * sample_weight), axis_name) / w_sum
+         + l2 + 1e-6)
     step = 1.0 / L
 
     def grad_fn(B):
         z = X1 @ B
         mu = jax.nn.softmax(z, axis=-1)
-        return X1.T @ (sample_weight[:, None] * (mu - Y)) / w_sum + l2m * B
+        return mesh_psum(X1.T @ (sample_weight[:, None] * (mu - Y)), axis_name) / w_sum + l2m * B
 
     def body(carry, _):
         B, Z, t = carry
@@ -190,26 +206,28 @@ def fit_ridge(X, y, sample_weight, l2, fit_intercept: bool = True) -> LinearFit:
     return LinearFit(coef=beta, intercept=jnp.zeros((1,), X.dtype))
 
 
-@functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept"))
+@functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept",
+                                             "axis_name"))
 def fit_linear_fista(X, y, sample_weight, l1, l2, max_iter: int = 300,
-                     fit_intercept: bool = True) -> LinearFit:
+                     fit_intercept: bool = True,
+                     axis_name: Optional[str] = None) -> LinearFit:
     """Elastic-net linear regression via FISTA (lasso path analog)."""
     n, d = X.shape
     X1 = jnp.concatenate([X, jnp.ones((n, 1), X.dtype)], axis=1) if fit_intercept else X
     p = X1.shape[1]
-    w_sum = jnp.maximum(sample_weight.sum(), 1e-12)
+    w_sum = jnp.maximum(mesh_psum(sample_weight.sum(), axis_name), 1e-12)
     l2_vec = jnp.full((p,), l2, X.dtype)
     l1_vec = jnp.full((p,), l1, X.dtype)
     if fit_intercept:
         l2_vec = l2_vec.at[-1].set(0.0)
         l1_vec = l1_vec.at[-1].set(0.0)
     # Lipschitz: largest eigenvalue of weighted gram; bound by trace
-    L = jnp.sum((X1 * X1).T * sample_weight) / w_sum + l2 + 1e-6
+    L = mesh_psum(jnp.sum((X1 * X1).T * sample_weight), axis_name) / w_sum + l2 + 1e-6
     step = 1.0 / L
 
     def grad_fn(beta):
         r = X1 @ beta - y
-        return X1.T @ (sample_weight * r) / w_sum + l2_vec * beta
+        return mesh_psum(X1.T @ (sample_weight * r), axis_name) / w_sum + l2_vec * beta
 
     def body(carry, _):
         beta, z, t = carry
@@ -231,24 +249,27 @@ def fit_linear_fista(X, y, sample_weight, l1, l2, max_iter: int = 300,
 # Reference analog: OpLinearSVC wrapping Spark LinearSVC (hinge + OWLQN);
 # squared hinge is the standard smooth surrogate (liblinear L2-loss SVC).
 # ---------------------------------------------------------------------------
-@functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept"))
+@functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept",
+                                             "axis_name"))
 def fit_linear_svc(X, y, sample_weight, l2, max_iter: int = 200,
-                   fit_intercept: bool = True) -> LinearFit:
+                   fit_intercept: bool = True,
+                   axis_name: Optional[str] = None) -> LinearFit:
     n, d = X.shape
     X1 = jnp.concatenate([X, jnp.ones((n, 1), X.dtype)], axis=1) if fit_intercept else X
     p = X1.shape[1]
-    w_sum = jnp.maximum(sample_weight.sum(), 1e-12)
+    w_sum = jnp.maximum(mesh_psum(sample_weight.sum(), axis_name), 1e-12)
     ypm = 2.0 * y - 1.0  # {0,1} -> {-1,+1}
     l2_vec = jnp.full((p,), l2, X.dtype)
     if fit_intercept:
         l2_vec = l2_vec.at[-1].set(0.0)
-    L = 2.0 * jnp.sum((X1 * X1).T * sample_weight) / w_sum + l2 + 1e-6
+    L = (2.0 * mesh_psum(jnp.sum((X1 * X1).T * sample_weight), axis_name) / w_sum
+         + l2 + 1e-6)
     step = 1.0 / L
 
     def grad_fn(beta):
         m = 1.0 - ypm * (X1 @ beta)
         active = jnp.maximum(m, 0.0)
-        return X1.T @ (sample_weight * (-2.0 * ypm * active)) / w_sum + l2_vec * beta
+        return mesh_psum(X1.T @ (sample_weight * (-2.0 * ypm * active)), axis_name) / w_sum + l2_vec * beta
 
     def body(carry, _):
         beta, z, t = carry
@@ -353,15 +374,18 @@ def predict_glm(X, coef, intercept, link: str):
 # The reference trains this block as JVM-thread Futures (OpValidator.scala:299);
 # here it is one vmapped XLA program.
 # ---------------------------------------------------------------------------
-@functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept"))
+@functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept",
+                                             "axis_name"))
 def fit_logistic_grid_folds_newton(X, y, train_w, l2s, max_iter: int = 25,
-                                   fit_intercept: bool = True) -> LinearFit:
+                                   fit_intercept: bool = True,
+                                   axis_name: Optional[str] = None) -> LinearFit:
     """Pure-L2 logistic fits for every (fold, grid) pair via Newton — the
     same optimizer fit_arrays uses for l1=0, so sweep metrics match refits."""
 
     def fit(w, l2):
         return fit_logistic_newton(X, y, w, l2, max_iter=max_iter,
-                                   fit_intercept=fit_intercept)
+                                   fit_intercept=fit_intercept,
+                                   axis_name=axis_name)
 
     over_grid = jax.vmap(fit, in_axes=(None, 0))
     over_folds = jax.vmap(over_grid, in_axes=(0, None))
@@ -380,60 +404,74 @@ def fit_ridge_grid_folds(X, y, train_w, l2s, fit_intercept: bool = True) -> Line
     return over_folds(train_w, l2s)
 
 
-@functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept"))
+@functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept",
+                                             "axis_name"))
 def fit_logistic_grid_folds_fista(X, y, train_w, l1s, l2s, max_iter: int = 200,
-                                  fit_intercept: bool = True) -> LinearFit:
+                                  fit_intercept: bool = True,
+                                  axis_name: Optional[str] = None) -> LinearFit:
     """Elastic-net logistic fits for every (fold, grid) pair.
 
     X: f32[n, d]; y: f32[n]; train_w: f32[F, n]; l1s/l2s: f32[G].
     Returns LinearFit with coef [F, G, d], intercept [F, G, 1].
+    With ``axis_name``, rows are one data shard and the fits psum their
+    gradients/Gram blocks over that axis (see fit_logistic_newton).
     """
 
     def fit(w, l1, l2):
         return fit_logistic_fista(X, y, w, l1, l2, max_iter=max_iter,
-                                  fit_intercept=fit_intercept)
+                                  fit_intercept=fit_intercept,
+                                  axis_name=axis_name)
 
     over_grid = jax.vmap(fit, in_axes=(None, 0, 0))
     over_folds = jax.vmap(over_grid, in_axes=(0, None, None))
     return over_folds(train_w, l1s, l2s)
 
 
-@functools.partial(jax.jit, static_argnames=("num_classes", "max_iter", "fit_intercept"))
+@functools.partial(jax.jit, static_argnames=("num_classes", "max_iter",
+                                             "fit_intercept", "axis_name"))
 def fit_softmax_grid_folds(X, y, train_w, l1s, l2s, num_classes: int,
-                           max_iter: int = 100, fit_intercept: bool = True) -> LinearFit:
+                           max_iter: int = 100, fit_intercept: bool = True,
+                           axis_name: Optional[str] = None) -> LinearFit:
     """Softmax fits for every (fold, grid): coef [F, G, d, k], intercept [F, G, k]."""
 
     def fit(w, l1, l2):
         return fit_softmax(X, y, w, l2, num_classes=num_classes, max_iter=max_iter,
-                           fit_intercept=fit_intercept, l1=l1)
+                           fit_intercept=fit_intercept, l1=l1,
+                           axis_name=axis_name)
 
     over_grid = jax.vmap(fit, in_axes=(None, 0, 0))
     over_folds = jax.vmap(over_grid, in_axes=(0, None, None))
     return over_folds(train_w, l1s, l2s)
 
 
-@functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept"))
+@functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept",
+                                             "axis_name"))
 def fit_linear_grid_folds_fista(X, y, train_w, l1s, l2s, max_iter: int = 300,
-                                fit_intercept: bool = True) -> LinearFit:
+                                fit_intercept: bool = True,
+                                axis_name: Optional[str] = None) -> LinearFit:
     """Elastic-net linear-regression fits for every (fold, grid) pair."""
 
     def fit(w, l1, l2):
         return fit_linear_fista(X, y, w, l1, l2, max_iter=max_iter,
-                                fit_intercept=fit_intercept)
+                                fit_intercept=fit_intercept,
+                                axis_name=axis_name)
 
     over_grid = jax.vmap(fit, in_axes=(None, 0, 0))
     over_folds = jax.vmap(over_grid, in_axes=(0, None, None))
     return over_folds(train_w, l1s, l2s)
 
 
-@functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept"))
+@functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept",
+                                             "axis_name"))
 def fit_svc_grid_folds(X, y, train_w, l2s, max_iter: int = 200,
-                       fit_intercept: bool = True) -> LinearFit:
+                       fit_intercept: bool = True,
+                       axis_name: Optional[str] = None) -> LinearFit:
     """Squared-hinge SVC fits for every (fold, grid) pair."""
 
     def fit(w, l2):
         return fit_linear_svc(X, y, w, l2, max_iter=max_iter,
-                              fit_intercept=fit_intercept)
+                              fit_intercept=fit_intercept,
+                              axis_name=axis_name)
 
     over_grid = jax.vmap(fit, in_axes=(None, 0))
     over_folds = jax.vmap(over_grid, in_axes=(0, None))

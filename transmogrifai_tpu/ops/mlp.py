@@ -8,11 +8,13 @@ are static so the whole fit is one compiled program of dense matmuls (MXU).
 from __future__ import annotations
 
 import functools
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from ..parallel.mesh import mesh_psum
 
 
 def init_params(key, layers: Sequence[int]):
@@ -36,13 +38,19 @@ def forward(params, X):
     return h @ W + b
 
 
-@functools.partial(jax.jit, static_argnames=("layers", "max_iter"))
+@functools.partial(jax.jit, static_argnames=("layers", "max_iter", "axis_name"))
 def fit_mlp(X, y, sample_weight, layers: Tuple[int, ...], max_iter: int = 100,
-            lr: float = 0.03, seed: int = 0):
-    """Softmax cross-entropy MLP fit; returns the parameter pytree."""
+            lr: float = 0.03, seed: int = 0,
+            axis_name: Optional[str] = None):
+    """Softmax cross-entropy MLP fit; returns the parameter pytree.
+
+    With ``axis_name`` (row-sharded launch under shard_map) X/y/sample_weight
+    hold one data shard; init is seed-only so parameters start replicated,
+    and psum of the per-shard loss gradient keeps every shard's Adam
+    trajectory identical to the full-batch fit."""
     k = layers[-1]
     Y = jax.nn.one_hot(y.astype(jnp.int32), k, dtype=jnp.float32)
-    w_sum = jnp.maximum(sample_weight.sum(), 1e-12)
+    w_sum = jnp.maximum(mesh_psum(sample_weight.sum(), axis_name), 1e-12)
     params = init_params(jax.random.PRNGKey(seed), layers)
 
     def loss_fn(p):
@@ -55,7 +63,7 @@ def fit_mlp(X, y, sample_weight, layers: Tuple[int, ...], max_iter: int = 100,
 
     def step(carry, i):
         p, m, v = carry
-        g = grad_fn(p)
+        g = jax.tree.map(lambda a: mesh_psum(a, axis_name), grad_fn(p))
         m = jax.tree.map(lambda a, b: 0.9 * a + 0.1 * b, m, g)
         v = jax.tree.map(lambda a, b: 0.999 * a + 0.001 * (b * b), v, g)
         t = i.astype(jnp.float32) + 1.0
@@ -78,16 +86,18 @@ def predict_mlp(params, X):
     return z, prob, pred
 
 
-@functools.partial(jax.jit, static_argnames=("layers", "max_iter"))
+@functools.partial(jax.jit, static_argnames=("layers", "max_iter", "axis_name"))
 def fit_mlp_grid_folds(X, y, train_w, lrs, seeds, layers: Tuple[int, ...],
-                       max_iter: int = 100):
+                       max_iter: int = 100,
+                       axis_name: Optional[str] = None):
     """MLP fits for every (fold, grid) pair in ONE launch — the OpValidator
     thread-pool analog for the MLP (one static (layers, max_iter) group per
     launch; lrs f32[G], seeds i32[G] are the dynamic grid axes)."""
 
     def fit(w, lr, seed):
         return fit_mlp.__wrapped_jit__(X, y, w, layers=layers,
-                                       max_iter=max_iter, lr=lr, seed=seed)
+                                       max_iter=max_iter, lr=lr, seed=seed,
+                                       axis_name=axis_name)
 
     over_grid = jax.vmap(fit, in_axes=(None, 0, 0))
     over_folds = jax.vmap(over_grid, in_axes=(0, None, None))

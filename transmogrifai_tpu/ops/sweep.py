@@ -58,40 +58,83 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.4.35-ish exports shard_map at top level
+    from jax import shard_map as _shard_map
+    _no_check = {"check_vma": False}
+except ImportError:  # the 0.4.x experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _no_check = {"check_rep": False}
+
+from ..parallel import mesh as mesh_mod
+from ..parallel.mesh import mesh_all_gather, mesh_psum
 from ..utils import devcache, flops
 from . import linear as L
 from . import trees as Tr
 from .metrics import (BINARY_METRICS, MULTICLASS_METRICS, REGRESSION_METRICS,
-                      _binary_grid_metrics, _multiclass_grid_metrics,
-                      _regression_grid_metrics)
+                      _binary_grid_metrics, _binary_one,
+                      _multiclass_grid_metrics, _multiclass_one,
+                      _regression_grid_metrics, _regression_one)
 
-__all__ = ["run_sweep", "run_sweep_partitioned", "reset_run_stats",
-           "run_stats", "BINARY_METRICS", "MULTICLASS_METRICS",
-           "REGRESSION_METRICS"]
+__all__ = ["run_sweep", "run_sweep_partitioned", "run_sweep_rowsharded",
+           "reset_run_stats", "run_stats", "record_fallback",
+           "BINARY_METRICS", "MULTICLASS_METRICS", "REGRESSION_METRICS"]
 
 
 # ---------------------------------------------------------------------------
 # Fragment interpreters (traced inline inside the one fused program)
+#
+# Every interpreter takes an optional row-shard context ``rs = (axis_name,
+# n_orig, n_data)`` (static).  With ``rs=None`` the trace is byte-identical
+# to the replicated program.  With it, the interpreter's row axis holds ONE
+# data shard of ``n_orig`` padded rows: the training kernels psum their
+# cross-row reductions over ``axis_name`` (ops/linear, ops/trees, ops/mlp),
+# on-device RNG draws happen at the ORIGINAL row count (shape-keyed Poisson/
+# uniform draws must match the single-device stream bit-for-bit) and are then
+# sliced to the local block, and all per-row state stays local.
 # ---------------------------------------------------------------------------
-def _fista_scores(frag, X, y, train_w, blob, classification: bool):
+def _rs_axis(rs) -> Optional[str]:
+    return None if rs is None else rs[0]
+
+
+def _local_rows(full, n_local: int, rs, axis: int = 0):
+    """This shard's contiguous block of a full-row array drawn at n_orig.
+
+    Zero-pads ``axis`` from n_orig up to ``n_data * n_local`` (padding rows
+    carry zero weight everywhere downstream) and slices the block at
+    ``axis_index * n_local`` — shard_map row shards are contiguous."""
+    axis_name, _, n_data = rs
+    pad = n_data * n_local - full.shape[axis]
+    if pad:
+        widths = [(0, 0)] * full.ndim
+        widths[axis] = (0, pad)
+        full = jnp.pad(full, widths)
+    start = lax.axis_index(axis_name) * n_local
+    return lax.dynamic_slice_in_dim(full, start, n_local, axis=axis)
+
+
+def _fista_scores(frag, X, y, train_w, blob, classification: bool, rs=None):
     _, cis, max_iter, fit_intercept, off_l1, off_l2 = frag
     G = len(cis)
     l1 = blob[off_l1:off_l1 + G]
     l2 = blob[off_l2:off_l2 + G]
+    ax = _rs_axis(rs)
     if classification:
         fit = L.fit_logistic_grid_folds_fista(X, y, train_w, l1, l2,
                                               max_iter=max_iter,
-                                              fit_intercept=fit_intercept)
+                                              fit_intercept=fit_intercept,
+                                              axis_name=ax)
         z = jnp.einsum("nd,fgd->fgn", X, fit.coef) + fit.intercept[..., :1]
         return jax.nn.sigmoid(z)
     fit = L.fit_linear_grid_folds_fista(X, y, train_w, l1, l2,
                                         max_iter=max_iter,
-                                        fit_intercept=fit_intercept)
+                                        fit_intercept=fit_intercept,
+                                        axis_name=ax)
     return jnp.einsum("nd,fgd->fgn", X, fit.coef) + fit.intercept[..., :1]
 
 
-def _softmax_scores(frag, X, y, train_w, blob, k: int):
+def _softmax_scores(frag, X, y, train_w, blob, k: int, rs=None):
     """Multiclass logistic: class probabilities [F, G, n, k]."""
     _, cis, max_iter, fit_intercept, off_l1, off_l2 = frag
     G = len(cis)
@@ -99,34 +142,37 @@ def _softmax_scores(frag, X, y, train_w, blob, k: int):
     l2 = blob[off_l2:off_l2 + G]
     fit = L.fit_softmax_grid_folds(X, y, train_w, l1, l2, num_classes=k,
                                    max_iter=max_iter,
-                                   fit_intercept=fit_intercept)
+                                   fit_intercept=fit_intercept,
+                                   axis_name=_rs_axis(rs))
     z = jnp.einsum("nd,fgdk->fgnk", X, fit.coef) + fit.intercept[:, :, None, :]
     return jax.nn.softmax(z, axis=-1)
 
 
-def _newton_scores(frag, X, y, train_w, blob):
+def _newton_scores(frag, X, y, train_w, blob, rs=None):
     _, cis, max_iter, fit_intercept, off_l2 = frag
     l2 = blob[off_l2:off_l2 + len(cis)]
     fit = L.fit_logistic_grid_folds_newton(X, y, train_w, l2,
                                            max_iter=max_iter,
-                                           fit_intercept=fit_intercept)
+                                           fit_intercept=fit_intercept,
+                                           axis_name=_rs_axis(rs))
     z = jnp.einsum("nd,fgd->fgn", X, fit.coef) + fit.intercept[..., :1]
     return jax.nn.sigmoid(z)
 
 
-def _svc_scores(frag, X, y, train_w, blob):
+def _svc_scores(frag, X, y, train_w, blob, rs=None):
     """Squared-hinge SVC: the host path emits raw margins but NO probability
     (Spark LinearSVC parity), so its evaluator sees the HARD prediction as
     the score — the fused score reproduces exactly that 0/1 score."""
     _, cis, max_iter, fit_intercept, off_l2 = frag
     l2 = blob[off_l2:off_l2 + len(cis)]
     fit = L.fit_svc_grid_folds(X, y, train_w, l2, max_iter=max_iter,
-                               fit_intercept=fit_intercept)
+                               fit_intercept=fit_intercept,
+                               axis_name=_rs_axis(rs))
     z = jnp.einsum("nd,fgd->fgn", X, fit.coef) + fit.intercept[..., :1]
     return (z >= 0.0).astype(jnp.float32)
 
 
-def _mlp_scores(frag, X, y, train_w, blob, full_prob: bool = False):
+def _mlp_scores(frag, X, y, train_w, blob, full_prob: bool = False, rs=None):
     """Batched MLP: p(class 1) — or the full [F, G, n, k] distribution."""
     from . import mlp as M
 
@@ -135,12 +181,13 @@ def _mlp_scores(frag, X, y, train_w, blob, full_prob: bool = False):
     lrs = blob[off_lr:off_lr + G]
     seeds = blob[off_seed:off_seed + G].astype(jnp.int32)
     params = M.fit_mlp_grid_folds(X, y, train_w, lrs, seeds,
-                                  layers=layers, max_iter=max_iter)
+                                  layers=layers, max_iter=max_iter,
+                                  axis_name=_rs_axis(rs))
     _, prob, _ = M.predict_mlp_grid(params, X)
     return prob if full_prob else prob[..., 1]
 
 
-def _forest_group_scores(group, xbs, y, train_w, blob, out_c: int):
+def _forest_group_scores(group, xbs, y, train_w, blob, out_c: int, rs=None):
     """One static forest group -> mean leaf vectors [F, Gc, n, c].
 
     Grouping (builder side) keys on (depth, n_trees, n_bins, frac, rate,
@@ -155,7 +202,16 @@ def _forest_group_scores(group, xbs, y, train_w, blob, out_c: int):
     F = train_w.shape[0]
     Gc = len(cis)
     kb, kf = Tr.rng_keys(seed)
-    boot = Tr.bootstrap_weights(kb, n, n_trees, bootstrap, rate)  # [T, n]
+    if rs is None:
+        boot = Tr.bootstrap_weights(kb, n, n_trees, bootstrap, rate)  # [T, n]
+    else:
+        # Poisson draws are shape-keyed: parity with the single-device launch
+        # requires drawing the FULL [T, n_orig] stream, then slicing this
+        # shard's contiguous row block (padding rows get fresh draws that are
+        # zeroed by the padded train_w)
+        boot = _local_rows(
+            Tr.bootstrap_weights(kb, rs[1], n_trees, bootstrap, rate),
+            n, rs, axis=1)
     fm = Tr.feature_masks(kf, d, n_trees, frac)                   # [T, d]
     g = -y[:, None] if out_c == 1 else -jax.nn.one_hot(
         y.astype(jnp.int32), out_c, dtype=jnp.float32)
@@ -184,7 +240,8 @@ def _forest_group_scores(group, xbs, y, train_w, blob, out_c: int):
         tree, row_node = Tr.grow_forest(
             Xb, g, h, wts, fms, depth, n_bins, frontier,
             reg_lambda_t=lam, gamma_t=gam, mcw_t=mcws, mig_t=migs,
-            exact_cap=exact_cap, return_row_node=True)
+            exact_cap=exact_cap, return_row_node=True,
+            axis_name=_rs_axis(rs))
         # growth routes EVERY row (weights only gate histograms), so
         # row_node already holds each row's leaf — reading leaf_val there
         # replaces the depth-step pointer walk that dominated the fragment
@@ -201,7 +258,8 @@ def _forest_group_scores(group, xbs, y, train_w, blob, out_c: int):
     return preds.reshape(F, Gc, n_trees, n, -1).mean(axis=2)  # [F, Gc, n, c]
 
 
-def _gbt_group_scores(group, xbs, y, train_w, blob, loss: str, out_c: int):
+def _gbt_group_scores(group, xbs, y, train_w, blob, loss: str, out_c: int,
+                      rs=None):
     """One static boosting group -> final margins [F, Gc, n, c]."""
     (cis, rounds, depth, xb_idx, n_bins, subsample, colsample, seed,
      frontier, exact_cap, fold_base, off_eta, off_lam, off_gam, off_mcw,
@@ -210,8 +268,13 @@ def _gbt_group_scores(group, xbs, y, train_w, blob, loss: str, out_c: int):
     n, d = Xb.shape
     F = train_w.shape[0]
     Gc = len(cis)
+    ax = _rs_axis(rs)
     ks, kf = Tr.rng_keys(seed)
-    rw = Tr.subsample_weights(ks, n, rounds, subsample)
+    if rs is None:
+        rw = Tr.subsample_weights(ks, n, rounds, subsample)
+    else:  # full-stream draw then local slice — see _forest_group_scores
+        rw = _local_rows(Tr.subsample_weights(ks, rs[1], rounds, subsample),
+                         n, rs, axis=1)
     fms = Tr.feature_masks(kf, d, rounds, colsample)
 
     eta = blob[off_eta:off_eta + Gc]
@@ -221,7 +284,8 @@ def _gbt_group_scores(group, xbs, y, train_w, blob, loss: str, out_c: int):
     mig = blob[off_mig:off_mig + Gc]
 
     if fold_base:  # regression boosting starts from the fold's label mean
-        base_f = (y[None, :] * train_w).sum(1) / jnp.maximum(train_w.sum(1), 1e-12)
+        base_f = (mesh_psum((y[None, :] * train_w).sum(1), ax)
+                  / jnp.maximum(mesh_psum(train_w.sum(1), ax), 1e-12))
     else:
         base_f = jnp.zeros(F, jnp.float32)
 
@@ -236,14 +300,15 @@ def _gbt_group_scores(group, xbs, y, train_w, blob, loss: str, out_c: int):
     def one(w, e, l, ga, mc, ba, mi):
         _, Fm = Tr._gbt_impl(Xb, y, w, rw, fms, loss, rounds, depth, n_bins,
                              frontier, e, l, ga, mc, ba, out_c,
-                             min_info_gain=mi, exact_cap=exact_cap)
+                             min_info_gain=mi, exact_cap=exact_cap,
+                             axis_name=ax)
         return Fm
 
     Fm = jax.vmap(one)(w_b, eta_b, lam_b, gam_b, mcw_b, base_b, mig_b)
     return Fm.reshape(F, Gc, n, -1)
 
 
-def _frag_scores(frag, X, xbs, y, train_w, blob, problem):
+def _frag_scores(frag, X, xbs, y, train_w, blob, problem, rs=None):
     """Returns (cis, scores [F, Gf, n] — or [F, Gf, n, k] multiclass)."""
     kind = frag[0]
     multiclass = isinstance(problem, tuple)
@@ -251,20 +316,22 @@ def _frag_scores(frag, X, xbs, y, train_w, blob, problem):
     if kind == "fista":
         if multiclass:
             return frag[1], _softmax_scores(frag, X, y, train_w, blob,
-                                            problem[1])
-        return frag[1], _fista_scores(frag, X, y, train_w, blob, classification)
+                                            problem[1], rs=rs)
+        return frag[1], _fista_scores(frag, X, y, train_w, blob,
+                                      classification, rs=rs)
     if kind == "newton":
-        return frag[1], _newton_scores(frag, X, y, train_w, blob)
+        return frag[1], _newton_scores(frag, X, y, train_w, blob, rs=rs)
     if kind == "svc":
-        return frag[1], _svc_scores(frag, X, y, train_w, blob)
+        return frag[1], _svc_scores(frag, X, y, train_w, blob, rs=rs)
     if kind == "mlp":
         return frag[1], _mlp_scores(frag, X, y, train_w, blob,
-                                    full_prob=multiclass)
+                                    full_prob=multiclass, rs=rs)
     if kind == "forest":
         _, out_c, groups = frag
         cis_all, outs = [], []
         for grp in groups:
-            dist = _forest_group_scores(grp, xbs, y, train_w, blob, out_c)
+            dist = _forest_group_scores(grp, xbs, y, train_w, blob, out_c,
+                                        rs=rs)
             # binary classification: 1-channel leaves ARE p(class=1);
             # regression: mean leaves are the prediction; multiclass keeps
             # the class-distribution leaves (argmax-equivalent unnormalized);
@@ -279,7 +346,8 @@ def _frag_scores(frag, X, xbs, y, train_w, blob, problem):
         _, loss, out_c, groups = frag
         cis_all, outs = [], []
         for grp in groups:
-            Fm = _gbt_group_scores(grp, xbs, y, train_w, blob, loss, out_c)
+            Fm = _gbt_group_scores(grp, xbs, y, train_w, blob, loss, out_c,
+                                   rs=rs)
             if loss == "softmax":
                 outs.append(jax.nn.softmax(Fm, axis=-1))
             elif loss == "logistic":
@@ -291,7 +359,7 @@ def _frag_scores(frag, X, xbs, y, train_w, blob, problem):
     raise ValueError(f"unknown sweep fragment {kind!r}")
 
 
-def _all_scores(spec, X, xbs, y, train_w, blob):
+def _all_scores(spec, X, xbs, y, train_w, blob, rs=None):
     problem, frags, strict = spec
     n = y.shape[0]
     F = train_w.shape[0]
@@ -301,7 +369,7 @@ def _all_scores(spec, X, xbs, y, train_w, blob):
     else:
         scores = jnp.zeros((F, C, n), jnp.float32)
     for frag in frags:
-        cis, sc = _frag_scores(frag, X, xbs, y, train_w, blob, problem)
+        cis, sc = _frag_scores(frag, X, xbs, y, train_w, blob, problem, rs=rs)
         if isinstance(problem, tuple) and sc.ndim == 3:
             # binary-family fragment under a k=2 multiclass evaluator:
             # expand the class-1 score to the [p0, p1] plane
@@ -336,6 +404,80 @@ def _run_scores(spec, X, xbs, y, train_w, blob):
 @functools.partial(jax.jit, static_argnames=("spec",))
 def _run_metrics(spec, y, scores, val_w):
     return _metrics_of(spec, y, scores, val_w)
+
+
+def _metrics_of_rs(spec, y, scores, val_w, rs):
+    """Row-sharded metrics pass -> [F, C, M], identical on every data shard.
+
+    The sum-shaped metrics could psum their accumulators, but AuROC/AuPR are
+    rank-based and need the GLOBAL row order.  Reassembling the whole
+    [F, C, n] score tensor at once would forfeit the 1/data_shards score-
+    memory win, so the candidate axis runs under ``lax.map``: per candidate,
+    all_gather this shard's [F, n_local] score block to [F, n_pad] (a
+    transient), evaluate the single-candidate metric kernels on globally
+    ordered rows, and move on.  Padding rows carry zero validation weight and
+    the metric kernels already treat vm=0 rows as excluded."""
+    problem, _, strict = spec
+    ax = rs[0]
+    y_full = mesh_all_gather(y, ax, axis=0)             # [n_pad]
+    vw_full = mesh_all_gather(val_w, ax, axis=1)        # [F, n_pad]
+    if isinstance(problem, tuple):
+        y1 = jax.nn.one_hot(y_full.astype(jnp.int32), problem[1],
+                            dtype=jnp.float32)
+
+        def one_mc(sc):                                 # sc [F, n_local, k]
+            sf = mesh_all_gather(sc, ax, axis=1)        # [F, n_pad, k]
+            return jax.vmap(_multiclass_one, in_axes=(None, 0, 0))(
+                y1, sf, vw_full)                        # [F, M]
+
+        out = lax.map(one_mc, jnp.moveaxis(scores, 1, 0))
+        return jnp.moveaxis(out, 0, 1)                  # [F, C, M]
+    if problem == "binary":
+        def one_bin(args):
+            sc, st = args                               # [F, n_local], f32
+            sf = mesh_all_gather(sc, ax, axis=1)        # [F, n_pad]
+            return jax.vmap(_binary_one, in_axes=(None, 0, 0, None))(
+                y_full, sf, vw_full, st)                # [F, M]
+
+        out = lax.map(one_bin, (jnp.moveaxis(scores, 1, 0),
+                                jnp.asarray(strict, jnp.float32)))
+        return jnp.moveaxis(out, 0, 1)
+
+    def one_reg(sc):
+        sf = mesh_all_gather(sc, ax, axis=1)
+        return jax.vmap(_regression_one, in_axes=(None, 0, 0))(
+            y_full, sf, vw_full)
+
+    out = lax.map(one_reg, jnp.moveaxis(scores, 1, 0))
+    return jnp.moveaxis(out, 0, 1)
+
+
+@functools.partial(jax.jit, static_argnames=("spec", "mesh", "n_orig"))
+def _run_rs(spec, mesh, n_orig, X, xbs, y, train_w, val_w, blob):
+    """ONE model column's fused program, row-sharded over its (data,) submesh.
+
+    Every array argument must be committed with the matching sharding (rows
+    over DATA_AXIS for X/xbs/y, axis 1 for the fold-weight matrices, blob
+    replicated).  Inside shard_map each device sees one contiguous row block
+    of n_pad/n_data rows; the interpreters' cross-row reductions become psums
+    over the data axis (normal-equation blocks, gradient/hessian histograms,
+    fold accumulators) while per-candidate state stays local, and the metric
+    pass reassembles global row order per candidate.  ``n_orig`` is static so
+    the RNG parity slices bake in.  NOTE: no SPLIT_METRICS two-launch variant
+    here — the lax.map over candidates already bounds the metric transient to
+    one [F, n_pad] block."""
+    ax = mesh_mod.DATA_AXIS
+    n_data = int(mesh.shape[ax])
+    rs = (ax, n_orig, n_data)
+
+    def local(Xl, xbs_l, yl, twl, vwl, bl):
+        scores = _all_scores(spec, Xl, xbs_l, yl, twl, bl, rs=rs)
+        return _metrics_of_rs(spec, yl, scores, vwl, rs)
+
+    return _shard_map(
+        local, mesh=mesh,
+        in_specs=(P(ax), P(ax), P(ax), P(None, ax), P(None, ax), P()),
+        out_specs=P(), **_no_check)(X, xbs, y, train_w, val_w, blob)
 
 
 #: above this many score ELEMENTS the sweep runs as TWO launches (scores,
@@ -380,7 +522,7 @@ def run_sweep(spec, X, xbs: Tuple, y, train_w, val_w, blob):
 #: ``run_sweep`` ({"shards": 1, ...}) / ``run_sweep_partitioned`` call
 #: ({"shards": k, "per_shard": [...], ...}); the bench and the multichip
 #: dryrun read it to report ``sweep_shards`` + per-shard wall/compile times.
-_run_stats: Dict[str, List[Dict[str, Any]]] = {"launches": []}
+_run_stats: Dict[str, List[Dict[str, Any]]] = {"launches": [], "fallbacks": []}
 
 #: per-(name, spec, device, arg-signature) AOT executables.  jit's own cache
 #: would recompile nothing either, but going through ``.lower().compile()``
@@ -395,13 +537,29 @@ _aot_lock = threading.Lock()
 
 def reset_run_stats() -> None:
     _run_stats["launches"] = []
+    _run_stats["fallbacks"] = []
+
+
+def record_fallback(reason: str, **detail) -> None:
+    """Note that a launch declined row-sharding (or fusion) and why.
+
+    The graceful-degradation contract: when rows are too few for the data
+    axis or a custom estimator blocks fusion, the validator routes through
+    the replicated path and RECORDS the reason here instead of erroring —
+    ``run_stats()['fallbacks']`` is the audit trail."""
+    entry: Dict[str, Any] = {"reason": reason}
+    entry.update(detail)
+    _run_stats["fallbacks"].append(entry)
 
 
 def run_stats() -> Dict[str, Any]:
     """Aggregate view of launches since the last reset (host-side stats)."""
     launches = [dict(e) for e in _run_stats["launches"]]
     return {"launches": launches,
-            "sweep_shards": max((e["shards"] for e in launches), default=0)}
+            "sweep_shards": max((e["shards"] for e in launches), default=0),
+            "data_shards": max((e.get("data_shards", 1) for e in launches),
+                               default=0),
+            "fallbacks": [dict(e) for e in _run_stats["fallbacks"]]}
 
 
 def _aot(name: str, fn, spec, device, dyn_args) -> Tuple[Any, float]:
@@ -520,4 +678,162 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
         {"shards": len(shards), "candidates": int(n_candidates),
          "wall_s": round(time.perf_counter() - t_all, 4),
          "per_shard": per_shard})
+    return metrics
+
+
+# ---------------------------------------------------------------------------
+# Row-sharded execution: a (data x model) mesh holding ONE row shard per chip
+# ---------------------------------------------------------------------------
+def _aot_rs(spec, submesh, n_orig: int, dyn_args) -> Tuple[Any, float, Tuple]:
+    """AOT executable of ``_run_rs`` + compile seconds + the program's traced
+    (kind, axis, bytes) collective list (replayed into utils/flops per call).
+    The collective trace is captured at lowering and cached WITH the
+    executable, so steady-state calls replay it without re-tracing."""
+    key = ("sweep.run_rs", spec, submesh, n_orig,
+           flops._signature(dyn_args, {}))
+    with _aot_lock:
+        hit = _aot_cache.get(key)
+    if hit is not None:
+        return hit[0], 0.0, hit[1]
+    t0 = time.perf_counter()
+    with mesh_mod.trace_collectives() as colls:
+        compiled = _run_rs.lower(spec, submesh, n_orig, *dyn_args).compile()
+    dt = time.perf_counter() - t0
+    with _aot_lock:
+        # a racing thread may have compiled the same key; keep the first
+        hit = _aot_cache.setdefault(key, (compiled, tuple(colls)))
+    return hit[0], dt, hit[1]
+
+
+def _rs_arrays(submesh, X, xbs, y, X_host, y_host, xb_bins):
+    """Row-sharded placements of the dataset over one model column's submesh.
+
+    Rows are zero-padded to a multiple of the data-shard count (padding rows
+    carry zero fold weight) and laid out over DATA_AXIS.  With host
+    identities available the placements cache through utils.devcache keyed on
+    (host identity, submesh devices), so repeated sweeps re-upload nothing.
+    Returns (X, xbs tuple, y, original row count).
+    """
+    mkey = tuple(str(d) for d in np.asarray(submesh.devices).flat)
+    if X_host is not None:
+        Xd, n_orig = devcache.derived(
+            X_host, ("sweep_rs_X", mkey),
+            lambda: mesh_mod.shard_rows(np.asarray(X_host, np.float32),
+                                        submesh))
+    else:
+        Xd, n_orig = mesh_mod.shard_rows(np.asarray(X, np.float32), submesh)
+    if y_host is not None:
+        yd, _ = devcache.derived(
+            y_host, ("sweep_rs_y", mkey),
+            lambda: mesh_mod.shard_rows(np.asarray(y_host, np.float32),
+                                        submesh))
+    else:
+        yd, _ = mesh_mod.shard_rows(np.asarray(y, np.float32), submesh)
+    xbs_d = []
+    for i, xb in enumerate(xbs):
+        if X_host is not None and xb_bins is not None:
+            xbs_d.append(devcache.derived(
+                X_host, ("sweep_rs_xb", int(xb_bins[i]), mkey),
+                lambda xb=xb: mesh_mod.shard_rows(np.asarray(xb),
+                                                  submesh)[0]))
+        else:
+            xbs_d.append(mesh_mod.shard_rows(np.asarray(xb), submesh)[0])
+    return Xd, tuple(xbs_d), yd, n_orig
+
+
+def run_sweep_rowsharded(shards, X, xbs: Tuple, y, train_w, val_w,
+                         n_candidates: int, mesh,
+                         X_host: Optional[np.ndarray] = None,
+                         y_host: Optional[np.ndarray] = None,
+                         xb_bins: Optional[Tuple[int, ...]] = None
+                         ) -> np.ndarray:
+    """Execute the sweep on a 2-D (data, model) mesh: model column ``j``
+    runs ``shards[j]``'s sub-spec program row-sharded over the column's
+    devices.
+
+    Composition with the cost-balanced model partitioning is by construction:
+    each column is an independent SPMD program over its own (data,)-axis
+    submesh — no cross-model communication — dispatched from its own worker
+    thread exactly like ``run_sweep_partitioned`` dispatches single-device
+    shards.  Within a column every device holds rows/data_shards of X (the
+    1/data_shards peak-memory claim; see the launch entry's
+    ``per_device_bytes``) and the fragment interpreters reduce over the
+    ``data`` axis with psum'd normal-equation blocks / histograms / metric
+    accumulators.  Returns host metrics [F, n_candidates, M] in the GLOBAL
+    candidate order.
+    """
+    grid = np.asarray(mesh.devices)
+    ax_d = list(mesh.axis_names).index(mesh_mod.DATA_AXIS)
+    ax_m = list(mesh.axis_names).index(mesh_mod.MODEL_AXIS)
+    grid = np.moveaxis(grid, (ax_d, ax_m), (0, 1))
+    n_data = grid.shape[0]
+    if len(shards) > grid.shape[1]:
+        raise ValueError(f"{len(shards)} model shards > mesh model axis "
+                         f"{grid.shape[1]}")
+    F = int(train_w.shape[0])
+    tw_host = np.asarray(train_w, np.float32)
+    vw_host = np.asarray(val_w, np.float32)
+    t_all = time.perf_counter()
+
+    def worker(shard, j):
+        t0 = time.perf_counter()
+        submesh = Mesh(grid[:, j], (mesh_mod.DATA_AXIS,))
+        Xd, xbs_d, yd, n_orig = _rs_arrays(submesh, X, xbs, y,
+                                           X_host, y_host, xb_bins)
+        n_pad = int(Xd.shape[0])
+        fold_sh = NamedSharding(submesh, P(None, mesh_mod.DATA_AXIS))
+        tw = jax.device_put(
+            mesh_mod.pad_to_multiple(tw_host, n_data, axis=1)[0], fold_sh)
+        vw = jax.device_put(
+            mesh_mod.pad_to_multiple(vw_host, n_data, axis=1)[0], fold_sh)
+        bl = jax.device_put(np.asarray(shard.blob, np.float32),
+                            NamedSharding(submesh, P()))
+        args = (Xd, xbs_d, yd, tw, vw, bl)
+        compiled, compile_s, colls = _aot_rs(shard.spec, submesh, n_orig,
+                                             args)
+        out = compiled(*args)
+        # block in THIS thread only: other columns keep dispatching/running
+        out = np.asarray(out)
+        label = ",".join(str(d) for d in grid[:, j])
+        stat = {"devices": [str(d) for d in grid[:, j]],
+                "candidates": len(shard.cis),
+                "predicted_cost": float(shard.cost),
+                "compile_s": round(compile_s, 4),
+                "rows_local": n_pad // n_data,
+                "wall_s": round(time.perf_counter() - t0, 4)}
+        return out, stat, ("sweep.run_rs", compiled, args, label, colls,
+                           n_orig, n_pad)
+
+    with ThreadPoolExecutor(max_workers=len(shards)) as pool:
+        results = list(pool.map(worker, shards, range(len(shards))))
+
+    M = results[0][0].shape[-1]
+    metrics = np.zeros((F, n_candidates, M), np.float32)
+    per_shard = []
+    coll_agg: Dict[str, Dict[str, float]] = {}
+    n_orig = n_pad = 0
+    for (out, stat, rec), shard in zip(results, shards):
+        metrics[:, np.asarray(shard.cis, np.int64), :] = out[:F]
+        per_shard.append(stat)
+        name, compiled, args, label, colls, n_orig, n_pad = rec
+        flops.record_compiled(name, compiled, args, device=label)
+        flops.record_collectives(colls, device=label)
+        for kind, axis, nbytes in colls:
+            agg = coll_agg.setdefault(axis, {"count": 0.0, "bytes": 0.0})
+            agg["count"] += 1
+            agg["bytes"] += nbytes
+    d = int(X_host.shape[1]) if X_host is not None else int(X.shape[1])
+    _run_stats["launches"].append(
+        {"shards": len(shards), "data_shards": int(n_data),
+         "rowsharded": True, "candidates": int(n_candidates),
+         "wall_s": round(time.perf_counter() - t_all, 4),
+         "per_shard": per_shard,
+         "collectives": coll_agg,
+         # the 1/data_shards peak-memory claim, auditable: what ONE device
+         # of a model column holds vs what a replicated launch would hold
+         "per_device_bytes": {
+             "X": n_pad // n_data * d * 4,
+             "y": n_pad // n_data * 4,
+             "X_replicated": n_orig * d * 4,
+             "y_replicated": n_orig * 4}})
     return metrics

@@ -48,6 +48,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..parallel.mesh import mesh_psum
+
 
 class Tree(NamedTuple):
     """One tree as a flat node pool; leading axes may batch trees/rounds."""
@@ -292,7 +294,8 @@ def _level_histograms(Xb, ghw, row_slot, m: int, n_bins: int):
 def _grow_level(Xb, gh, w, feat_mask, nodes, leaf_val, slot_base, next_free,
                 n_active, row_slot, row_node, m: int, next_cap: int,
                 n_bins: int, reg_lambda, gamma, min_child_weight,
-                min_info_gain=0.0, Og=None, exact_cap: bool = False):
+                min_info_gain=0.0, Og=None, exact_cap: bool = False,
+                axis_name: Optional[str] = None):
     """One breadth-first level over an ``m``-slot frontier.
 
     SCATTER/GATHER-FREE by design: XLA TPU lowers batched scatters and
@@ -330,6 +333,11 @@ def _grow_level(Xb, gh, w, feat_mask, nodes, leaf_val, slot_base, next_free,
     else:
         S = None
         G, H = _level_histograms(Xb, gh * w[:, None], row_slot, m, B)
+    # row-sharded launch: local-rows histograms psum to the GLOBAL per-bin
+    # stats, so every shard picks identical splits (distributed-XGBoost
+    # histogram aggregation); row routing below stays local
+    G = mesh_psum(G, axis_name)
+    H = mesh_psum(H, axis_name)
     # G: [m, c, d, B]; H: [m, d, B] — bins minor, no 2-wide lane dims
     GT = G[:, :, 0, :].sum(axis=-1)   # [m, c] — node totals (same per feature)
     HT = H[:, 0, :].sum(axis=-1)      # [m]
@@ -434,7 +442,7 @@ def grow_tree(Xb, g, h, w, feat_mask, max_depth: int, n_bins: int,
               frontier: int, reg_lambda: float = 1.0, gamma: float = 0.0,
               min_child_weight: float = 1.0, min_info_gain=0.0,
               Og=None, return_row_node: bool = False,
-              exact_cap: bool = False):
+              exact_cap: bool = False, axis_name: Optional[str] = None):
     """Grow one second-order histogram tree (traceable; static shapes).
 
     Xb: int[n, d] pre-binned features; g: f32[n, c] gradients; h: f32[n]
@@ -458,7 +466,8 @@ def grow_tree(Xb, g, h, w, feat_mask, max_depth: int, n_bins: int,
     P = _pool_size(max_depth, frontier)
     gw = g * w[:, None]
     hw = h * w
-    root_val = -gw.sum(axis=0) / (hw.sum() + reg_lambda)      # [c]
+    root_val = (-mesh_psum(gw.sum(axis=0), axis_name)
+                / (mesh_psum(hw.sum(), axis_name) + reg_lambda))  # [c]
     nodes = jnp.tile(jnp.asarray([-1, 0, 0, 0], jnp.int32), (P, 1))
     leaf_val = jnp.zeros((P, c), jnp.float32).at[0].set(root_val)
     row_node = jnp.zeros((n,), jnp.int32)
@@ -489,7 +498,7 @@ def grow_tree(Xb, g, h, w, feat_mask, max_depth: int, n_bins: int,
             (1 << (t + 1)) - 1, *carry[2:], m=1 << t, next_cap=next_cap,
             n_bins=n_bins, reg_lambda=reg_lambda, gamma=gamma,
             min_child_weight=min_child_weight, min_info_gain=min_info_gain,
-            Og=Og, exact_cap=exact_cap)
+            Og=Og, exact_cap=exact_cap, axis_name=axis_name)
     # deep levels: ONE fori_loop body at fixed M slots
     if max_depth > L:
         def body(t, carry):
@@ -499,7 +508,7 @@ def grow_tree(Xb, g, h, w, feat_mask, max_depth: int, n_bins: int,
                                n_bins=n_bins, reg_lambda=reg_lambda,
                                gamma=gamma, min_child_weight=min_child_weight,
                                min_info_gain=min_info_gain, Og=Og,
-                               exact_cap=exact_cap)
+                               exact_cap=exact_cap, axis_name=axis_name)
 
         carry = lax.fori_loop(L, max_depth, body, carry)
     nodes, leaf_val, row_node = carry[0], carry[1], carry[4]
@@ -540,7 +549,7 @@ def _grow_level_batch(Xb, gh, w_t, feat_mask_t, nodes, leaf_val, slot_base,
                       next_free, n_active, row_slot, row_node, m: int,
                       next_cap: int, n_bins: int, reg_lambda_t, gamma_t,
                       mcw_t, mig_t, Og, exact_cap: bool,
-                      gh_t=None, Obin=None):
+                      gh_t=None, Obin=None, axis_name: Optional[str] = None):
     """One breadth-first level for a BATCH of T trees (shared Xb).
 
     Same split math as ``_grow_level`` (see its docstring for the
@@ -579,6 +588,8 @@ def _grow_level_batch(Xb, gh, w_t, feat_mask_t, nodes, leaf_val, slot_base,
                              Obin, (((1,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32)
     GH = GH.reshape(T, m, c + 1, d, B)
+    # global per-bin stats under a row-sharded launch (see _grow_level)
+    GH = mesh_psum(GH, axis_name)
     G, H = GH[:, :, :c], GH[:, :, c]                # [T,m,c,d,B], [T,m,d,B]
     GT = G[:, :, :, 0, :].sum(axis=-1)              # [T, m, c]
     HT = H[:, :, 0, :].sum(axis=-1)                 # [T, m]
@@ -663,7 +674,7 @@ def _grow_level_batch(Xb, gh, w_t, feat_mask_t, nodes, leaf_val, slot_base,
 def grow_forest(Xb, g, h, w_t, feat_mask_t, max_depth: int, n_bins: int,
                 frontier: int, reg_lambda_t, gamma_t, mcw_t, mig_t,
                 exact_cap: bool = False, return_row_node: bool = False,
-                gh_t=None, Obin=None):
+                gh_t=None, Obin=None, axis_name: Optional[str] = None):
     """Grow T trees together; ONE flat GEMM per level (see header note).
 
     Shared: Xb int[n, d].  Gradients either SHARED (g f32[n, c], h f32[n] —
@@ -685,7 +696,7 @@ def grow_forest(Xb, g, h, w_t, feat_mask_t, max_depth: int, n_bins: int,
                                  frontier, reg_lambda=lam, gamma=gam,
                                  min_child_weight=mcw, min_info_gain=mig,
                                  Og=None, return_row_node=return_row_node,
-                                 exact_cap=exact_cap)
+                                 exact_cap=exact_cap, axis_name=axis_name)
 
             return jax.vmap(one)(w_t, feat_mask_t, reg_lambda_t, gamma_t,
                                  mcw_t, mig_t)
@@ -695,7 +706,7 @@ def grow_forest(Xb, g, h, w_t, feat_mask_t, max_depth: int, n_bins: int,
                              n_bins, frontier, reg_lambda=lam, gamma=gam,
                              min_child_weight=mcw, min_info_gain=mig,
                              Og=None, return_row_node=return_row_node,
-                             exact_cap=exact_cap)
+                             exact_cap=exact_cap, axis_name=axis_name)
 
         return jax.vmap(one)(gh_t, w_t, feat_mask_t, reg_lambda_t, gamma_t,
                              mcw_t, mig_t)
@@ -712,6 +723,8 @@ def grow_forest(Xb, g, h, w_t, feat_mask_t, max_depth: int, n_bins: int,
             Obin = bin_onehot(Xb, n_bins)
         gw_sum = (gh_t[:, :, :c] * w_t[:, :, None]).sum(axis=1)
         hw_sum = (gh_t[:, :, c] * w_t).sum(axis=1)
+    gw_sum = mesh_psum(gw_sum, axis_name)
+    hw_sum = mesh_psum(hw_sum, axis_name)
     P = _pool_size(max_depth, frontier)
     root_val = -gw_sum / (hw_sum + reg_lambda_t)[:, None]
     nodes = jnp.tile(jnp.asarray([-1, 0, 0, 0], jnp.int32), (T, P, 1))
@@ -738,7 +751,7 @@ def grow_forest(Xb, g, h, w_t, feat_mask_t, max_depth: int, n_bins: int,
             (1 << (t + 1)) - 1, *carry[2:], m=1 << t, next_cap=1 << (t + 1),
             n_bins=n_bins, reg_lambda_t=reg_lambda_t, gamma_t=gamma_t,
             mcw_t=mcw_t, mig_t=mig_t, Og=Og, exact_cap=exact_cap,
-            gh_t=gh_t, Obin=Obin)
+            gh_t=gh_t, Obin=Obin, axis_name=axis_name)
     if max_depth > L:
         def body(t, carry):
             sb = M - 1 + (t - L) * M
@@ -747,7 +760,7 @@ def grow_forest(Xb, g, h, w_t, feat_mask_t, max_depth: int, n_bins: int,
                 *carry[2:], m=M, next_cap=M, n_bins=n_bins,
                 reg_lambda_t=reg_lambda_t, gamma_t=gamma_t, mcw_t=mcw_t,
                 mig_t=mig_t, Og=Og, exact_cap=exact_cap,
-                gh_t=gh_t, Obin=Obin)
+                gh_t=gh_t, Obin=Obin, axis_name=axis_name)
 
         carry = lax.fori_loop(L, max_depth, body, carry)
     nodes, leaf_val, row_node = carry[0], carry[1], carry[4]
@@ -908,7 +921,8 @@ def _grad_hess(loss: str, F, y, Y_onehot):
 def _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int,
               max_depth: int, n_bins: int, frontier: int, eta, reg_lambda,
               gamma, min_child_weight, base_score: float, n_classes: int,
-              min_info_gain=0.0, exact_cap: bool = False) -> Tuple[Tree, jax.Array]:
+              min_info_gain=0.0, exact_cap: bool = False,
+              axis_name: Optional[str] = None) -> Tuple[Tree, jax.Array]:
     """Traceable boosting body shared by fit_gbt and fit_gbt_batch."""
     n = Xb.shape[0]
     c = n_classes if loss == "softmax" else 1
@@ -928,7 +942,7 @@ def _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int
             reg_lambda=reg_lambda, gamma=gamma,
             min_child_weight=min_child_weight,
             min_info_gain=min_info_gain, Og=Og, return_row_node=True,
-            exact_cap=exact_cap)
+            exact_cap=exact_cap, axis_name=axis_name)
         # row_node is each row's resting node — no predict walk needed
         F = F + eta * tree.leaf_val[row_node]
         return F, tree

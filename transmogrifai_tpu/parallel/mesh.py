@@ -17,7 +17,9 @@ the code below is agnostic to how many processes back the device list.
 from __future__ import annotations
 
 import contextlib
-from typing import Iterator, Optional, Sequence, Tuple
+import os
+import threading
+from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -26,6 +28,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+
+#: below this many rows per data shard the collective latency outweighs the
+#: per-chip compute saved — the validator routes through the replicated path
+#: instead (override with TMOG_MIN_ROWS_PER_SHARD).
+DEFAULT_MIN_ROWS_PER_SHARD = 32
 
 #: the mesh the validator sweep currently runs under (see ``use_mesh``)
 _ACTIVE_MESH: Optional[Mesh] = None
@@ -56,6 +63,14 @@ def model_shards() -> int:
     """Number of shards a batched sweep should pad its candidate axis to."""
     m = _ACTIVE_MESH
     return int(m.shape[MODEL_AXIS]) if m is not None else 1
+
+
+def data_shards() -> int:
+    """Row-shard count of the active mesh (1 without a mesh or data axis)."""
+    m = _ACTIVE_MESH
+    if m is None or DATA_AXIS not in m.shape:
+        return 1
+    return int(m.shape[DATA_AXIS])
 
 
 def model_devices(mesh: Optional[Mesh] = None) -> list:
@@ -167,3 +182,137 @@ def pad_to_multiple(x: np.ndarray, multiple: int, axis: int = 0,
     pad_widths = [(0, 0)] * x.ndim
     pad_widths[axis] = (0, rem)
     return np.pad(x, pad_widths, constant_values=fill), n
+
+
+def shard_rows(x, mesh: Mesh, axis: int = 0,
+               fill: float = 0.0) -> Tuple[jax.Array, int]:
+    """Pad ``axis`` to a multiple of the mesh's data-shard count and place
+    the array row-sharded over DATA_AXIS (other dims replicated).
+
+    Padding rows carry zero sample-weight downstream, so they are numerically
+    invisible: weighted reductions add exact zeros and the metric kernels
+    already treat zero-weight rows as excluded.  Returns (sharded device
+    array, original length)."""
+    import jax.numpy as jnp
+
+    x = np.asarray(x)
+    n_data = int(mesh.shape[DATA_AXIS])
+    padded, n = pad_to_multiple(x, n_data, axis=axis, fill=fill)
+    spec = [None] * padded.ndim
+    spec[axis] = DATA_AXIS
+    return jax.device_put(jnp.asarray(padded), NamedSharding(mesh, P(*spec))), n
+
+
+# ---------------------------------------------------------------------------
+# Collectives with trace-time telemetry.
+#
+# ``mesh_psum`` / ``mesh_all_gather`` are what the row-sharded fragment
+# interpreters call instead of raw ``lax`` collectives: identity when no axis
+# name is given (so the same kernel serves the replicated path), and each call
+# appends (kind, axis, payload bytes) to the active ``trace_collectives``
+# collector *at trace time*.  The launch layer wraps program lowering in the
+# collector and replays the recorded set into utils/flops on every call —
+# giving per-axis collective accounting without parsing HLO.  Sites inside
+# scan/fori_loop bodies are traced once and therefore counted once (the same
+# caveat utils/flops documents for FLOPs under lax.scan); vmap batch factors
+# are likewise not multiplied into the payload bytes.
+# ---------------------------------------------------------------------------
+
+# thread-local: the sweep launcher AOT-compiles per-model-column programs
+# concurrently, and each compiling thread must collect only its own trace
+_TRACE_TLS = threading.local()
+
+
+@contextlib.contextmanager
+def trace_collectives() -> Iterator[List[Tuple[str, str, int]]]:
+    """Collect (kind, axis, bytes) for every mesh collective traced inside."""
+    prev = getattr(_TRACE_TLS, "sink", None)
+    sink: List[Tuple[str, str, int]] = []
+    _TRACE_TLS.sink = sink
+    try:
+        yield sink
+    finally:
+        _TRACE_TLS.sink = prev
+
+
+def _record_collective(kind: str, axis_name: str, x) -> None:
+    sink = getattr(_TRACE_TLS, "sink", None)
+    if sink is None:
+        return
+    try:
+        nbytes = int(np.prod(x.shape)) * x.dtype.itemsize
+    except Exception:
+        nbytes = 0
+    sink.append((kind, axis_name, nbytes))
+
+
+def mesh_psum(x, axis_name: Optional[str]):
+    """``lax.psum`` over ``axis_name``; identity when ``axis_name`` is None.
+
+    The single entry point the fused-fragment kernels use for cross-row
+    reductions: Gram/normal-equation blocks, gradient/hessian histograms,
+    per-fold accumulators.  Calling with None keeps the replicated path
+    byte-for-byte identical to the pre-row-sharding kernels."""
+    if axis_name is None:
+        return x
+    from jax import lax
+
+    _record_collective("psum", axis_name, x)
+    return lax.psum(x, axis_name)
+
+
+def mesh_all_gather(x, axis_name: Optional[str], axis: int = 0):
+    """Tiled ``lax.all_gather`` over ``axis_name``; identity when None.
+
+    Used where a reduction cannot be expressed as a sum — the rank/sort-based
+    metrics (AuROC/AuPR) need the global row order reassembled."""
+    if axis_name is None:
+        return x
+    from jax import lax
+
+    _record_collective("all_gather", axis_name, x)
+    return lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# Mesh selection and row-sharding profitability policy.
+# ---------------------------------------------------------------------------
+
+
+def env_mesh() -> Optional[Mesh]:
+    """Mesh requested via TMOG_MESH ("DxM", e.g. "2x4"; bare "8" means 1x8).
+
+    Returns None when the variable is unset/empty or the device pool cannot
+    satisfy the request (so CI matrix entries degrade gracefully on smaller
+    hosts instead of erroring)."""
+    spec = os.environ.get("TMOG_MESH", "").strip().lower()
+    if not spec:
+        return None
+    try:
+        if "x" in spec:
+            d_s, m_s = spec.split("x", 1)
+            n_data, n_model = int(d_s), int(m_s)
+        else:
+            n_data, n_model = 1, int(spec)
+        if n_data < 1 or n_model < 1:
+            return None
+        return make_mesh(n_data=n_data, n_model=n_model)
+    except (ValueError, RuntimeError):
+        return None
+
+
+def min_rows_per_shard() -> int:
+    """Fewest rows per data shard worth the collective round-trips."""
+    try:
+        return max(int(os.environ.get("TMOG_MIN_ROWS_PER_SHARD",
+                                      DEFAULT_MIN_ROWS_PER_SHARD)), 1)
+    except ValueError:
+        return DEFAULT_MIN_ROWS_PER_SHARD
+
+
+def rowshard_viable(n_rows: int, n_data: int) -> bool:
+    """Whether a row-sharded launch over ``n_data`` shards is profitable.
+
+    The validator falls back to the replicated sweep (and records the reason
+    in ``ops.sweep.run_stats()``) when this is False."""
+    return n_data > 1 and n_rows >= n_data * min_rows_per_shard()

@@ -31,7 +31,9 @@ import jax
 _enabled: bool = bool(int(os.environ.get("TMOG_COUNT_FLOPS", "0") or 0))
 _totals: Dict[str, float] = {"flops": 0.0, "bytes_accessed": 0.0, "calls": 0.0}
 _by_fn: Dict[str, Dict[str, Any]] = {}
-_by_device: Dict[str, Dict[str, float]] = {}
+_by_device: Dict[str, Dict[str, Any]] = {}
+#: per-axis collective traffic: axis -> {"count", "bytes", "<kind>_count"}
+_collectives: Dict[str, Dict[str, float]] = {}
 _cost_cache: Dict[Tuple, Optional[Dict[str, float]]] = {}
 
 
@@ -53,6 +55,7 @@ def reset() -> None:
     _totals.update(flops=0.0, bytes_accessed=0.0, calls=0.0)
     _by_fn.clear()
     _by_device.clear()
+    _collectives.clear()
 
 
 def totals() -> Dict[str, Any]:
@@ -63,15 +66,53 @@ def totals() -> Dict[str, Any]:
     shard/per chunk under DIFFERENT shapes (the partitioned sweep does
     exactly this) stays auditable: sum of by_shape calls == entry calls.
     ``by_device`` splits the same totals by the device label the caller
-    attributed the launch to (multi-chip runs; empty on unattributed runs).
+    attributed the launch to (multi-chip runs; empty on unattributed runs);
+    a device that ran collective-bearing programs additionally carries a
+    ``collectives`` sub-dict.  Top-level ``collectives`` maps mesh axis ->
+    {"count", "bytes", "psum_count", "all_gather_count"} — the row-sharded
+    sweep's communication claim, auditable per axis (bytes are trace-time
+    payload sizes: loop bodies counted once, vmap batch factors excluded).
     """
     out: Dict[str, Any] = dict(_totals)
     out["by_fn"] = {
         k: {"flops": v["flops"], "calls": v["calls"],
             "by_shape": {s: dict(c) for s, c in v["by_shape"].items()}}
         for k, v in _by_fn.items()}
-    out["by_device"] = {k: dict(v) for k, v in _by_device.items()}
+    out["by_device"] = {
+        k: {kk: (dict(vv) if isinstance(vv, dict) else vv)
+            for kk, vv in v.items()}
+        for k, v in _by_device.items()}
+    out["collectives"] = {k: dict(v) for k, v in _collectives.items()}
     return out
+
+
+def record_collectives(colls, device=None) -> None:
+    """Accumulate ONE launch's worth of traced mesh collectives.
+
+    ``colls`` is the (kind, axis, bytes) list captured by
+    ``parallel.mesh.trace_collectives`` around the program's lowering; the
+    launcher replays it here per call so per-axis counts and bytes scale
+    with launches just like FLOPs do.  No-op unless enabled."""
+    if not _enabled or not colls:
+        return
+    for kind, axis, nbytes in colls:
+        agg = _collectives.setdefault(
+            axis, {"count": 0.0, "bytes": 0.0})
+        agg["count"] += 1
+        agg["bytes"] += nbytes
+        agg[f"{kind}_count"] = agg.get(f"{kind}_count", 0.0) + 1
+        if device is not None:
+            dv = _by_device.setdefault(str(device),
+                                       {"flops": 0.0, "calls": 0.0})
+            dcoll = dv.setdefault("collectives", {})
+            dax = dcoll.setdefault(axis, {"count": 0.0, "bytes": 0.0})
+            dax["count"] += 1
+            dax["bytes"] += nbytes
+
+
+def collective_totals() -> Dict[str, Dict[str, float]]:
+    """Per-axis collective traffic (same shape as totals()["collectives"])."""
+    return {k: dict(v) for k, v in _collectives.items()}
 
 
 def _signature(args, kwargs) -> Tuple:
