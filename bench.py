@@ -186,6 +186,43 @@ def make_selector(seed: int = 42):
         num_folds=3, seed=seed)
 
 
+def family_flops_breakdown(sel, X, y, train_w, val_mask):
+    """Per-family single-launch XLA flops of the default sweep (LR/RF/XGB).
+
+    Each family's fragment subset is lowered STANDALONE at the bench's exact
+    fold shapes via ``flops.cost_of`` (no accumulation into the running
+    totals), so the one ``sweep.run`` bucket decomposes into who actually
+    burns the FLOPs.  Returns {} when the fused builder declines a family.
+    """
+    from transmogrifai_tpu.impl.sweep_fragments import build_sweep_plan
+    from transmogrifai_tpu.ops.sweep import _run
+    from transmogrifai_tpu.utils import flops
+
+    fam_of = {"OpLogisticRegression": "LR", "OpLinearRegression": "LR",
+              "OpRandomForestClassifier": "RF", "OpRandomForestRegressor": "RF",
+              "OpDecisionTreeClassifier": "RF", "OpDecisionTreeRegressor": "RF",
+              "OpGBTClassifier": "XGB", "OpXGBoostClassifier": "XGB",
+              "OpGBTRegressor": "XGB", "OpXGBoostRegressor": "XGB"}
+    tw = np.asarray(train_w, np.float32)
+    vw = np.asarray(val_mask, np.float32)
+    fams = {}
+    for est, grids in sel.models:
+        label = fam_of.get(type(est).__name__, "other")
+        try:
+            plan = build_sweep_plan([(est, grids)], X, y, tw,
+                                    sel.validator.evaluator)
+            if plan is None:
+                continue
+            cost = flops.cost_of(_run, plan.spec, plan.X, tuple(plan.xbs),
+                                 plan.y, tw, vw, plan.blob)
+        except Exception:
+            continue
+        if cost is None:
+            continue
+        fams[label] = fams.get(label, 0.0) + cost["flops"]
+    return {k: round(v) for k, v in fams.items()}
+
+
 def main():
     platform, fallback = init_backend()
 
@@ -244,6 +281,17 @@ def main():
         "sweep_shards": sweep_stats["sweep_shards"],
         "data_shards": sweep_stats["data_shards"],
     }
+    # round-collapse visibility: the longest sequential GBT level chain in
+    # the sweep (steps x depth); K=4 collapse turns the reference 200x10 =
+    # 2000 levels into 500
+    if sweep_stats.get("gbt_chain_levels"):
+        out["gbt_sequential_launches"] = sweep_stats["gbt_chain_levels"]
+        out["gbt_chain_steps"] = sweep_stats["gbt_chain_steps"]
+    hs = acct.get("hist_subtracted") or {}
+    if hs.get("levels"):
+        out["hist_subtracted_per_rep"] = {
+            "levels": round(hs["levels"] / reps),
+            "flops_avoided": round(hs["flops_avoided"] / reps)}
     per_shard = [s for l in sweep_stats["launches"] if l["shards"] > 1
                  for s in l["per_shard"]]
     if per_shard:
@@ -279,6 +327,20 @@ def main():
         out["flops_per_rep"] = round(flops_per_rep)
         out["flops_by_kernel"] = {k: round(v["flops"] / reps)
                                   for k, v in acct["by_fn"].items()}
+        # decompose the single fused sweep.run bucket per model family by
+        # lowering each family's fragment subset standalone at the same
+        # shapes; residual (metrics glue, XLA fusion deltas) stays labeled
+        tw, vm = sel.validator.make_folds(X.shape[0], y)
+        fam = family_flops_breakdown(sel, X, y, tw, vm)
+        if fam:
+            out["flops_by_family"] = fam
+            if "sweep.run" in out["flops_by_kernel"]:
+                total = out["flops_by_kernel"].pop("sweep.run")
+                for k, v in sorted(fam.items()):
+                    out["flops_by_kernel"][f"sweep.run[{k}]"] = v
+                rest = round(total - sum(fam.values()))
+                if rest > 0:
+                    out["flops_by_kernel"]["sweep.run[other]"] = rest
         peak = PEAK_FLOPS.get(device_kind)
         if platform != "cpu" and peak:
             out["mfu"] = round(flops_per_rep / dt / peak, 6)

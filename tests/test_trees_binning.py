@@ -1,0 +1,105 @@
+"""Bin-id dtype boundaries and frontier sizing edges (ops/trees).
+
+Regression pins for two silent-overflow classes:
+
+- ``_bin_dtype``: int8 holds ids 0..127, so ``n_bins == 128`` must stay
+  int8 (the old ``<= 127`` comparison promoted it needlessly) and 129+
+  must promote — an off-by-one the other way would wrap bin 128 to -128
+  and quantize garbage.
+- ``frontier_cap`` / ``frontier_is_exact``: the beam math at degenerate
+  depths, heavy min_child_weight, tiny n, and ``_next_pow2`` at exact
+  powers of two (where an off-by-one doubles every frontier).
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.ops import trees as Tr
+
+
+class TestBinDtype:
+    def test_int8_through_128(self):
+        assert Tr._bin_dtype(2) == np.int8
+        assert Tr._bin_dtype(127) == np.int8
+        assert Tr._bin_dtype(128) == np.int8
+
+    def test_promotes_beyond_int8(self):
+        assert Tr._bin_dtype(129) == np.int32
+        assert Tr._bin_dtype(255) == np.int32
+        assert Tr._bin_dtype(256) == np.int32
+
+    @pytest.mark.parametrize("n_bins", [1, 0, -3])
+    def test_rejects_degenerate(self, n_bins):
+        with pytest.raises(ValueError):
+            Tr._bin_dtype(n_bins)
+
+    @pytest.mark.parametrize("n_bins", [127, 128, 255, 256])
+    def test_quantize_uses_full_range_without_overflow(self, n_bins):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(4096, 3)).astype(np.float32)
+        Xb, edges = Tr.quantize(X, n_bins)
+        assert Xb.dtype == Tr._bin_dtype(n_bins)
+        assert edges.shape == (3, n_bins - 1)
+        # ids live in [0, n_bins); a wrapped int8 would show up negative
+        assert int(Xb.min()) >= 0
+        assert int(Xb.max()) == n_bins - 1  # top bin reachable, not clipped
+
+    def test_bin_with_edges_matches_quantize(self):
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(512, 2)).astype(np.float32)
+        Xb, edges = Tr.quantize(X, 128)
+        np.testing.assert_array_equal(np.asarray(Xb),
+                                      np.asarray(Tr.bin_with_edges(X, edges)))
+
+    def test_binning_monotone(self):
+        x = np.sort(np.random.default_rng(2).normal(size=1000)
+                    ).astype(np.float32)[:, None]
+        Xb, _ = Tr.quantize(x, 128)
+        assert (np.diff(np.asarray(Xb)[:, 0]) >= 0).all()
+
+
+class TestNextPow2:
+    @pytest.mark.parametrize("p", [2, 4, 8, 16, 256, 512])
+    def test_fixed_points_at_powers_of_two(self, p):
+        assert Tr._next_pow2(p) == p
+
+    @pytest.mark.parametrize("x,want", [(1, 2), (3, 4), (5, 8), (9, 16),
+                                        (257, 512)])
+    def test_rounds_up_between(self, x, want):
+        assert Tr._next_pow2(x) == want
+
+
+class TestFrontierCap:
+    def test_trivial_depths_floor_at_two(self):
+        assert Tr.frontier_cap(1000, 0) == 2
+        assert Tr.frontier_cap(1000, 1) == 2
+
+    def test_full_unroll_small_depth(self):
+        # 2^max_depth binds: the tree is fully unrolled
+        assert Tr.frontier_cap(10_000, 3) == 8
+        assert Tr.frontier_is_exact(10_000, 3, 1.0, 1.0, 8)
+
+    def test_heavy_mcw_shrinks_frontier(self):
+        # ceil(1.25 * 100 / 50) = 3 valid splitters -> next pow2 = 4
+        assert Tr.frontier_cap(100, 6, min_child_weight=50.0) == 4
+        assert Tr.frontier_is_exact(100, 6, 50.0, 1.0, 4)
+        assert not Tr.frontier_is_exact(100, 6, 50.0, 1.0, 2)
+
+    def test_mcw_beyond_total_weight_floors_at_two(self):
+        assert Tr.frontier_cap(100, 6, min_child_weight=1000.0) == 2
+
+    def test_tiny_n_caps_at_next_pow2_of_n(self):
+        # n=4 rows can't occupy more than 4 leaves however deep the tree
+        assert Tr.frontier_cap(4, 10) == 4
+
+    def test_total_weight_overrides_row_count(self):
+        # actual weight sum 10 -> 10 splitters -> 16 slots, despite n=100
+        assert Tr.frontier_cap(100, 8, total_weight=10.0) == 16
+        assert Tr.frontier_is_exact(100, 8, 1.0, 1.0, 16, total_weight=10.0)
+        # the 1.25*n fallback would need 128 slots for the same call
+        assert not Tr.frontier_is_exact(100, 8, 1.0, 1.0, 16)
+
+    @pytest.mark.parametrize("n,depth,mcw", [(7, 4, 1.0), (100, 6, 50.0),
+                                             (891, 12, 1.0), (4, 10, 1.0)])
+    def test_always_power_of_two_and_at_least_two(self, n, depth, mcw):
+        m = Tr.frontier_cap(n, depth, mcw)
+        assert m >= 2 and (m & (m - 1)) == 0

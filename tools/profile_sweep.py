@@ -63,6 +63,22 @@ def timed(name, candidates, reps=3):
     return dt
 
 
+def _print_gbt_telemetry(sweep_ops) -> None:
+    """Critical-path telemetry: sequential GBT chain + histogram subtraction."""
+    from transmogrifai_tpu.utils import flops
+    chains = [l["gbt_chain"] for l in sweep_ops.run_stats()["launches"]
+              if l.get("gbt_chain")]
+    if chains:
+        ch = max(chains, key=lambda c: c["levels"])
+        print(f"gbt chain: {ch['steps']} sequential boosting steps = "
+              f"{ch['levels']} levels (TMOG_GBT_ROUND_COLLAPSE shortens)")
+    hs = flops.hist_subtracted_totals()
+    if hs.get("levels"):
+        print(f"hist subtraction: {hs['levels']} level-builds halved, "
+              f"~{hs['flops_avoided']:,} hist flops avoided "
+              "(TMOG_HIST_SUBTRACT=0 disables)")
+
+
 def profile_shards(n_shards: int, reps: int = 3) -> None:
     """Predicted vs measured per-shard cost of the default 28-candidate grid."""
     import jax
@@ -83,6 +99,11 @@ def profile_shards(n_shards: int, reps: int = 3) -> None:
     if plan is None:
         print("default grid did not build a fused plan; nothing to profile")
         return
+    from transmogrifai_tpu.ops import sweep as sweep_ops
+    from transmogrifai_tpu.utils import flops
+    flops.enable()
+    flops.reset()
+    sweep_ops.reset_run_stats()
     shards = partition_spec(plan.spec, plan.blob, n_shards, plan.n_rows,
                             plan.n_features, F)
     mx, mean = predicted_balance(shards)
@@ -108,6 +129,8 @@ def profile_shards(n_shards: int, reps: int = 3) -> None:
               f"{sh.cost / max(mean, 1e-9):9.3f} {w:10.4f} "
               f"{w / max(wmean, 1e-9):9.3f}")
     print(f"measured max/mean={max(walls) / max(wmean, 1e-9):.3f}")
+    _print_gbt_telemetry(sweep_ops)
+    flops.disable()
 
 
 def profile_rowsharded(n_data: int, n_model: int, reps: int = 3) -> None:
@@ -134,9 +157,12 @@ def profile_rowsharded(n_data: int, n_model: int, reps: int = 3) -> None:
     if plan is None:
         print("default grid did not build a fused plan; nothing to profile")
         return
+    from transmogrifai_tpu.utils import flops
     mesh = make_mesh(n_data=n_data, n_model=n_model)
     single = plan.run(train_w, val_mask)
     sweep_ops.reset_run_stats()
+    flops.enable()
+    flops.reset()
     mrs = plan.run_rowsharded(train_w, val_mask, mesh)  # warm (compiles)
     diff = np.max(np.abs(mrs - single))
     print(f"mesh {n_data}x{n_model}: parity max|diff|={diff:.3g} "
@@ -172,6 +198,8 @@ def profile_rowsharded(n_data: int, n_model: int, reps: int = 3) -> None:
     print(f"per-device X+y bytes: rowsharded={pdb['X'] + pdb['y']:,} "
           f"replicated={pdb['X_replicated'] + pdb['y_replicated']:,} "
           f"(x{(pdb['X_replicated'] + pdb['y_replicated']) / max(pdb['X'] + pdb['y'], 1):.2f} saved)")
+    _print_gbt_telemetry(sweep_ops)
+    flops.disable()
 
 
 if args.data_shards > 0:
@@ -192,3 +220,6 @@ for dep, gs in sorted(by_depth.items()):
     timed(f"RF depth={dep} x{len(gs)}", [(OpRandomForestClassifier(), gs)])
 timed("RF all x18", [(OpRandomForestClassifier(), rf)])
 timed("XGB x2", [(OpXGBoostClassifier(), D.xgboost_grid())])
+
+from transmogrifai_tpu.ops import sweep as sweep_ops  # noqa: E402
+_print_gbt_telemetry(sweep_ops)

@@ -24,6 +24,7 @@ from ..selector.predictor import PredictorEstimator
 from ..trees_common import (DEFAULT_MAX_FRONTIER, DEFAULT_MAX_FRONTIER_BOOSTED,
                             TreeParamsMixin,
                             boosted_grid_folds as _boosted_grid_folds,
+                            effective_trees_per_round,
                             forest_grid_folds as _forest_grid_folds,
                             gbt_boost_params, tree_from_params, tree_params,
                             xgb_boost_params)
@@ -204,6 +205,11 @@ class _BoostedClassifierBase(_TreeClassifierBase):
         fms = Tr.feature_masks(kf, d, bp["n_rounds"], bp["colsample"])
         loss = "logistic" if k == 2 else "softmax"
         frontier = self._frontier(n, bp["max_depth"], bp["min_child_weight"], 0.25)
+        # round-collapse: K trees per boosting step at eta / K; predict_gbt
+        # applies the stored eta uniformly over the stacked trees, so the
+        # stored eta is the per-tree one
+        k_eff = effective_trees_per_round(bp.get("trees_per_round", 1),
+                                          bp["n_rounds"])
         trees, _ = Tr.fit_gbt(jnp.asarray(Xb), _as_f32(y), jnp.asarray(sw),
                               jnp.asarray(rw), jnp.asarray(fms), loss=loss,
                               n_rounds=bp["n_rounds"], max_depth=bp["max_depth"],
@@ -212,9 +218,10 @@ class _BoostedClassifierBase(_TreeClassifierBase):
                               reg_lambda=bp["reg_lambda"], gamma=bp["gamma"],
                               min_child_weight=bp["min_child_weight"],
                               n_classes=k,
-                              min_info_gain=bp.get("min_info_gain", 0.0))
+                              min_info_gain=bp.get("min_info_gain", 0.0),
+                              trees_per_round=k_eff)
         return tree_params(trees, edges=edges, max_depth=bp["max_depth"],
-                           eta=bp["eta"], num_classes=k, loss=loss)
+                           eta=bp["eta"] / k_eff, num_classes=k, loss=loss)
 
     @staticmethod
     def _margins_to_preds(loss: str, F: np.ndarray

@@ -17,6 +17,7 @@ from ..selector.predictor import PredictorEstimator
 from ..trees_common import (DEFAULT_MAX_FRONTIER, DEFAULT_MAX_FRONTIER_BOOSTED,
                             TreeParamsMixin,
                             boosted_grid_folds as _boosted_grid_folds,
+                            effective_trees_per_round,
                             forest_grid_folds as _forest_grid_folds,
                             gbt_boost_params, tree_from_params, tree_params,
                             xgb_boost_params)
@@ -146,6 +147,10 @@ class _BoostedRegressorBase(_TreeRegressorBase):
         fms = Tr.feature_masks(kf, d, bp["n_rounds"], bp["colsample"])
         base = float(np.average(y, weights=np.maximum(sw, 1e-12)))
         frontier = self._frontier(n, bp["max_depth"], bp["min_child_weight"])
+        # round-collapse: K trees per boosting step at eta / K; the stored
+        # eta is the per-tree one (predict_gbt applies it to every tree)
+        k_eff = effective_trees_per_round(bp.get("trees_per_round", 1),
+                                          bp["n_rounds"])
         trees, _ = Tr.fit_gbt(jnp.asarray(Xb), jnp.asarray(np.asarray(y, np.float32)),
                               jnp.asarray(sw), jnp.asarray(rw), jnp.asarray(fms),
                               loss="squared", n_rounds=bp["n_rounds"],
@@ -155,9 +160,10 @@ class _BoostedRegressorBase(_TreeRegressorBase):
                               gamma=bp["gamma"],
                               min_child_weight=bp["min_child_weight"],
                               base_score=base,
-                              min_info_gain=bp.get("min_info_gain", 0.0))
+                              min_info_gain=bp.get("min_info_gain", 0.0),
+                              trees_per_round=k_eff)
         return tree_params(trees, edges=edges, max_depth=bp["max_depth"],
-                           eta=bp["eta"], base_score=base)
+                           eta=bp["eta"] / k_eff, base_score=base)
 
     @classmethod
     def predict_arrays(cls, params: Dict[str, Any], X: np.ndarray

@@ -39,7 +39,8 @@ from ..ops.metrics import (BINARY_METRICS, MULTICLASS_METRICS,
                            REGRESSION_METRICS)
 from ..utils import devcache
 from .trees_common import (DEFAULT_MAX_FRONTIER, DEFAULT_MAX_FRONTIER_BOOSTED,
-                           _DYNAMIC_BOOST_KEYS, _FOREST_GRID_KEYS)
+                           _DYNAMIC_BOOST_KEYS, _FOREST_GRID_KEYS,
+                           effective_trees_per_round)
 
 log = logging.getLogger(__name__)
 
@@ -242,9 +243,18 @@ def _forest_group_cost(group, n: int, d: int, F: int) -> float:
 def _gbt_group_cost(group, n: int, d: int, F: int) -> float:
     _, rounds, depth, _, n_bins, *_rest = group
     frontier = group[8]
+    k = max(int(group[11]), 1)
+    # histogram subtraction builds only the light sibling below the root:
+    # the matmul (MB) term halves for every level past the first
+    level_sum = _tree_level_sum(depth, frontier)
+    if Tr._hist_subtract() and depth > 1:
+        level_sum = 1.0 + (level_sum - 1.0) * 0.5
     per_tree = (TREE_LEVEL_ND * depth * n * d
-                + TREE_LEVEL_MB * _tree_level_sum(depth, frontier) * d * n_bins)
-    return F * per_tree * (1.0 + rounds / GBT_ROUNDS_REF)
+                + TREE_LEVEL_MB * level_sum * d * n_bins)
+    # round-collapse: K trees per step, rounds / K sequential steps — the
+    # per-launch constant term scales with the SHORTER chain while total
+    # tree work (K * rounds / K) is unchanged
+    return F * k * per_tree * (1.0 + (rounds / k) / GBT_ROUNDS_REF)
 
 
 def spec_units(spec, n: int, d: int, F: int) -> List[SweepUnit]:
@@ -319,11 +329,11 @@ def _split_forest_group(group, picks: List[int], local: Dict[int, int],
 def _split_gbt_group(group, picks: List[int], local: Dict[int, int],
                      blob: np.ndarray, out_blob: "_Blob"):
     (cis, rounds, depth, xb_idx, n_bins, subsample, colsample, seed,
-     frontier, exact_cap, fold_base, off_eta, off_lam, off_gam, off_mcw,
-     off_mig) = group
+     frontier, exact_cap, fold_base, trees_per_round, off_eta, off_lam,
+     off_gam, off_mcw, off_mig) = group
     new_cis = tuple(local[cis[p]] for p in picks)
     return (new_cis, rounds, depth, xb_idx, n_bins, subsample, colsample,
-            seed, frontier, exact_cap, fold_base,
+            seed, frontier, exact_cap, fold_base, trees_per_round,
             out_blob.add(blob[[off_eta + p for p in picks]]),
             out_blob.add(blob[[off_lam + p for p in picks]]),
             out_blob.add(blob[[off_gam + p for p in picks]]),
@@ -581,7 +591,8 @@ def _softmax_fragments(est, grids, pos: int, blob: _Blob) -> Optional[List]:
 def _gbt_fragment(est, grids, pos: int, blob: _Blob, xbs, X, train_w,
                   loss: str, n_classes: int = 2) -> Optional[List]:
     static_keys = ("num_round", "max_iter", "max_depth", "max_bins",
-                   "subsample", "subsampling_rate", "colsample_bytree")
+                   "subsample", "subsampling_rate", "colsample_bytree",
+                   "trees_per_round")
     for g in grids:
         for k in g:
             if k not in _DYNAMIC_BOOST_KEYS and k not in static_keys:
@@ -591,15 +602,25 @@ def _gbt_fragment(est, grids, pos: int, blob: _Blob, xbs, X, train_w,
     bps = [c._boost_params() for c in cands]
     groups: Dict[tuple, List[int]] = {}
     for i, bp in enumerate(bps):
+        k_req = int(bp.get("trees_per_round", 1))
+        k_eff = effective_trees_per_round(k_req, bp["n_rounds"])
+        if k_req > 1 and k_eff == 1:
+            # declined round-collapse for this candidate (K must divide
+            # rounds) — audit-trail it like the other graceful degradations
+            from ..ops import sweep as sweep_ops
+            sweep_ops.record_fallback(
+                "gbt_rounds_not_collapsible", requested=k_req,
+                n_rounds=int(bp["n_rounds"]))
         key = (bp["n_rounds"], bp["max_depth"], bp["n_bins"],
                float(bp["subsample"]), float(bp["colsample"]),
-               int(cands[i].get_param("seed", 42)))
+               int(cands[i].get_param("seed", 42)), k_eff)
         groups.setdefault(key, []).append(i)
     fold_sum = float(np.asarray(train_w, np.float32).sum(axis=1).max())
     h_max = 0.25 if loss in ("logistic", "softmax") else 1.0
     fold_base = loss == "squared"
     out_groups = []
-    for (rounds, depth, n_bins, subsample, colsample, seed), idxs in groups.items():
+    for (rounds, depth, n_bins, subsample, colsample, seed,
+         k_eff), idxs in groups.items():
         mcw_min = min(bps[i]["min_child_weight"] for i in idxs)
         frontier = Tr.frontier_cap(
             n, depth, mcw_min, h_max=h_max,
@@ -611,7 +632,7 @@ def _gbt_fragment(est, grids, pos: int, blob: _Blob, xbs, X, train_w,
         out_groups.append((
             tuple(int(pos + i) for i in idxs), rounds, depth,
             _xb_index(xbs, X, n_bins), n_bins, subsample, colsample, seed,
-            frontier, exact, fold_base,
+            frontier, exact, fold_base, k_eff,
             blob.add([bps[i]["eta"] for i in idxs]),
             blob.add([bps[i]["reg_lambda"] for i in idxs]),
             blob.add([bps[i]["gamma"] for i in idxs]),

@@ -8,6 +8,7 @@ subsamplingRate) and the boosting params of OpGBT*/OpXGBoost* wrappers.
 from __future__ import annotations
 
 import math
+import os
 from typing import Any, Dict
 
 _SUBSET_STRATEGIES = ("auto", "all", "sqrt", "log2", "onethird")
@@ -22,6 +23,29 @@ _SUBSET_STRATEGIES = ("auto", "all", "sqrt", "log2", "onethird")
 #: min-child-weight settings.
 DEFAULT_MAX_FRONTIER = 256
 DEFAULT_MAX_FRONTIER_BOOSTED = 256
+
+
+def round_collapse_default() -> int:
+    """Env default for the boosted-forest round-collapse factor K
+    (``TMOG_GBT_ROUND_COLLAPSE``; 1 = off, the exact per-round scan).
+    K > 1 grows K trees per boosting step against shared gradients at
+    learning rate eta / K, cutting the sequential scan to rounds / K steps
+    (ops/trees._gbt_impl / _gbt_batch_impl)."""
+    try:
+        return max(int(os.environ.get("TMOG_GBT_ROUND_COLLAPSE", "1") or 1), 1)
+    except ValueError:
+        return 1
+
+
+def effective_trees_per_round(k: int, n_rounds: int) -> int:
+    """Clamp a requested collapse factor to one the kernel honors: K must
+    exceed 1, not exceed ``n_rounds``, and divide it exactly (the boosting
+    scan reshapes rounds -> [rounds / K, K]).  Returns 1 (no collapse)
+    otherwise — callers that care record a fallback."""
+    k = int(k)
+    if k <= 1 or k > n_rounds or n_rounds % k:
+        return 1
+    return k
 
 
 def tree_params(tree, **extra) -> Dict[str, Any]:
@@ -88,7 +112,9 @@ def gbt_boost_params(stage) -> Dict[str, Any]:
             "subsample": float(stage.get_param("subsampling_rate", 1.0)),
             "colsample": 1.0, "reg_lambda": 1e-6, "gamma": 0.0,
             "min_child_weight": float(stage.get_param("min_instances_per_node", 1)),
-            "min_info_gain": float(stage.get_param("min_info_gain", 0.0))}
+            "min_info_gain": float(stage.get_param("min_info_gain", 0.0)),
+            "trees_per_round": int(stage.get_param("trees_per_round",
+                                                   round_collapse_default()))}
 
 
 #: boosting hyperparameters that are traced scalars in the kernel — grids
@@ -122,7 +148,8 @@ def boosted_grid_folds(est, X, y, train_w, grids, loss: str, n_classes: int,
             # the per-candidate fallback loop
             if key not in _DYNAMIC_BOOST_KEYS and key not in (
                     "num_round", "max_iter", "max_depth", "max_bins",
-                    "subsample", "subsampling_rate", "colsample_bytree"):
+                    "subsample", "subsampling_rate", "colsample_bytree",
+                    "trees_per_round"):
                 raise NotImplementedError(f"non-batchable boosting grid key {key}")
 
     n_folds = train_w.shape[0]
@@ -131,11 +158,14 @@ def boosted_grid_folds(est, X, y, train_w, grids, loss: str, n_classes: int,
     groups: Dict[tuple, list] = {}
     for ci, bp in enumerate(bps):
         static = (bp["n_rounds"], bp["max_depth"], bp["n_bins"],
-                  bp["subsample"], bp["colsample"])
+                  bp["subsample"], bp["colsample"],
+                  effective_trees_per_round(bp.get("trees_per_round", 1),
+                                            bp["n_rounds"]))
         groups.setdefault(static, []).append(ci)
 
     h_max = 0.25 if loss in ("logistic", "softmax") else 1.0
-    for (n_rounds, max_depth, n_bins, subsample, colsample), cis in groups.items():
+    for (n_rounds, max_depth, n_bins, subsample, colsample,
+         k_eff), cis in groups.items():
         Xb, _ = Tr.quantize(X, n_bins)
         ks, kfm = Tr.rng_keys(int(est.get_param("seed", 42)))
         rw = Tr.subsample_weights(ks, n, n_rounds, subsample)
@@ -191,7 +221,8 @@ def boosted_grid_folds(est, X, y, train_w, grids, loss: str, n_classes: int,
             eta_b=eta_dev, reg_lambda_b=lam_dev,
             gamma_b=gam_dev, min_child_weight_b=mcw_dev,
             base_score_b=base_dev, n_classes=n_classes,
-            min_info_gain_b=mig_dev, exact_cap=exact_cap)
+            min_info_gain_b=mig_dev, exact_cap=exact_cap,
+            trees_per_round=k_eff)
         F = np.asarray(F)[:B]
         for bi, (f, ci) in enumerate((f, ci) for f in range(n_folds) for ci in cis):
             out[f][ci] = convert(F[bi])
@@ -336,4 +367,6 @@ def xgb_boost_params(stage) -> Dict[str, Any]:
             "colsample": float(stage.get_param("colsample_bytree", 1.0)),
             "reg_lambda": float(stage.get_param("reg_lambda", 1.0)),
             "gamma": float(stage.get_param("gamma", 0.0)),
-            "min_child_weight": float(stage.get_param("min_child_weight", 1.0))}
+            "min_child_weight": float(stage.get_param("min_child_weight", 1.0)),
+            "trees_per_round": int(stage.get_param("trees_per_round",
+                                                   round_collapse_default()))}

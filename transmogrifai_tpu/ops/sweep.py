@@ -36,8 +36,12 @@ hyperparameter ``blob``; ``xb_idx`` picks the pre-binned matrix in ``xbs``:
                     bootstrap, seed, frontier, exact_cap, chunk,
                     off_mcw, off_mig)
     gbt group    = (cis, rounds, depth, xb_idx, n_bins, subsample, colsample,
-                    seed, frontier, exact_cap, fold_base,
+                    seed, frontier, exact_cap, fold_base, trees_per_round,
                     off_eta, off_lam, off_gam, off_mcw, off_mig)
+
+``trees_per_round`` (K) is the round-collapse factor: K > 1 shortens the
+boosting scan to rounds / K steps, growing K trees per step at eta / K
+(ops/trees._gbt_batch_impl).  K = 1 is the exact per-round scan.
 
 ``strict`` is the per-candidate 0/1 tuple choosing ``score > 0.5`` vs
 ``>= 0.5`` for the class decision (matches each family's host
@@ -262,8 +266,8 @@ def _gbt_group_scores(group, xbs, y, train_w, blob, loss: str, out_c: int,
                       rs=None):
     """One static boosting group -> final margins [F, Gc, n, c]."""
     (cis, rounds, depth, xb_idx, n_bins, subsample, colsample, seed,
-     frontier, exact_cap, fold_base, off_eta, off_lam, off_gam, off_mcw,
-     off_mig) = group
+     frontier, exact_cap, fold_base, trees_per_round, off_eta, off_lam,
+     off_gam, off_mcw, off_mig) = group
     Xb = xbs[xb_idx]
     n, d = Xb.shape
     F = train_w.shape[0]
@@ -296,6 +300,15 @@ def _gbt_group_scores(group, xbs, y, train_w, blob, loss: str, out_c: int,
     mcw_b = jnp.tile(mcw, F)
     mig_b = jnp.tile(mig, F)
     base_b = jnp.repeat(base_f, Gc)
+
+    if trees_per_round > 1:
+        # round-collapsed: one K-wide forest step per rounds/K scan steps
+        Fm = Tr._gbt_batch_impl(Xb, y, w_b, rw, fms, loss, rounds, depth,
+                                n_bins, frontier, eta_b, lam_b, gam_b, mcw_b,
+                                base_score_b=base_b, n_classes=out_c,
+                                min_info_gain_b=mig_b, exact_cap=exact_cap,
+                                axis_name=ax, trees_per_round=trees_per_round)
+        return Fm.reshape(F, Gc, n, -1)
 
     def one(w, e, l, ga, mc, ba, mi):
         _, Fm = Tr._gbt_impl(Xb, y, w, rw, fms, loss, rounds, depth, n_bins,
@@ -488,6 +501,28 @@ def _run_rs(spec, mesh, n_orig, X, xbs, y, train_w, val_w, blob):
 SPLIT_METRICS_ELEMS = 20_000_000
 
 
+#: kernel trace events (hist-subtraction savings) per (spec, n_rows).  jit
+#: caches traces, so only the FIRST execution of a program re-runs the
+#: Python-level ``record_trace_event`` calls — later calls (and ``.lower``
+#: for cost analysis) see an empty trace.  run_sweep captures the first
+#: trace here and replays it into utils/flops on every call, matching the
+#: per-call replay the AOT shard paths get from their cached (compiled,
+#: events) pairs.
+_TRACE_EVENT_CACHE: Dict[Tuple, Tuple] = {}
+
+
+def _replay_trace_events(spec, n: int, colls) -> None:
+    # keyed on the subtraction flag too: flipping TMOG_HIST_SUBTRACT
+    # mid-process must not replay the other configuration's savings
+    key = (spec, int(n), Tr._hist_subtract())
+    events = tuple(c for c in colls if c[0] == "hist_subtracted")
+    if events:
+        _TRACE_EVENT_CACHE[key] = events
+    else:
+        events = _TRACE_EVENT_CACHE.get(key, ())
+    flops.record_collectives(events)
+
+
 def run_sweep(spec, X, xbs: Tuple, y, train_w, val_w, blob):
     """Execute a fused sweep program; returns device metrics [F, C, M].
 
@@ -499,17 +534,24 @@ def run_sweep(spec, X, xbs: Tuple, y, train_w, val_w, blob):
     F = train_w.shape[0]
     k = spec[0][1] if isinstance(spec[0], tuple) else 1
     split = F * C * n * k > SPLIT_METRICS_ELEMS
-    _run_stats["launches"].append(
-        {"shards": 1, "candidates": C, "split": bool(split)})
+    entry = {"shards": 1, "candidates": C, "split": bool(split)}
+    chain = _spec_gbt_chain(spec)
+    if chain:
+        entry["gbt_chain"] = chain
+    _run_stats["launches"].append(entry)
     if split:
-        scores = _run_scores(spec, X, tuple(xbs), y, train_w, blob)
+        with mesh_mod.trace_collectives() as colls:
+            scores = _run_scores(spec, X, tuple(xbs), y, train_w, blob)
+        _replay_trace_events(spec, n, colls)
         out = _run_metrics(spec, y, scores, val_w)
         flops.record("sweep.run_scores", _run_scores, spec, X, tuple(xbs), y,
                      train_w, blob)
         flops.record("sweep.run_metrics", _run_metrics, spec, y, scores,
                      val_w)
         return out
-    out = _run(spec, X, tuple(xbs), y, train_w, val_w, blob)
+    with mesh_mod.trace_collectives() as colls:
+        out = _run(spec, X, tuple(xbs), y, train_w, val_w, blob)
+    _replay_trace_events(spec, n, colls)
     flops.record("sweep.run", _run, spec, X, tuple(xbs), y, train_w, val_w,
                  blob)
     return out
@@ -559,25 +601,63 @@ def run_stats() -> Dict[str, Any]:
             "sweep_shards": max((e["shards"] for e in launches), default=0),
             "data_shards": max((e.get("data_shards", 1) for e in launches),
                                default=0),
+            # longest post-collapse boosting chain any launch dispatched
+            "gbt_chain_steps": max(
+                (e.get("gbt_chain", {}).get("steps", 0) for e in launches),
+                default=0),
+            "gbt_chain_levels": max(
+                (e.get("gbt_chain", {}).get("levels", 0) for e in launches),
+                default=0),
             "fallbacks": [dict(e) for e in _run_stats["fallbacks"]]}
 
 
-def _aot(name: str, fn, spec, device, dyn_args) -> Tuple[Any, float]:
+def _aot(name: str, fn, spec, device, dyn_args) -> Tuple[Any, float, Tuple]:
     """AOT executable of ``fn`` for ``spec`` at these (device-committed)
-    arguments + compile seconds (0.0 on cache hit).  All ``dyn_args`` must be
-    committed to ``device`` so lowering bakes the placement in."""
+    arguments + compile seconds (0.0 on cache hit) + the program's traced
+    (kind, axis, bytes) event list (hist-subtraction savings etc., replayed
+    into utils/flops per call).  All ``dyn_args`` must be committed to
+    ``device`` so lowering bakes the placement in."""
     key = (name, spec, device, flops._signature(dyn_args, {}))
     with _aot_lock:
         hit = _aot_cache.get(key)
     if hit is not None:
-        return hit, 0.0
+        return hit[0], 0.0, hit[1]
     t0 = time.perf_counter()
-    compiled = fn.lower(spec, *dyn_args).compile()
+    with mesh_mod.trace_collectives() as colls:
+        compiled = fn.lower(spec, *dyn_args).compile()
     dt = time.perf_counter() - t0
     with _aot_lock:
         # a racing thread may have compiled the same key; keep the first
-        hit = _aot_cache.setdefault(key, compiled)
-    return hit, dt
+        hit = _aot_cache.setdefault(key, (compiled, tuple(colls)))
+    return hit[0], dt, hit[1]
+
+
+def _spec_gbt_chain(spec) -> Optional[Dict[str, int]]:
+    """Longest sequential boosting chain in ``spec``: {"steps", "levels"} —
+    scan steps and dependent tree levels AFTER round-collapse (gbt group
+    index 11 = trees_per_round).  None when the spec has no gbt fragment.
+    This is the critical-path telemetry the bench reports as
+    ``gbt_sequential_launches``."""
+    steps = levels = 0
+    for frag in spec[1]:
+        if frag[0] != "gbt":
+            continue
+        for g in frag[3]:
+            k = max(int(g[11]), 1)
+            s = -(-int(g[1]) // k)
+            steps = max(steps, s)
+            levels = max(levels, s * int(g[2]))
+    if steps == 0:
+        return None
+    return {"steps": steps, "levels": levels}
+
+
+def _max_gbt_chain(specs) -> Optional[Dict[str, int]]:
+    chains = [c for c in (_spec_gbt_chain(s) for s in specs) if c]
+    if not chains:
+        return None
+    return {"steps": max(c["steps"] for c in chains),
+            "levels": max(c["levels"] for c in chains)}
 
 
 def _shard_arrays(shard, dev, X, xbs, y, X_host, y_host, xb_bins):
@@ -641,21 +721,21 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
         records = []
         if split:
             args_s = (Xd, xbs_d, yd, tw, bl)
-            cs, dt_s = _aot("sweep.run_scores", _run_scores, shard.spec,
-                            dev, args_s)
+            cs, dt_s, ev_s = _aot("sweep.run_scores", _run_scores, shard.spec,
+                                  dev, args_s)
             scores = cs(*args_s)
             args_m = (yd, scores, vw)
-            cm, dt_m = _aot("sweep.run_metrics", _run_metrics, shard.spec,
-                            dev, args_m)
+            cm, dt_m, ev_m = _aot("sweep.run_metrics", _run_metrics,
+                                  shard.spec, dev, args_m)
             out = cm(*args_m)
             compile_s = dt_s + dt_m
-            records = [("sweep.run_scores", cs, args_s),
-                       ("sweep.run_metrics", cm, args_m)]
+            records = [("sweep.run_scores", cs, args_s, ev_s),
+                       ("sweep.run_metrics", cm, args_m, ev_m)]
         else:
             args = (Xd, xbs_d, yd, tw, vw, bl)
-            c, compile_s = _aot("sweep.run", _run, shard.spec, dev, args)
+            c, compile_s, ev = _aot("sweep.run", _run, shard.spec, dev, args)
             out = c(*args)
-            records = [("sweep.run", c, args)]
+            records = [("sweep.run", c, args, ev)]
         # block in THIS thread only: other shards keep dispatching/running
         out = np.asarray(out)
         return out, {"device": str(dev), "candidates": C_s,
@@ -672,12 +752,16 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
     for (out, stat, records), shard, dev in zip(results, shards, devices):
         metrics[:, np.asarray(shard.cis, np.int64), :] = out
         per_shard.append(stat)
-        for name, compiled, args in records:
+        for name, compiled, args, events in records:
             flops.record_compiled(name, compiled, args, device=dev)
-    _run_stats["launches"].append(
-        {"shards": len(shards), "candidates": int(n_candidates),
-         "wall_s": round(time.perf_counter() - t_all, 4),
-         "per_shard": per_shard})
+            flops.record_collectives(events, device=dev)
+    entry = {"shards": len(shards), "candidates": int(n_candidates),
+             "wall_s": round(time.perf_counter() - t_all, 4),
+             "per_shard": per_shard}
+    chain = _max_gbt_chain([s.spec for s in shards])
+    if chain:
+        entry["gbt_chain"] = chain
+    _run_stats["launches"].append(entry)
     return metrics
 
 
@@ -819,21 +903,26 @@ def run_sweep_rowsharded(shards, X, xbs: Tuple, y, train_w, val_w,
         flops.record_compiled(name, compiled, args, device=label)
         flops.record_collectives(colls, device=label)
         for kind, axis, nbytes in colls:
+            if kind == "hist_subtracted":
+                continue  # flops-savings event, not mesh traffic
             agg = coll_agg.setdefault(axis, {"count": 0.0, "bytes": 0.0})
             agg["count"] += 1
             agg["bytes"] += nbytes
     d = int(X_host.shape[1]) if X_host is not None else int(X.shape[1])
-    _run_stats["launches"].append(
-        {"shards": len(shards), "data_shards": int(n_data),
-         "rowsharded": True, "candidates": int(n_candidates),
-         "wall_s": round(time.perf_counter() - t_all, 4),
-         "per_shard": per_shard,
-         "collectives": coll_agg,
-         # the 1/data_shards peak-memory claim, auditable: what ONE device
-         # of a model column holds vs what a replicated launch would hold
-         "per_device_bytes": {
-             "X": n_pad // n_data * d * 4,
-             "y": n_pad // n_data * 4,
-             "X_replicated": n_orig * d * 4,
-             "y_replicated": n_orig * 4}})
+    entry = {"shards": len(shards), "data_shards": int(n_data),
+             "rowsharded": True, "candidates": int(n_candidates),
+             "wall_s": round(time.perf_counter() - t_all, 4),
+             "per_shard": per_shard,
+             "collectives": coll_agg,
+             # the 1/data_shards peak-memory claim, auditable: what ONE device
+             # of a model column holds vs what a replicated launch would hold
+             "per_device_bytes": {
+                 "X": n_pad // n_data * d * 4,
+                 "y": n_pad // n_data * 4,
+                 "X_replicated": n_orig * d * 4,
+                 "y_replicated": n_orig * 4}}
+    chain = _max_gbt_chain([s.spec for s in shards])
+    if chain:
+        entry["gbt_chain"] = chain
+    _run_stats["launches"].append(entry)
     return metrics

@@ -48,7 +48,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..parallel.mesh import mesh_psum
+from ..parallel.mesh import mesh_psum, record_trace_event
 
 
 class Tree(NamedTuple):
@@ -68,7 +68,17 @@ _SKETCH_ROWS = 1 << 18  # 262144 — plenty for <=256 quantile edges
 
 
 def _bin_dtype(n_bins: int):
-    return np.int8 if n_bins <= 127 else np.int32
+    """Narrowest dtype holding every bin id in [0, n_bins).
+
+    int8 tops out at +127, so it is safe through ``n_bins == 128`` (ids
+    0..127) and must promote to int32 beyond — at exactly 128 the old
+    ``<= 127`` boundary promoted a bin matrix that still fit, and one bin
+    more would have overflowed int8 had the comparison been ``< 256``-style
+    sloppy.  Regression-pinned at 127/128/255/256 in
+    tests/test_trees_binning.py."""
+    if n_bins < 2:
+        raise ValueError(f"n_bins must be >= 2 (one split edge), got {n_bins}")
+    return np.int8 if n_bins <= 128 else np.int32
 
 
 @jax.jit
@@ -203,6 +213,28 @@ def _hist_bf16() -> bool:
     return False
 
 
+def _hist_subtract() -> bool:
+    """Parent-minus-child histogram subtraction (the XGBoost/LightGBM trick).
+
+    Each split level builds per-bin G/H histograms only for the LIGHTER
+    child (by hessian weight) of every sibling pair and derives the heavy
+    sibling as ``parent_hist - light_hist`` from parent histograms carried
+    level to level — halving the dominant histogram-build cost and, on
+    row-sharded launches, the psum payload (the subtraction happens AFTER
+    the data-axis psum on already-global stats).  Not bitwise-identical to
+    the direct build (f32 ``parent - light`` rounds differently than
+    summing the heavy rows), so near-tied splits can flip; parity is pinned
+    at the sweep-metric level in tests/test_hist_subtract_parity.py.
+    TMOG_HIST_SUBTRACT=0/1 forces either way (default on).
+    """
+    import os
+
+    force = os.environ.get("TMOG_HIST_SUBTRACT")
+    if force is not None and force != "":
+        return force == "1"
+    return True
+
+
 def _hist_via_matmul(n: int, d: int, n_bins: int, c1: int = 2) -> bool:
     """Pick the histogram formulation (static, at trace time).
 
@@ -295,7 +327,8 @@ def _grow_level(Xb, gh, w, feat_mask, nodes, leaf_val, slot_base, next_free,
                 n_active, row_slot, row_node, m: int, next_cap: int,
                 n_bins: int, reg_lambda, gamma, min_child_weight,
                 min_info_gain=0.0, Og=None, exact_cap: bool = False,
-                axis_name: Optional[str] = None):
+                axis_name: Optional[str] = None, pair_light=None,
+                pair_hist=None, want_pairs: bool = False):
     """One breadth-first level over an ``m``-slot frontier.
 
     SCATTER/GATHER-FREE by design: XLA TPU lowers batched scatters and
@@ -321,23 +354,69 @@ def _grow_level(Xb, gh, w, feat_mask, nodes, leaf_val, slot_base, next_free,
     histogram build.  A node's leaf value is written once, when the node is
     created (root at init).  ``row_node`` tracks each row's current pool
     node so boosting can read final leaf values without a predict walk.
+
+    Histogram subtraction (``_hist_subtract``): with ``pair_hist``
+    f32[m/2, c+1, d, B] (the parent slots' histograms, packed at sibling-
+    pair positions by the PREVIOUS level) and ``pair_light`` f32[m/2]
+    (1.0 = the lighter child sits in the even/left slot), histograms are
+    built only for the light child of each pair; the heavy sibling is
+    ``parent - light`` AFTER the data-axis psum.  ``want_pairs`` appends
+    (pair_light', pair_hist') for the NEXT level to the return tuple.
     """
     B = n_bins
     d = Xb.shape[1]
     c = gh.shape[1] - 1
     iota_m = jnp.arange(m)
     in_use = iota_m < n_active
+    subtract = pair_hist is not None
+    pairs = m // 2
     if Og is not None:
         S = jax.nn.one_hot(row_slot, m, dtype=jnp.float32)       # [n, m]
-        G, H = _level_histograms_mm(Og, S, w, m, B, d, c + 1)
+        if subtract:
+            # light-child membership from the full slot one-hot: select the
+            # light column of each sibling pair (no gathers)
+            light_sel = jnp.stack([pair_light, 1.0 - pair_light], axis=-1)
+            S_light = (S.reshape(-1, pairs, 2) * light_sel[None]).sum(-1)
+            record_trace_event("hist_subtracted", "mm",
+                               2 * pairs * S.shape[0] * (c + 1) * d * B)
+            Gl, Hl = _level_histograms_mm(Og, S_light, w, pairs, B, d, c + 1)
+        else:
+            G, H = _level_histograms_mm(Og, S, w, m, B, d, c + 1)
     else:
         S = None
-        G, H = _level_histograms(Xb, gh * w[:, None], row_slot, m, B)
+        if subtract:
+            # CPU segment-sum path: gathers are cheap here, so route light
+            # rows straight to their pair id and rest everything else
+            lp_slot = pair_light > 0.5
+            light_slot = jnp.stack([lp_slot, ~lp_slot], axis=-1).reshape(-1)
+            s_safe = jnp.maximum(row_slot, 0)
+            is_light = light_slot[s_safe] & (row_slot >= 0)
+            pair_ids = jnp.where(is_light, row_slot >> 1, -1)
+            record_trace_event("hist_subtracted", "segment",
+                               row_slot.shape[0] * (c + 1) * d // 2)
+            Gl, Hl = _level_histograms(Xb, gh * w[:, None], pair_ids, pairs, B)
+        else:
+            G, H = _level_histograms(Xb, gh * w[:, None], row_slot, m, B)
     # row-sharded launch: local-rows histograms psum to the GLOBAL per-bin
     # stats, so every shard picks identical splits (distributed-XGBoost
-    # histogram aggregation); row routing below stays local
-    G = mesh_psum(G, axis_name)
-    H = mesh_psum(H, axis_name)
+    # histogram aggregation); row routing below stays local.  On the
+    # subtracted path only the LIGHT histograms cross the wire (half the
+    # payload); parents are already post-psum globals from the prior level.
+    if subtract:
+        Gl = mesh_psum(Gl, axis_name)                # [pairs, c, d, B]
+        Hl = mesh_psum(Hl, axis_name)                # [pairs, d, B]
+        Gh = pair_hist[:, :c] - Gl
+        Hh = pair_hist[:, c] - Hl
+        lp = pair_light > 0.5                        # light child is LEFT
+        lpg = lp[:, None, None, None]
+        lph = lp[:, None, None]
+        G = jnp.stack([jnp.where(lpg, Gl, Gh),
+                       jnp.where(lpg, Gh, Gl)], axis=1).reshape(m, c, d, B)
+        H = jnp.stack([jnp.where(lph, Hl, Hh),
+                       jnp.where(lph, Hh, Hl)], axis=1).reshape(m, d, B)
+    else:
+        G = mesh_psum(G, axis_name)
+        H = mesh_psum(H, axis_name)
     # G: [m, c, d, B]; H: [m, d, B] — bins minor, no 2-wide lane dims
     GT = G[:, :, 0, :].sum(axis=-1)   # [m, c] — node totals (same per feature)
     HT = H[:, 0, :].sum(axis=-1)      # [m]
@@ -435,6 +514,17 @@ def _grow_level(Xb, gh, w, feat_mask, nodes, leaf_val, slot_base, next_free,
         child_r = child_idx[s_safe]
     new_row_slot = jnp.where(splits_here, child_r + go_right, -1)
     row_node = jnp.where(splits_here, next_free + child_r + go_right, row_node)
+    if want_pairs:
+        # parent histograms for the NEXT level's sibling pairs: slot s's
+        # (post-psum, post-reassembly) G/H packed at pair j = child_idx/2 by
+        # reusing every other row of the child-packing selector L_eq; the
+        # light-left flag comes from the winning split's child hessians
+        GH_all = jnp.concatenate([G, H[:, None]], axis=1).reshape(m, -1)
+        P_pair = L_eq[0::2]                          # [next_cap // 2, m]
+        new_pair_hist = (P_pair @ GH_all).reshape(next_cap // 2, c + 1, d, B)
+        new_pair_light = P_pair @ (HL_best <= HR_best).astype(jnp.float32)
+        return (nodes, leaf_val, 2 * n_split, new_row_slot, row_node,
+                new_pair_light, new_pair_hist)
     return nodes, leaf_val, 2 * n_split, new_row_slot, row_node
 
 
@@ -483,34 +573,62 @@ def grow_tree(Xb, g, h, w, feat_mask, max_depth: int, n_bins: int,
 
     M = frontier
     L = M.bit_length() - 1
+    # histogram subtraction only pays from level 1 on (the root has no
+    # sibling); the pair carry rides alongside the 5-tuple when enabled
+    sub = _hist_subtract() and max_depth > 1
     carry = (nodes, leaf_val,
              jnp.asarray(1, jnp.int32),          # n_active (just the root)
              jnp.zeros((n,), jnp.int32),         # row_slot
              row_node)
+    pl = ph = None
     # exact unrolled levels: widths 1, 2, 4, ..., min(2^(depth-1), M/ --)
     # static pool layout (_pool_size): level t's frontier block starts at
     # 2^t - 1; loop level t's at M - 1 + (t - L)*M — uniform across trees
     u = min(max_depth, L)
     for t in range(u):
         next_cap = 1 << (t + 1)                  # = 2m: no beam cap
-        carry = _grow_level(
+        out = _grow_level(
             Xb, gh, w, feat_mask, carry[0], carry[1], (1 << t) - 1,
             (1 << (t + 1)) - 1, *carry[2:], m=1 << t, next_cap=next_cap,
             n_bins=n_bins, reg_lambda=reg_lambda, gamma=gamma,
             min_child_weight=min_child_weight, min_info_gain=min_info_gain,
-            Og=Og, exact_cap=exact_cap, axis_name=axis_name)
-    # deep levels: ONE fori_loop body at fixed M slots
+            Og=Og, exact_cap=exact_cap, axis_name=axis_name,
+            pair_light=pl, pair_hist=ph, want_pairs=sub)
+        if sub:
+            carry, pl, ph = out[:5], out[5], out[6]
+        else:
+            carry = out
+    # deep levels: ONE fori_loop body at fixed M slots.  With subtraction
+    # the carry gains (pair_light [M/2], pair_hist [M/2, c+1, d, B]) — the
+    # last unrolled level's next_cap is exactly M, so the shapes are static
+    # across iterations.
     if max_depth > L:
-        def body(t, carry):
-            sb = M - 1 + (t - L) * M             # affine in t: batch-uniform
-            return _grow_level(Xb, gh, w, feat_mask, carry[0], carry[1], sb,
-                               sb + M, *carry[2:], m=M, next_cap=M,
-                               n_bins=n_bins, reg_lambda=reg_lambda,
-                               gamma=gamma, min_child_weight=min_child_weight,
-                               min_info_gain=min_info_gain, Og=Og,
-                               exact_cap=exact_cap, axis_name=axis_name)
+        if sub:
+            def body(t, state):
+                sb = M - 1 + (t - L) * M         # affine in t: batch-uniform
+                return _grow_level(
+                    Xb, gh, w, feat_mask, state[0], state[1], sb, sb + M,
+                    *state[2:5], m=M, next_cap=M, n_bins=n_bins,
+                    reg_lambda=reg_lambda, gamma=gamma,
+                    min_child_weight=min_child_weight,
+                    min_info_gain=min_info_gain, Og=Og, exact_cap=exact_cap,
+                    axis_name=axis_name, pair_light=state[5],
+                    pair_hist=state[6], want_pairs=True)
 
-        carry = lax.fori_loop(L, max_depth, body, carry)
+            carry = lax.fori_loop(L, max_depth, body,
+                                  tuple(carry) + (pl, ph))[:5]
+        else:
+            def body(t, carry):
+                sb = M - 1 + (t - L) * M         # affine in t: batch-uniform
+                return _grow_level(Xb, gh, w, feat_mask, carry[0], carry[1],
+                                   sb, sb + M, *carry[2:], m=M, next_cap=M,
+                                   n_bins=n_bins, reg_lambda=reg_lambda,
+                                   gamma=gamma,
+                                   min_child_weight=min_child_weight,
+                                   min_info_gain=min_info_gain, Og=Og,
+                                   exact_cap=exact_cap, axis_name=axis_name)
+
+            carry = lax.fori_loop(L, max_depth, body, carry)
     nodes, leaf_val, row_node = carry[0], carry[1], carry[4]
     tree = as_tree(nodes, leaf_val)
     return (tree, row_node) if return_row_node else tree
@@ -549,7 +667,9 @@ def _grow_level_batch(Xb, gh, w_t, feat_mask_t, nodes, leaf_val, slot_base,
                       next_free, n_active, row_slot, row_node, m: int,
                       next_cap: int, n_bins: int, reg_lambda_t, gamma_t,
                       mcw_t, mig_t, Og, exact_cap: bool,
-                      gh_t=None, Obin=None, axis_name: Optional[str] = None):
+                      gh_t=None, Obin=None, axis_name: Optional[str] = None,
+                      pair_light=None, pair_hist=None,
+                      want_pairs: bool = False):
     """One breadth-first level for a BATCH of T trees (shared Xb).
 
     Same split math as ``_grow_level`` (see its docstring for the
@@ -576,20 +696,41 @@ def _grow_level_batch(Xb, gh, w_t, feat_mask_t, nodes, leaf_val, slot_base,
     in_use = iota_m[None, :] < n_active[:, None]                    # [T, m]
     # slot one-hot with slot axis BEFORE rows: flattening needs no transpose
     S = (row_slot[:, None, :] == iota_m[None, :, None]).astype(jnp.float32)
-    Sw = S * w_t[:, None, :]                                        # [T, m, n]
+    subtract = pair_hist is not None
+    pairs = m // 2
+    if subtract:
+        # histogram subtraction: the level GEMM's LHS covers only the LIGHT
+        # child of each sibling pair (half the slot rows); the heavy sibling
+        # is parent - light after the data-axis psum (see _grow_level)
+        light_sel = jnp.stack([pair_light, 1.0 - pair_light], axis=-1)
+        S_hist = (S.reshape(T, pairs, 2, n) * light_sel[..., None]).sum(2)
+        mh = pairs
+        record_trace_event("hist_subtracted", "mm_batch",
+                           2 * T * pairs * n * (c + 1) * d * B)
+    else:
+        S_hist = S
+        mh = m
+    Sw = S_hist * w_t[:, None, :]                                   # [T, mh, n]
     if gh_t is None:
-        GH = lax.dot_general(Sw.reshape(T * m, n).astype(Og.dtype), Og,
+        GH = lax.dot_general(Sw.reshape(T * mh, n).astype(Og.dtype), Og,
                              (((1,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32)
     else:
-        # [T, m, c1, n]: slot one-hot x per-tree weighted gradients
+        # [T, mh, c1, n]: slot one-hot x per-tree weighted gradients
         L = Sw[:, :, None, :] * gh_t.transpose(0, 2, 1)[:, None, :, :]
-        GH = lax.dot_general(L.reshape(T * m * (c + 1), n).astype(Obin.dtype),
+        GH = lax.dot_general(L.reshape(T * mh * (c + 1), n).astype(Obin.dtype),
                              Obin, (((1,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32)
-    GH = GH.reshape(T, m, c + 1, d, B)
-    # global per-bin stats under a row-sharded launch (see _grow_level)
+    GH = GH.reshape(T, mh, c + 1, d, B)
+    # global per-bin stats under a row-sharded launch (see _grow_level);
+    # subtracted levels psum only the light half of the payload
     GH = mesh_psum(GH, axis_name)
+    if subtract:
+        GH_h = pair_hist - GH
+        lp = (pair_light > 0.5)[:, :, None, None, None]
+        GH = jnp.stack([jnp.where(lp, GH, GH_h),
+                        jnp.where(lp, GH_h, GH)],
+                       axis=2).reshape(T, m, c + 1, d, B)
     G, H = GH[:, :, :c], GH[:, :, c]                # [T,m,c,d,B], [T,m,d,B]
     GT = G[:, :, :, 0, :].sum(axis=-1)              # [T, m, c]
     HT = H[:, :, 0, :].sum(axis=-1)                 # [T, m]
@@ -668,6 +809,17 @@ def _grow_level_batch(Xb, gh, w_t, feat_mask_t, nodes, leaf_val, slot_base,
     go_right = (row_bin > routed[:, :, 1]).astype(jnp.int32)
     new_row_slot = jnp.where(splits_here, child_r + go_right, -1)
     row_node = jnp.where(splits_here, next_free + child_r + go_right, row_node)
+    if want_pairs:
+        # parent histograms packed at next-level pair positions (see
+        # _grow_level): every other row of the child-packing selector L_eq
+        GH_all = jnp.concatenate([G, H[:, :, None]], axis=2).reshape(T, m, -1)
+        P_pair = L_eq[:, 0::2, :]                    # [T, next_cap // 2, m]
+        new_pair_hist = jnp.einsum("tpm,tmx->tpx", P_pair, GH_all).reshape(
+            T, next_cap // 2, c + 1, d, B)
+        new_pair_light = jnp.einsum(
+            "tpm,tm->tp", P_pair, (HL_best <= HR_best).astype(jnp.float32))
+        return (nodes, leaf_val, 2 * n_split, new_row_slot, row_node,
+                new_pair_light, new_pair_hist)
     return nodes, leaf_val, 2 * n_split, new_row_slot, row_node
 
 
@@ -742,27 +894,48 @@ def grow_forest(Xb, g, h, w_t, feat_mask_t, max_depth: int, n_bins: int,
 
     M = frontier
     L = M.bit_length() - 1
+    sub = _hist_subtract() and max_depth > 1
     carry = (nodes, leaf_val, jnp.ones((T,), jnp.int32),
              jnp.zeros((T, n), jnp.int32), row_node)
+    pl = ph = None
     u = min(max_depth, L)
     for t in range(u):
-        carry = _grow_level_batch(
+        out = _grow_level_batch(
             Xb, gh, w_t, feat_mask_t, carry[0], carry[1], (1 << t) - 1,
             (1 << (t + 1)) - 1, *carry[2:], m=1 << t, next_cap=1 << (t + 1),
             n_bins=n_bins, reg_lambda_t=reg_lambda_t, gamma_t=gamma_t,
             mcw_t=mcw_t, mig_t=mig_t, Og=Og, exact_cap=exact_cap,
-            gh_t=gh_t, Obin=Obin, axis_name=axis_name)
+            gh_t=gh_t, Obin=Obin, axis_name=axis_name,
+            pair_light=pl, pair_hist=ph, want_pairs=sub)
+        if sub:
+            carry, pl, ph = out[:5], out[5], out[6]
+        else:
+            carry = out
     if max_depth > L:
-        def body(t, carry):
-            sb = M - 1 + (t - L) * M
-            return _grow_level_batch(
-                Xb, gh, w_t, feat_mask_t, carry[0], carry[1], sb, sb + M,
-                *carry[2:], m=M, next_cap=M, n_bins=n_bins,
-                reg_lambda_t=reg_lambda_t, gamma_t=gamma_t, mcw_t=mcw_t,
-                mig_t=mig_t, Og=Og, exact_cap=exact_cap,
-                gh_t=gh_t, Obin=Obin, axis_name=axis_name)
+        if sub:
+            def body(t, state):
+                sb = M - 1 + (t - L) * M
+                return _grow_level_batch(
+                    Xb, gh, w_t, feat_mask_t, state[0], state[1], sb, sb + M,
+                    *state[2:5], m=M, next_cap=M, n_bins=n_bins,
+                    reg_lambda_t=reg_lambda_t, gamma_t=gamma_t, mcw_t=mcw_t,
+                    mig_t=mig_t, Og=Og, exact_cap=exact_cap,
+                    gh_t=gh_t, Obin=Obin, axis_name=axis_name,
+                    pair_light=state[5], pair_hist=state[6], want_pairs=True)
 
-        carry = lax.fori_loop(L, max_depth, body, carry)
+            carry = lax.fori_loop(L, max_depth, body,
+                                  tuple(carry) + (pl, ph))[:5]
+        else:
+            def body(t, carry):
+                sb = M - 1 + (t - L) * M
+                return _grow_level_batch(
+                    Xb, gh, w_t, feat_mask_t, carry[0], carry[1], sb, sb + M,
+                    *carry[2:], m=M, next_cap=M, n_bins=n_bins,
+                    reg_lambda_t=reg_lambda_t, gamma_t=gamma_t, mcw_t=mcw_t,
+                    mig_t=mig_t, Og=Og, exact_cap=exact_cap,
+                    gh_t=gh_t, Obin=Obin, axis_name=axis_name)
+
+            carry = lax.fori_loop(L, max_depth, body, carry)
     nodes, leaf_val, row_node = carry[0], carry[1], carry[4]
     tree = as_tree(nodes, leaf_val)
     return (tree, row_node) if return_row_node else tree
@@ -807,8 +980,11 @@ def forest_chunk_size(max_depth: int, n_bins: int, d: int, c: int,
 
     A level materializes G [M, d, B, c] + cumsums per tree (x3 covers the
     cumsum/gain temporaries) plus, on the batch-GEMM path, the slot one-hot
-    [M, n] and its weighted flattening (the ``2 * n_rows`` term)."""
-    per_tree = frontier * (n_bins * d * (c + 1) * 3 + 2 * n_rows) * 4
+    [M, n] and its weighted flattening (the ``2 * n_rows`` term).  With
+    histogram subtraction on, the carried parent pair histograms add about
+    half a level's histograms (the 0.5 bump)."""
+    hist_factor = 3.5 if _hist_subtract() else 3.0
+    per_tree = frontier * (n_bins * d * (c + 1) * hist_factor + 2 * n_rows) * 4
     return max(1, int(budget_bytes / max(per_tree, 1)))
 
 
@@ -922,14 +1098,55 @@ def _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int
               max_depth: int, n_bins: int, frontier: int, eta, reg_lambda,
               gamma, min_child_weight, base_score: float, n_classes: int,
               min_info_gain=0.0, exact_cap: bool = False,
-              axis_name: Optional[str] = None) -> Tuple[Tree, jax.Array]:
-    """Traceable boosting body shared by fit_gbt and fit_gbt_batch."""
+              axis_name: Optional[str] = None,
+              trees_per_round: int = 1) -> Tuple[Tree, jax.Array]:
+    """Traceable boosting body shared by fit_gbt and fit_gbt_batch.
+
+    ``trees_per_round`` = K > 1 collapses the boosting chain: the scan takes
+    ``n_rounds / K`` steps, each growing K trees against the SAME gradients
+    (their round-specific subsample/colsample draws kept) at learning rate
+    ``eta / K`` — the boosted-forest round-collapse.  K must divide
+    ``n_rounds``.  The stacked tree axis stays [n_rounds, ...] and
+    ``predict_gbt`` with ``eta / K`` scores it unchanged.
+    """
     n = Xb.shape[0]
     c = n_classes if loss == "softmax" else 1
     Y = jax.nn.one_hot(y.astype(jnp.int32), max(c, 2), dtype=jnp.float32) \
         if loss == "softmax" else jnp.zeros((n, 2), jnp.float32)
     F0 = jnp.full((n, c), base_score, jnp.float32)
     use_mm = _hist_via_matmul(n, Xb.shape[1], n_bins, c + 1)
+    K = int(trees_per_round)
+
+    if K > 1:
+        if n_rounds % K:
+            raise ValueError(
+                f"trees_per_round={K} must divide n_rounds={n_rounds}")
+        steps = n_rounds // K
+        rw_s = row_w_rounds.reshape(steps, K, n)
+        fm_s = feat_mask_rounds.reshape(steps, K, -1)
+        as_k = lambda v: jnp.broadcast_to(
+            jnp.asarray(v, jnp.float32), (K,))
+
+        def step_fn(F, xs):
+            rwk, fmk = xs                              # [K, n], [K, d]
+            g, hh = _grad_hess(loss, F, y, Y)
+            trees, row_node = grow_forest(
+                Xb, g, hh, w[None, :] * rwk, fmk, max_depth, n_bins,
+                frontier, reg_lambda_t=as_k(reg_lambda), gamma_t=as_k(gamma),
+                mcw_t=as_k(min_child_weight), mig_t=as_k(min_info_gain),
+                exact_cap=exact_cap, return_row_node=True,
+                axis_name=axis_name)
+            leaves = jnp.take_along_axis(
+                trees.leaf_val, row_node[:, :, None].repeat(c, axis=2),
+                axis=1)                                # [K, n, c]
+            F = F + (eta / K) * leaves.sum(axis=0)
+            return F, trees
+
+        F, trees = lax.scan(step_fn, F0, (rw_s, fm_s))
+        # restore the flat [n_rounds, ...] tree axis
+        trees = jax.tree.map(
+            lambda a: a.reshape((n_rounds,) + a.shape[2:]), trees)
+        return trees, F
 
     def round_fn(F, xs):
         rw, fm = xs
@@ -953,45 +1170,48 @@ def _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int
 
 @functools.partial(jax.jit, static_argnames=("loss", "n_rounds", "max_depth",
                                              "n_bins", "n_classes", "frontier",
-                                             "exact_cap"))
+                                             "exact_cap", "trees_per_round"))
 def fit_gbt(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int,
             max_depth: int, n_bins: int, frontier: int, eta: float = 0.3,
             reg_lambda: float = 1.0, gamma: float = 0.0,
             min_child_weight: float = 1.0, base_score: float = 0.0,
             n_classes: int = 1, min_info_gain: float = 0.0,
-            exact_cap: bool = False) -> Tuple[Tree, jax.Array]:
+            exact_cap: bool = False,
+            trees_per_round: int = 1) -> Tuple[Tree, jax.Array]:
     """XGBoost-style boosting: scan over rounds, one histogram tree per round.
 
     row_w_rounds: f32[R, n] subsample weights per round; feat_mask_rounds:
     f32[R, d] colsample masks.  Multiclass uses multi-output trees (leaf
     vector per class) — a TPU-friendly variant of per-class tree sets.
-    Returns (stacked Tree [R, ...], final margins F [n, c]).
+    ``trees_per_round`` = K > 1 grows K trees per boosting step at eta / K
+    (round-collapse; callers scoring the stacked trees must scale eta the
+    same way).  Returns (stacked Tree [R, ...], final margins F [n, c]).
     """
     return _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss, n_rounds,
                      max_depth, n_bins, frontier, eta, reg_lambda, gamma,
                      min_child_weight, base_score, n_classes,
-                     min_info_gain=min_info_gain, exact_cap=exact_cap)
+                     min_info_gain=min_info_gain, exact_cap=exact_cap,
+                     trees_per_round=trees_per_round)
 
 
-@functools.partial(jax.jit, static_argnames=("loss", "n_rounds", "max_depth",
-                                             "n_bins", "n_classes", "frontier",
-                                             "exact_cap"))
-def fit_gbt_batch(Xb, y, w_batch, row_w_rounds, feat_mask_rounds, loss: str,
-                  n_rounds: int, max_depth: int, n_bins: int, frontier: int,
-                  eta_b, reg_lambda_b, gamma_b, min_child_weight_b,
-                  base_score_b=None, n_classes: int = 1,
-                  min_info_gain_b=None, exact_cap: bool = False) -> jax.Array:
-    """The fold x grid boosting sweep as ONE launch (the OpValidator
-    thread-pool analog for boosted models — SURVEY §2.7 axis 2).
+def _gbt_batch_impl(Xb, y, w_batch, row_w_rounds, feat_mask_rounds, loss: str,
+                    n_rounds: int, max_depth: int, n_bins: int, frontier: int,
+                    eta_b, reg_lambda_b, gamma_b, min_child_weight_b,
+                    base_score_b=None, n_classes: int = 1,
+                    min_info_gain_b=None, exact_cap: bool = False,
+                    axis_name: Optional[str] = None,
+                    trees_per_round: int = 1) -> jax.Array:
+    """Traceable body of :func:`fit_gbt_batch` — also called directly by the
+    fused sweep (ops/sweep.py) with ``axis_name`` set on the row-sharded
+    path and ``trees_per_round`` > 1 for round-collapsed GBT groups.
 
-    ``w_batch`` f32[B, n] carries fold-mask x sample weights per batch
-    element; ``eta_b``/``reg_lambda_b``/``gamma_b``/``min_child_weight_b``
-    f32[B] are the grid's dynamic hyperparameters (static shape params —
-    depth, rounds, bins — must match across the batch; the caller groups
-    grids accordingly).  Returns final margins F f32[B, n, c] on the FULL
-    dataset, from which fold-validation slices are taken.
+    With K = ``trees_per_round``, every scan step grows B * K trees as one
+    flat-GEMM forest (K per candidate, against that candidate's step
+    gradients, each keeping its own round subsample/colsample draw) and
+    applies their mean at learning rate ``eta_b`` (i.e. eta / K each) — the
+    boosted-forest round-collapse.  K = 1 reproduces the per-round scan
+    bit-for-bit (the K-generalized reshapes are layout no-ops).
     """
-
     if base_score_b is None:
         base_score_b = jnp.zeros(w_batch.shape[0], jnp.float32)
     if min_info_gain_b is None:
@@ -1001,19 +1221,24 @@ def fit_gbt_batch(Xb, y, w_batch, row_w_rounds, feat_mask_rounds, loss: str,
     n, d = Xb.shape
     B = w_batch.shape[0]
     c = n_classes if loss == "softmax" else 1
+    K = int(trees_per_round)
+    if n_rounds % max(K, 1):
+        raise ValueError(
+            f"trees_per_round={K} must divide n_rounds={n_rounds}")
     if not _hist_via_matmul(n, d, n_bins, c + 1):
         # segment-sum backends keep the per-element vmap formulation
         def one(w, eta, lam, gam, mcw, base, mig):
             _, F = _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss,
                              n_rounds, max_depth, n_bins, frontier, eta, lam,
                              gam, mcw, base, n_classes, min_info_gain=mig,
-                             exact_cap=exact_cap)
+                             exact_cap=exact_cap, axis_name=axis_name,
+                             trees_per_round=K)
             return F
 
         return jax.vmap(one)(w_batch, eta_b, reg_lambda_b, gamma_b,
                              min_child_weight_b, base_score_b, min_info_gain_b)
 
-    # batch-native boosting: every round grows its B trees as ONE
+    # batch-native boosting: every step grows its B * K trees as ONE
     # flat-GEMM forest (per-tree gradients ride the LHS); the gradient-free
     # bin one-hot RHS is built ONCE for the whole launch instead of per
     # round (see bin_onehot / _grow_level_batch)
@@ -1021,9 +1246,12 @@ def fit_gbt_batch(Xb, y, w_batch, row_w_rounds, feat_mask_rounds, loss: str,
         if loss == "softmax" else jnp.zeros((n, 2), jnp.float32)
     Obin = bin_onehot(Xb, n_bins)
     F0 = jnp.broadcast_to(base_score_b[:, None, None], (B, n, c)).astype(jnp.float32)
+    steps = n_rounds // K
+    rw_s = row_w_rounds.reshape(steps, K, n)
+    fm_s = feat_mask_rounds.reshape(steps, K, d)
 
-    def round_fn(F, xs):
-        rw, fmr = xs                                   # [n], [d] shared
+    def step_fn(F, xs):
+        rwk, fmk = xs                                  # [K, n], [K, d] shared
         if loss == "squared":
             gb = F[..., 0] - y[None, :]
             hb = jnp.ones((B, n), jnp.float32)
@@ -1037,21 +1265,56 @@ def fit_gbt_batch(Xb, y, w_batch, row_w_rounds, feat_mask_rounds, loss: str,
             g3 = p - Y[None, :, :]
             hb = jnp.maximum((p * (1 - p)).mean(axis=-1), 1e-6)
         gh_t = jnp.concatenate([g3, hb[..., None]], axis=-1)   # [B, n, c1]
+        # candidate-major tree axis [B * K]: candidate b's K trees share its
+        # gradients but keep their own round draws
+        gh_T = jnp.repeat(gh_t, K, axis=0)
+        w_T = (w_batch[:, None, :] * rwk[None, :, :]).reshape(B * K, n)
+        fm_T = jnp.broadcast_to(fmk[None, :, :], (B, K, d)).reshape(B * K, d)
         tree, row_node = grow_forest(
-            Xb, None, None, w_batch * rw[None, :],
-            jnp.broadcast_to(fmr[None, :], (B, d)), max_depth, n_bins,
-            frontier, reg_lambda_t=reg_lambda_b, gamma_t=gamma_b,
-            mcw_t=min_child_weight_b, mig_t=min_info_gain_b,
+            Xb, None, None, w_T, fm_T, max_depth, n_bins,
+            frontier, reg_lambda_t=jnp.repeat(reg_lambda_b, K),
+            gamma_t=jnp.repeat(gamma_b, K),
+            mcw_t=jnp.repeat(min_child_weight_b, K),
+            mig_t=jnp.repeat(min_info_gain_b, K),
             exact_cap=exact_cap, return_row_node=True,
-            gh_t=gh_t, Obin=Obin)
-        # leaf lookup via one gather per round (row_node tracks leaves)
+            gh_t=gh_T, Obin=Obin, axis_name=axis_name)
+        # leaf lookup via one gather per step (row_node tracks leaves)
         leaves = jnp.take_along_axis(
             tree.leaf_val, row_node[:, :, None].repeat(c, axis=2), axis=1)
-        F = F + eta_b[:, None, None] * leaves
+        leaves = leaves.reshape(B, K, n, c).sum(axis=1)
+        F = F + (eta_b / K)[:, None, None] * leaves
         return F, None
 
-    F, _ = lax.scan(round_fn, F0, (row_w_rounds, feat_mask_rounds))
+    F, _ = lax.scan(step_fn, F0, (rw_s, fm_s))
     return F
+
+
+@functools.partial(jax.jit, static_argnames=("loss", "n_rounds", "max_depth",
+                                             "n_bins", "n_classes", "frontier",
+                                             "exact_cap", "trees_per_round"))
+def fit_gbt_batch(Xb, y, w_batch, row_w_rounds, feat_mask_rounds, loss: str,
+                  n_rounds: int, max_depth: int, n_bins: int, frontier: int,
+                  eta_b, reg_lambda_b, gamma_b, min_child_weight_b,
+                  base_score_b=None, n_classes: int = 1,
+                  min_info_gain_b=None, exact_cap: bool = False,
+                  trees_per_round: int = 1) -> jax.Array:
+    """The fold x grid boosting sweep as ONE launch (the OpValidator
+    thread-pool analog for boosted models — SURVEY §2.7 axis 2).
+
+    ``w_batch`` f32[B, n] carries fold-mask x sample weights per batch
+    element; ``eta_b``/``reg_lambda_b``/``gamma_b``/``min_child_weight_b``
+    f32[B] are the grid's dynamic hyperparameters (static shape params —
+    depth, rounds, bins, trees_per_round — must match across the batch; the
+    caller groups grids accordingly).  Returns final margins F f32[B, n, c]
+    on the FULL dataset, from which fold-validation slices are taken.
+    """
+    return _gbt_batch_impl(Xb, y, w_batch, row_w_rounds, feat_mask_rounds,
+                           loss, n_rounds, max_depth, n_bins, frontier,
+                           eta_b, reg_lambda_b, gamma_b, min_child_weight_b,
+                           base_score_b=base_score_b, n_classes=n_classes,
+                           min_info_gain_b=min_info_gain_b,
+                           exact_cap=exact_cap,
+                           trees_per_round=trees_per_round)
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth",))

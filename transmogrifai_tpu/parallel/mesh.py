@@ -246,6 +246,21 @@ def _record_collective(kind: str, axis_name: str, x) -> None:
     sink.append((kind, axis_name, nbytes))
 
 
+def record_trace_event(kind: str, tag: str, value: int) -> None:
+    """Append a non-collective trace event to the active collector.
+
+    Rides the same sink as the collectives so launch layers that already
+    capture/replay the trace pick these up for free.  Used by the tree
+    grower to report histogram-subtraction savings (kind
+    ``"hist_subtracted"``, value = avoided FLOPs per traced level) —
+    utils/flops routes that kind into a dedicated bucket instead of the
+    per-axis collective traffic."""
+    sink = getattr(_TRACE_TLS, "sink", None)
+    if sink is None:
+        return
+    sink.append((kind, tag, int(value)))
+
+
 def mesh_psum(x, axis_name: Optional[str]):
     """``lax.psum`` over ``axis_name``; identity when ``axis_name`` is None.
 

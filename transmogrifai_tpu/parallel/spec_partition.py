@@ -24,11 +24,15 @@ re-packed blobs), so ANY candidate subset is expressible.  On the default
 LR+RF+XGB grid this lands within a few percent of the mean at 2/4/8 shards
 (unit-tested bound: max <= 1.3x mean).
 
-Known non-goal (ROADMAP leftover): the XGBoost sequential-rounds chain.
-A boosting group's rounds x depth levels are data-dependent sequential
-launches whose WALL time does not shrink when the candidate axis narrows;
-balance here is FLOP balance (what ``utils/flops`` reports), and the chain
-overlaps with other shards' work under async dispatch.
+The XGBoost sequential-rounds chain (previously a known non-goal here) is
+now attacked at the kernel level: a boosting group's data-dependent chain is
+rounds / trees_per_round x depth levels — round-collapse (gbt group field
+``trees_per_round``, env ``TMOG_GBT_ROUND_COLLAPSE``) shortens it and
+histogram subtraction halves each level's histogram build (ops/trees).
+``impl.sweep_fragments._gbt_group_cost`` folds both into the unit costs this
+partitioner balances; balance here is still FLOP balance (what
+``utils/flops`` reports), and the residual chain overlaps with other
+shards' work under async dispatch.
 """
 from __future__ import annotations
 

@@ -34,6 +34,12 @@ _by_fn: Dict[str, Dict[str, Any]] = {}
 _by_device: Dict[str, Dict[str, Any]] = {}
 #: per-axis collective traffic: axis -> {"count", "bytes", "<kind>_count"}
 _collectives: Dict[str, Dict[str, float]] = {}
+#: histogram-subtraction savings: sibling histograms derived as parent - child
+#: rather than rebuilt.  XLA's cost_analysis already counts only the work the
+#: optimized HLO actually does, so the main ``flops`` total needs no
+#: adjustment — this bucket records the AVOIDED build FLOPs separately
+#: (trace-time estimates: loop bodies counted once, like the collectives).
+_hist_subtracted: Dict[str, float] = {"levels": 0.0, "flops_avoided": 0.0}
 _cost_cache: Dict[Tuple, Optional[Dict[str, float]]] = {}
 
 
@@ -56,6 +62,7 @@ def reset() -> None:
     _by_fn.clear()
     _by_device.clear()
     _collectives.clear()
+    _hist_subtracted.update(levels=0.0, flops_avoided=0.0)
 
 
 def totals() -> Dict[str, Any]:
@@ -83,6 +90,7 @@ def totals() -> Dict[str, Any]:
             for kk, vv in v.items()}
         for k, v in _by_device.items()}
     out["collectives"] = {k: dict(v) for k, v in _collectives.items()}
+    out["hist_subtracted"] = dict(_hist_subtracted)
     return out
 
 
@@ -96,6 +104,13 @@ def record_collectives(colls, device=None) -> None:
     if not _enabled or not colls:
         return
     for kind, axis, nbytes in colls:
+        if kind == "hist_subtracted":
+            # not traffic: a trees-kernel trace event carrying the avoided
+            # histogram-build FLOPs of one subtracted level (see
+            # parallel.mesh.record_trace_event)
+            _hist_subtracted["levels"] += 1
+            _hist_subtracted["flops_avoided"] += nbytes
+            continue
         agg = _collectives.setdefault(
             axis, {"count": 0.0, "bytes": 0.0})
         agg["count"] += 1
@@ -113,6 +128,11 @@ def record_collectives(colls, device=None) -> None:
 def collective_totals() -> Dict[str, Dict[str, float]]:
     """Per-axis collective traffic (same shape as totals()["collectives"])."""
     return {k: dict(v) for k, v in _collectives.items()}
+
+
+def hist_subtracted_totals() -> Dict[str, float]:
+    """{"levels", "flops_avoided"}: histogram builds saved by subtraction."""
+    return dict(_hist_subtracted)
 
 
 def _signature(args, kwargs) -> Tuple:
@@ -160,17 +180,32 @@ def _accumulate(name: str, cost: Dict[str, float], shape_key: str,
         dv["calls"] += 1
 
 
-def _cost(fn, args, kwargs) -> Optional[Dict[str, float]]:
+def _cost(fn, args, kwargs) -> Optional[Dict[str, Any]]:
     try:
-        compiled = fn.lower(*args, **kwargs).compile()
+        # lower inside the mesh trace collector so kernel trace events
+        # (hist_subtracted savings, collectives traced outside a launcher
+        # that captures them itself) ride along with the cached cost and
+        # are replayed per recorded call
+        from ..parallel.mesh import trace_collectives
+
+        with trace_collectives() as colls:
+            lowered = fn.lower(*args, **kwargs)
+        compiled = lowered.compile()
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):  # older jax returns [dict]
             ca = ca[0] if ca else {}
         return {"flops": float(ca.get("flops", 0.0)),
                 "bytes_accessed": float(ca.get("bytes accessed",
-                                               ca.get("bytes_accessed", 0.0)))}
+                                               ca.get("bytes_accessed", 0.0))),
+                "events": tuple(c for c in colls if c[0] == "hist_subtracted")}
     except Exception:
         return None
+
+
+def cost_of(fn, *args, **kwargs) -> Optional[Dict[str, Any]]:
+    """One-off XLA cost of jitted ``fn`` at these args, WITHOUT accumulating
+    into the running totals (bench uses this for per-family attribution)."""
+    return _cost(fn, args, kwargs)
 
 
 def wrap(name: str, jitted):
@@ -203,6 +238,7 @@ def record(name: str, fn, *args, **kwargs) -> None:
     if cost is None:
         return
     _accumulate(name, cost, _shape_key(args, kwargs), None)
+    record_collectives(cost.get("events", ()))
 
 
 def record_device(name: str, device, fn, *args, **kwargs) -> None:
@@ -216,6 +252,7 @@ def record_device(name: str, device, fn, *args, **kwargs) -> None:
     if cost is None:
         return
     _accumulate(name, cost, _shape_key(args, kwargs), str(device))
+    record_collectives(cost.get("events", ()), device)
 
 
 def record_compiled(name: str, compiled, args: Tuple, device=None) -> None:
