@@ -178,6 +178,108 @@ def titanic_arrays():
     return np.asarray(X, np.float32), y
 
 
+def transform_bench():
+    """``bench.py --transform [rows]``: streamed vs per-stage transform wall.
+
+    Times the workflow transform pipeline ONLY (fill + 2 vectorizers +
+    combiner + scaler, fitted once on a head sample) two ways over the same
+    rows: the per-stage host path (what ran above TMOG_FUSE_MAX_ROWS before
+    streaming) and the chunked streaming executor (workflow/stream.py).
+    CPU-proxy friendly — run with JAX_PLATFORMS=cpu; the streamed number
+    reports warm (includes the single compile) and steady separately.
+    """
+    import transmogrifai_tpu.types as T
+    from transmogrifai_tpu import FeatureBuilder
+    from transmogrifai_tpu.columns import Dataset, NumericColumn
+    from transmogrifai_tpu.impl.feature.transformers import FillMissingWithMean
+    from transmogrifai_tpu.impl.feature.vectorizers import (
+        RealVectorizer, StandardScalerVectorizer, VectorsCombiner)
+    from transmogrifai_tpu.utils import flops
+    from transmogrifai_tpu.workflow import stream
+
+    platform, fallback = init_backend()
+    rows = next((int(a) for a in sys.argv[2:] if a.isdigit()), 1_000_000)
+    n_feat = 8
+    rng = np.random.default_rng(0)
+    cols = {}
+    for j in range(n_feat):
+        v = rng.normal(size=rows).astype(np.float32)
+        m = rng.random(rows) > 0.1
+        cols[f"x{j}"] = NumericColumn(T.Real, np.where(m, v, 0.0), m)
+    ds = Dataset(cols)
+    head = Dataset({k: NumericColumn(c.ftype, c.values[:50_000], c.mask[:50_000])
+                    for k, c in ds.columns.items()})
+
+    xs = [FeatureBuilder(f"x{j}", T.Real).extract(field=f"x{j}").as_predictor()
+          for j in range(n_feat)]
+    fm = FillMissingWithMean().set_input(xs[0]).fit(head)
+    m1 = RealVectorizer().set_input(*xs[:4]).fit(head)
+    m2 = RealVectorizer(fill_with_mean=False, fill_value=-1.0).set_input(*xs[4:]).fit(head)
+    comb = VectorsCombiner().set_input(m1.get_output(), m2.get_output())
+    fit_ds = head
+    for t in (fm, m1, m2, comb):
+        fit_ds = fit_ds.with_column(t.get_output().name, t.transform_dataset(fit_ds))
+    sm = StandardScalerVectorizer().set_input(comb.get_output()).fit(fit_ds)
+    layers = [[fm, m1, m2], [comb], [sm]]
+    final = sm.get_output().name
+
+    # per-stage host path (the pre-streaming fallback above the fuse cliff)
+    t0 = time.perf_counter()
+    host = ds
+    for t in (fm, m1, m2, comb, sm):
+        host = host.with_column(t.get_output().name, t.transform_dataset(host))
+    host_s = time.perf_counter() - t0
+
+    # live={final}: the workflow's liveness pass materializes only columns
+    # needed downstream — intermediates stay device-resident (the host path
+    # has no such option; it materializes every stage output)
+    flops.enable()
+    stream.reset_stream_stats()
+    t0 = time.perf_counter()
+    out = stream.apply_streamed(ds, layers, live={final})
+    warm_s = time.perf_counter() - t0
+    assert out is not None, "streaming declined the bench pipeline"
+    np.testing.assert_allclose(out[final].values, host[final].values,
+                               rtol=2e-6, atol=1e-6)
+
+    stream.reset_stream_stats()
+    t0 = time.perf_counter()
+    out = stream.apply_streamed(ds, layers, live={final})
+    steady_s = time.perf_counter() - t0
+    s = stream.stream_stats()
+    streamed_flops = flops.totals().get("streamed") or {}
+    flops.disable()
+
+    report = {
+        "metric": "transform_stream_speedup",
+        "value": round(host_s / steady_s, 2),
+        "unit": "x vs per-stage host path",
+        "rows": rows,
+        "features": n_feat,
+        "vector_width": int(out[final].values.shape[1]),
+        "host_wall_s": round(host_s, 3),
+        "stream_warm_s": round(warm_s, 3),
+        "stream_steady_s": round(steady_s, 3),
+        "transform_rows_per_sec": round(s["transform_rows_per_sec"]),
+        "chunks": s["chunks"],
+        "chunk_rows": s["chunk_rows"],
+        "pad_rows": s["pad_rows"],
+        "buffers": stream.stream_buffers(),
+        "stages_fused": s["stages_fused"],
+        "compiles_steady": s["compiles"],
+        "bytes_streamed_in": round(s["bytes_in"]),
+        "bytes_streamed_out": round(s["bytes_out"]),
+        "overlap_efficiency": round(s["overlap_efficiency"], 3),
+        "streamed_flops_bucket": streamed_flops,
+        "platform": platform,
+        **({"backend_fallback": fallback} if fallback else {}),
+    }
+    print(json.dumps(report))
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "STREAM_BENCH.json"), "w") as f:
+        json.dump(report, f, indent=1)
+
+
 def make_selector(seed: int = 42):
     from transmogrifai_tpu.impl.selector.factories import (
         BinaryClassificationModelSelector)
@@ -356,4 +458,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--transform" in sys.argv:
+        transform_bench()
+    else:
+        main()

@@ -219,6 +219,26 @@ def main():
         "sweep_s": sweep_s,
         "best_model": best_model,
     }
+    # streaming-transform telemetry (workflow/stream.py): train() resets the
+    # window, so these numbers are THIS run's — chunk counts + the <=1
+    # steady-state compile prove the transform layers streamed rather than
+    # falling back to per-stage host transforms above TMOG_FUSE_MAX_ROWS
+    from transmogrifai_tpu.workflow import stream
+    s = stream.stream_stats()
+    if s["streams"]:
+        out["stream"] = {
+            "streams": s["streams"], "chunks": s["chunks"],
+            "chunk_rows": s["chunk_rows"], "pad_rows": s["pad_rows"],
+            "stages_fused": s["stages_fused"], "stages_host": s["stages_host"],
+            "device_only": s["device_only"], "compiles": s["compiles"],
+            "bytes_streamed_in": round(s["bytes_in"]),
+            "bytes_streamed_out": round(s["bytes_out"]),
+            "device_handoffs": s["device_handoffs"],
+            "handoff_bytes": round(s["handoff_bytes"]),
+            "transform_rows_per_sec": round(s["transform_rows_per_sec"]),
+            "overlap_efficiency": round(s["overlap_efficiency"], 3),
+            "fallbacks": s["fallbacks"],
+        }
     if fallback:
         out["backend_fallback"] = fallback
     print(json.dumps(out))

@@ -33,6 +33,10 @@ def _features():
 def test_two_vectorizers_fuse_into_one_launch(monkeypatch):
     from transmogrifai_tpu.impl.feature.vectorizers import RealVectorizer
 
+    # this test is about the single-launch fused path; pin the fuse threshold
+    # above the fixture size so a CI matrix entry forcing streaming
+    # (small TMOG_FUSE_MAX_ROWS) doesn't reroute the layer through stream.py
+    monkeypatch.setenv("TMOG_FUSE_MAX_ROWS", "1000000")
     ds = _mkds()
     label, xs = _features()
     v1 = RealVectorizer().set_input(*xs[:3])

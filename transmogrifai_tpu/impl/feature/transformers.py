@@ -12,6 +12,14 @@ Reference parity (core/.../impl/feature/):
   vector slots by metadata predicate,
 - ``PredictionDeIndexer`` (impl/preparators/PredictionDeIndexer.scala) —
   prediction index -> original string label.
+
+Chunk-safe ``jax_transform`` contract (workflow/stream.py streams these
+stages in fixed-size row chunks): every ``jax_transform`` here is row-wise —
+output row i depends only on input row i and fitted constants — with no
+data-dependent shapes, and ``jax_host_prep`` outputs are row-aligned per
+chunk.  Metadata (``jax_out_metadata``) is computed once per plan and reused
+for every chunk.  A stage that cannot honor this must set
+``jax_chunkable = False`` to stay on the single-launch/host paths.
 """
 from __future__ import annotations
 

@@ -14,6 +14,16 @@ Reference parity:
 Fit statistics are single-pass masked reductions (the SequenceAggregators
 analog, utils/.../spark/SequenceAggregators.scala:41); transforms emit dense
 float32 blocks that concatenate into the model matrix.
+
+Chunk-safe ``jax_transform`` contract (workflow/stream.py): all vectorizer
+``jax_transform``s are row-wise with static output widths fixed by the
+FITTED state (fills / categories / mean+std), never by the data in the
+launch, so they stream in fixed-size row chunks unchanged.  The categorical
+pivot's ``jax_host_prep`` maps labels -> fitted category codes per chunk
+(row-aligned int32 targets; chunk-local ``np.unique`` factorization is
+exact because the fitted category index, not the chunk, defines the
+codes).  ``jax_out_metadata`` runs once per stream plan and is reused for
+every chunk.  Opt out with ``jax_chunkable = False``.
 """
 from __future__ import annotations
 

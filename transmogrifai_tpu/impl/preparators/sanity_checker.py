@@ -500,6 +500,10 @@ class SanityCheckerModel(Model):
                             self.out_metadata)
 
     # ---- fused-layer protocol (workflow/dag._apply_layer_transforms) -------
+    # chunk-safe (workflow/stream.py): a pure per-row column gather with a
+    # keep-set fixed at fit time, so the checker's transform joins the
+    # streamed cross-layer program — at 10M x 500 the host gather alone was
+    # a ~761s stage (SCALE_r05), on-device it rides the existing chunk pull
     def jax_transform(self, *args):
         import jax.numpy as jnp
 

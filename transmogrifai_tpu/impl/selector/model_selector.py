@@ -267,6 +267,16 @@ class ModelSelector(BinaryEstimator, AllowLabelAsInput):
             if prep_w is not None:
                 prep_w = prep_w[sub]
         Xtr = X[train_idx]
+        # device-side handoff: when the streaming transform executor produced
+        # this feature matrix, its chunks are still device-resident — gather
+        # the training rows ON DEVICE and seed the sweep's devcache under
+        # Xtr's identity, so the fused sweep finds a resident buffer instead
+        # of re-uploading the host matrix (workflow/stream.handoff_rows)
+        from ...workflow import stream as _stream
+
+        _stream.handoff_rows(
+            vec_col.values, Xtr,
+            train_idx if keep.all() else np.flatnonzero(keep)[train_idx])
 
         # 3. the sweep (skipped when workflow-level CV already chose a winner)
         if self.best_estimator is not None:

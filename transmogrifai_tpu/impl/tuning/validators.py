@@ -264,8 +264,21 @@ class OpValidator:
                 candidates, max(int(budget // max(per_cand, 1.0)), 1))
             # convert ONCE: devcache keys device buffers by host-array
             # identity, so each chunk's plan must see the SAME ndarray or
-            # every chunk re-uploads and re-quantizes the matrix
-            X = np.ascontiguousarray(np.asarray(X, np.float32))
+            # every chunk re-uploads and re-quantizes the matrix.  When the
+            # selector seeded a streamed device-resident X (f32, contiguous),
+            # the conversion is the identity and the seed survives; any other
+            # dtype/layout gets its cached f32 product carried over so the
+            # device-side handoff is never silently dropped.
+            Xc = np.ascontiguousarray(np.asarray(X, np.float32))
+            if Xc is not X:
+                from ...utils import devcache as _devcache
+
+                prior = _devcache._slot(X)
+                dev = prior.get(("base", np.dtype(np.float32).str, None)) \
+                    if prior else None
+                if dev is not None:
+                    _devcache.seed(Xc, dev, np.float32)
+            X = Xc
             plans = []
             for chunk in chunks:
                 plan = build_sweep_plan(chunk, X, y, train_w, self.evaluator)

@@ -111,6 +111,28 @@ def device_array(arr, dtype=None, tag: str = "base", device=None):
     return dev
 
 
+def seed(arr: np.ndarray, dev, dtype=None, tag: str = "base",
+         device=None) -> bool:
+    """Pre-populate ``arr``'s cached device product with ``dev``.
+
+    The streaming transform executor uses this to hand a freshly computed
+    device-resident matrix straight to the selector sweep: after seeding,
+    ``device_array(arr, dtype)`` returns ``dev`` without re-uploading the
+    host copy.  The caller GUARANTEES ``dev`` equals ``arr`` (same values,
+    rows, dtype) — the contract is the same as the no-in-place-mutation one
+    above.  Returns False when ``arr`` cannot be weakref'd (nothing cached).
+    """
+    if not isinstance(arr, np.ndarray):
+        return False
+    products = _slot(arr)
+    if products is None:
+        return False
+    key = (tag, None if dtype is None else np.dtype(dtype).str,
+           None if device is None else str(device))
+    products[key] = dev
+    return True
+
+
 def derived(arr: np.ndarray, key: Tuple, build) -> Any:
     """Cached derived product of ``arr`` (e.g. quantized bins + edges).
 

@@ -40,6 +40,12 @@ _collectives: Dict[str, Dict[str, float]] = {}
 #: adjustment — this bucket records the AVOIDED build FLOPs separately
 #: (trace-time estimates: loop bodies counted once, like the collectives).
 _hist_subtracted: Dict[str, float] = {"levels": 0.0, "flops_avoided": 0.0}
+#: streamed transform-pipeline traffic (workflow/stream.py): bytes pushed
+#: through device_put per chunk and pulled back for terminal columns, plus
+#: the chunk/launch counts — the "intermediates never leave the device"
+#: claim made auditable next to the FLOPs totals
+_streamed: Dict[str, float] = {"bytes_in": 0.0, "bytes_out": 0.0,
+                               "chunks": 0.0, "streams": 0.0}
 _cost_cache: Dict[Tuple, Optional[Dict[str, float]]] = {}
 
 
@@ -63,6 +69,7 @@ def reset() -> None:
     _by_device.clear()
     _collectives.clear()
     _hist_subtracted.update(levels=0.0, flops_avoided=0.0)
+    _streamed.update(bytes_in=0.0, bytes_out=0.0, chunks=0.0, streams=0.0)
 
 
 def totals() -> Dict[str, Any]:
@@ -91,7 +98,26 @@ def totals() -> Dict[str, Any]:
         for k, v in _by_device.items()}
     out["collectives"] = {k: dict(v) for k, v in _collectives.items()}
     out["hist_subtracted"] = dict(_hist_subtracted)
+    out["streamed"] = dict(_streamed)
     return out
+
+
+def record_streamed(bytes_in: float, bytes_out: float, chunks: int) -> None:
+    """Accumulate ONE streamed transform run's transfer traffic
+    (workflow/stream.execute calls this with the run's deltas).  No-op
+    unless enabled, like every other bucket here."""
+    if not _enabled:
+        return
+    _streamed["bytes_in"] += float(bytes_in)
+    _streamed["bytes_out"] += float(bytes_out)
+    _streamed["chunks"] += float(chunks)
+    _streamed["streams"] += 1.0
+
+
+def streamed_totals() -> Dict[str, float]:
+    """{"bytes_in", "bytes_out", "chunks", "streams"}: streamed transform
+    transfer traffic (same shape as totals()["streamed"])."""
+    return dict(_streamed)
 
 
 def record_collectives(colls, device=None) -> None:

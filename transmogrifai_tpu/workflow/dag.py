@@ -104,40 +104,53 @@ def _fused_layer(ds: Dataset, fusables: Sequence[Transformer]) -> Dict[str, Any]
     from .. import types as T
     from ..columns import NumericColumn, VectorColumn
 
+    # each DISTINCT input column uploads once per launch: stages in one layer
+    # commonly share inputs, and a second jnp.asarray on the same host array
+    # would be a second device buffer
     flat = []
-    sizes = []
+    pos_of: Dict[Any, int] = {}
+    stage_pos = []
+
+    def _upload(key, build):
+        i = pos_of.get(key)
+        if i is None:
+            i = len(flat)
+            pos_of[key] = i
+            flat.append(build())
+        return i
+
     for t in fusables:
-        k = 0
+        idxs = []
         if hasattr(t, "jax_host_prep"):
             # host-side prep (e.g. string -> category codes); the expansion
-            # and everything downstream run inside the fused XLA launch
+            # and everything downstream run inside the fused XLA launch —
+            # prep outputs are per-stage, so they do not dedupe
             for a in t.jax_host_prep([ds[f.name] for f in t.inputs]):
+                idxs.append(len(flat))
                 flat.append(jnp.asarray(a))
-                k += 1
         else:
             for f in t.inputs:
                 col = ds[f.name]
                 if isinstance(col, NumericColumn):
-                    flat += [jnp.asarray(col.values, jnp.float32),
-                             jnp.asarray(col.mask)]
-                    k += 2
+                    idxs.append(_upload(
+                        (f.name, "v"),
+                        lambda c=col: jnp.asarray(c.values, jnp.float32)))
+                    idxs.append(_upload(
+                        (f.name, "m"), lambda c=col: jnp.asarray(c.mask)))
                 else:
-                    flat.append(jnp.asarray(col.values, jnp.float32))
-                    k += 1
-        sizes.append(k)
-    key = tuple(id(t) for t in fusables)
+                    idxs.append(_upload(
+                        (f.name, "vec"),
+                        lambda c=col: jnp.asarray(c.values, jnp.float32)))
+        stage_pos.append(tuple(idxs))
+    key = (tuple(id(t) for t in fusables), tuple(stage_pos))
     cached = _FUSED_JIT.get(key)
     if cached is None:
         ts = list(fusables)
-        szs = tuple(sizes)
+        sp = tuple(stage_pos)
 
         def fused(args):
-            outs = []
-            i = 0
-            for t, k in zip(ts, szs):
-                outs.append(t.jax_transform(*args[i:i + k]))
-                i += k
-            return outs
+            return [t.jax_transform(*(args[i] for i in idxs))
+                    for t, idxs in zip(ts, sp)]
 
         cached = (jax.jit(fused), ts)  # ts ref pins ids against gc reuse
         _FUSED_JIT[key] = cached
@@ -159,26 +172,39 @@ def _fused_layer(ds: Dataset, fusables: Sequence[Transformer]) -> Dict[str, Any]
     return new_cols
 
 
-#: above this many rows the fused DEVICE layer is skipped in favor of the
-#: stages' host (numpy) batch functions: every fused output must come back
-#: to the host columnar store, and on a tunneled backend device->host reads
-#: run ~20 MB/s (round-5 link probe) — a 10M x 500 pull alone would cost
-#: ~18 min.  Co-located deployments can raise TMOG_FUSE_MAX_ROWS.
+#: above this many rows the single-launch fused layer is skipped: it
+#: materializes every fused output full-width back to the host columnar
+#: store, and on a tunneled backend device->host reads run ~20 MB/s
+#: (round-5 link probe) — a 10M x 500 pull alone would cost ~18 min.
+#: Above the threshold the STREAMING executor (workflow/stream.py) takes
+#: over instead of the old per-stage host fallback: fixed-size chunks,
+#: double-buffered uploads, device-resident intermediates, terminal-only
+#: pulls.  TMOG_STREAM=0 restores the pre-stream host fallback.
 def _fuse_max_rows() -> int:
     import os
 
-    return int(os.environ.get("TMOG_FUSE_MAX_ROWS", 200_000))
+    v = os.environ.get("TMOG_FUSE_MAX_ROWS", "").strip()
+    return int(float(v)) if v else 200_000
 
 
-def _apply_layer_transforms(ds: Dataset, transformers: Sequence[Transformer]) -> Dataset:
+def _apply_layer_transforms(ds: Dataset, transformers: Sequence[Transformer],
+                            try_stream: bool = True) -> Dataset:
     """Fused layer transform (applyOpTransformations analog,
     FitStagesUtil.scala:96): transformers implementing the ``jax_transform``
     protocol compile into ONE jitted computation per layer; the rest apply
-    per stage off the same input batch."""
+    per stage off the same input batch.  Above the fuse-row threshold the
+    layer streams in chunks (workflow/stream.py) instead."""
+    if try_stream and len(ds) > _fuse_max_rows():
+        from . import stream as stream_mod
+
+        out = stream_mod.apply_streamed(ds, [list(transformers)])
+        if out is not None:
+            return out
     new_cols = {}
     fusables = ([t for t in transformers if _fusable(t, ds)]
                 if len(ds) <= _fuse_max_rows() else [])
-    rest = [t for t in transformers if t not in fusables]
+    fusable_ids = {id(t) for t in fusables}
+    rest = [t for t in transformers if id(t) not in fusable_ids]
     if len(fusables) == 1:  # no fusion win; avoid a second jit cache entry
         rest = list(transformers)
         fusables = []
@@ -260,6 +286,61 @@ def _maybe_free(dag: List[Layer], layer_idx: int, ds: Dataset,
     return ds.drop(dead) if dead else ds
 
 
+def _live_after(dag: List[Layer], layer_idx: int, responses: set) -> Set[str]:
+    """Column names still needed after ``layer_idx`` — the complement of
+    ``_dead_columns`` for not-yet-materialized stream outputs."""
+    live: Set[str] = set(responses)
+    for later in dag[layer_idx + 1:]:
+        for stage in later:
+            for f in stage.inputs:
+                live.add(f.name)
+    if dag:
+        for stage in dag[-1]:
+            for f in stage.get_outputs():
+                live.add(f.name)
+    return live
+
+
+def _selector_input_names(dag: List[Layer], layer_idx: int) -> Set[str]:
+    """Inputs of any downstream ModelSelector — candidates for the stream's
+    device-side X handoff into the sweep."""
+    return {f.name for later in dag[layer_idx + 1:] for s in later
+            if getattr(s, "is_model_selector", False) for f in s.inputs}
+
+
+def _total_cells(ds: Dataset) -> int:
+    try:
+        n = len(ds)
+    except Exception:
+        return 0
+    return sum(n * (getattr(c, "width", None) or 1)
+               for c in ds.columns.values())
+
+
+def _apply_pending(ds: Dataset, pending: List[Tuple[int, List[Transformer]]],
+                   dag: List[Layer], responses: set,
+                   handoff: Optional[Set[str]] = None) -> Dataset:
+    """Apply a run of deferred transformer layers, streaming them as ONE
+    cross-layer chunked program when the data is past the fuse-row cliff.
+    Liveness-based skipping of intermediates only engages past the same
+    cell threshold as ``_maybe_free`` — below it, materializing everything
+    keeps small-data debugging (and test fixtures) byte-identical."""
+    last_li = pending[-1][0]
+    if len(ds) > _fuse_max_rows():
+        from . import stream as stream_mod
+
+        live = (_live_after(dag, last_li, responses)
+                if _total_cells(ds) >= FREE_INTERMEDIATES_CELLS else None)
+        out = stream_mod.apply_streamed(
+            ds, [ts for _, ts in pending], live=live, handoff=handoff)
+        if out is not None:
+            return _maybe_free(dag, last_li, out, responses)
+    for li, ts in pending:
+        ds = _apply_layer_transforms(ds, ts, try_stream=False)
+        ds = _maybe_free(dag, li, ds, responses)
+    return ds
+
+
 def fit_and_transform_dag(dag: List[Layer], train: Dataset,
                           test: Optional[Dataset] = None,
                           fitted_so_far: Optional[Dict[str, PipelineStage]] = None,
@@ -272,11 +353,34 @@ def fit_and_transform_dag(dag: List[Layer], train: Dataset,
     stages are applied, not refitted.  On large data, intermediate columns
     that no later stage consumes are freed after each layer (KeepRawFeatures
     defaults false in the reference, OpWorkflowModel.scala:458-463).
+
+    Transformer-only layers (pre-fitted models and pure transformers) are
+    DEFERRED and flushed together right before the next estimator fit needs
+    their outputs — past the fuse-row cliff the whole run streams as one
+    cross-layer chunked program (workflow/stream.py) instead of bouncing
+    each layer's full-width output through the host store.
     """
     fitted_so_far = fitted_so_far or {}
     responses = responses or set()
     fitted: List[PipelineStage] = []
+    pending: List[Tuple[int, List[Transformer]]] = []
+
+    def flush(train: Dataset, test: Optional[Dataset]
+              ) -> Tuple[Dataset, Optional[Dataset]]:
+        if not pending:
+            return train, test
+        handoff = _selector_input_names(dag, pending[-1][0])
+        train = _apply_pending(train, pending, dag, responses,
+                               handoff=handoff or None)
+        if test is not None:
+            test = _apply_pending(test, pending, dag, responses)
+        pending.clear()
+        return train, test
+
     for li, layer in enumerate(dag):
+        if any(isinstance(s, Estimator) and s.uid not in fitted_so_far
+               for s in layer):
+            train, test = flush(train, test)
         transformers: List[Transformer] = []
         for stage in layer:
             if stage.uid in fitted_so_far:
@@ -293,17 +397,22 @@ def fit_and_transform_dag(dag: List[Layer], train: Dataset,
                 fitted.append(stage)
             else:
                 raise TypeError(f"Stage {stage} is neither Estimator nor Transformer")
-        train = _apply_layer_transforms(train, transformers)
-        train = _maybe_free(dag, li, train, responses)
-        if test is not None:
-            test = _apply_layer_transforms(test, transformers)
-            test = _maybe_free(dag, li, test, responses)
+        pending.append((li, transformers))
+    train, test = flush(train, test)
     return FittedDAG(train=train, test=test, fitted_stages=fitted)
 
 
-def apply_transformations_dag(ds: Dataset, dag: List[Layer]) -> Dataset:
+def apply_transformations_dag(ds: Dataset, dag: List[Layer],
+                              keep: Optional[Sequence[str]] = None) -> Dataset:
     """Scoring path: all stages must already be transformers
-    (OpWorkflowCore.applyTransformationsDAG, OpWorkflowCore.scala:324)."""
+    (OpWorkflowCore.applyTransformationsDAG, OpWorkflowCore.scala:324).
+
+    Past the fuse-row cliff the ENTIRE scoring DAG streams as one chunked
+    program.  ``keep`` (optional) names the columns the caller consumes
+    afterwards (e.g. the result features) — device-resident intermediates
+    not in it are never materialized to host; default keeps every output.
+    """
+    layers: List[List[Transformer]] = []
     for layer in dag:
         transformers = []
         for stage in layer:
@@ -311,7 +420,18 @@ def apply_transformations_dag(ds: Dataset, dag: List[Layer]) -> Dataset:
                 raise TypeError(
                     f"Scoring DAG contains unfitted estimator {stage}; fit the workflow first")
             transformers.append(stage)
-        ds = _apply_layer_transforms(ds, transformers)
+        layers.append(transformers)
+    if layers and len(ds) > _fuse_max_rows():
+        from . import stream as stream_mod
+
+        live = None
+        if keep is not None and _total_cells(ds) >= FREE_INTERMEDIATES_CELLS:
+            live = set(keep) | {f.name for s in dag[-1] for f in s.get_outputs()}
+        out = stream_mod.apply_streamed(ds, layers, live=live)
+        if out is not None:
+            return out
+    for transformers in layers:
+        ds = _apply_layer_transforms(ds, transformers, try_stream=False)
     return ds
 
 

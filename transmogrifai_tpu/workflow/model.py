@@ -34,8 +34,8 @@ class OpWorkflowModel(OpWorkflowCore):
         dag = self.dag
 
         def fn(raw: Dataset) -> Dataset:
-            full = dag_util.apply_transformations_dag(raw, dag)
             names = [f.name for f in self.result_features]
+            full = dag_util.apply_transformations_dag(raw, dag, keep=names)
             out = full.select([n for n in names if n in full.columns])
             return out
 
@@ -47,8 +47,12 @@ class OpWorkflowModel(OpWorkflowCore):
         """Score a dataset (defaults: KeepRawFeatures=false,
         KeepIntermediateFeatures=false — OpWorkflowModel.scala:458-463)."""
         raw = self._raw_for_scoring(data, params)
-        full = dag_util.apply_transformations_dag(raw, self.dag)
         names = [f.name for f in self.result_features]
+        # liveness hint for the streamed scoring path: intermediates can stay
+        # device-only unless the caller asked to keep them
+        hint = None if keep_intermediate_features else \
+            names + ([f.name for f in self.raw_features] if keep_raw_features else [])
+        full = dag_util.apply_transformations_dag(raw, self.dag, keep=hint)
         if keep_intermediate_features:
             keep = full.column_names()
         elif keep_raw_features:
@@ -73,7 +77,8 @@ class OpWorkflowModel(OpWorkflowCore):
                            ) -> Tuple[Dataset, Dict[str, float]]:
         """OpWorkflowModel.scala:298."""
         raw = self._raw_for_scoring(data, params)
-        full = dag_util.apply_transformations_dag(raw, self.dag)
+        full = dag_util.apply_transformations_dag(
+            raw, self.dag, keep=[f.name for f in self.result_features])
         scores = full.select([f.name for f in self.result_features if f.name in full.columns])
         metrics = self._evaluate_on(evaluator, full)
         return scores, metrics
@@ -82,7 +87,8 @@ class OpWorkflowModel(OpWorkflowCore):
                  params: Optional[Dict[str, Any]] = None) -> Dict[str, float]:
         """OpWorkflowModel.scala:326."""
         raw = self._raw_for_scoring(data, params)
-        full = dag_util.apply_transformations_dag(raw, self.dag)
+        full = dag_util.apply_transformations_dag(
+            raw, self.dag, keep=[f.name for f in self.result_features])
         return self._evaluate_on(evaluator, full)
 
     def _evaluate_on(self, evaluator, full: Dataset) -> Dict[str, float]:

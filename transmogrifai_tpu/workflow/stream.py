@@ -1,0 +1,635 @@
+"""Streaming cross-layer transform executor — chunked, double-buffered,
+device-resident feature materialization.
+
+The per-layer fused path (`workflow/dag._fused_layer`) compiles one layer at
+a time and materializes every fused output back into the host columnar store
+between layers.  That full-width device->host bounce is why the fused device
+path used to be disabled above ``TMOG_FUSE_MAX_ROWS`` — on a tunneled
+backend the pull link runs ~20 MB/s and a 10M x 500 round trip alone costs
+minutes per layer.  This module removes the cliff:
+
+- ``build_plan`` walks a run of DAG layers and compiles the entire fusable
+  transform sub-DAG (all layers, up to the first unfusable stage per output
+  chain) into ONE jitted per-chunk program.  Stage outputs consumed only by
+  later fused stages stay device-resident for the whole chunk; only
+  *terminal* columns (consumed by a host stage or live downstream) are
+  pulled, once per chunk.
+- ``execute`` streams fixed-size row chunks through the program: constant
+  chunk shape (``TMOG_TRANSFORM_CHUNK_ROWS``) with a zero-padded, mask-aware
+  tail so there is exactly ONE compilation; async ``jax.device_put`` of
+  chunk k+1 overlaps the compute of chunk k (``TMOG_STREAM_BUFFERS``
+  bounds the in-flight window); input buffers are donated so XLA reuses
+  them in place.
+- When a downstream consumer is the model selector, the final feature
+  matrix chunks are additionally kept device-side (``device_view`` /
+  ``handoff_rows``) and seeded into ``utils.devcache`` so the selector
+  sweep's ``devcache.device_array(X, float32)`` finds the resident buffer
+  and skips the host->device re-upload entirely.
+
+Chunk-safe ``jax_transform`` contract (documented here, asserted in the
+planner): stages must be row-wise — output row i depends only on input
+row i — with no data-dependent shapes, and ``jax_host_prep``/``
+jax_out_metadata`` must tolerate per-chunk slices (metadata is computed
+ONCE at plan time and reused for every chunk).  All shipped jax stages
+satisfy this; the same zero-fill + mask idiom is proven by
+``parallel/stats.py``'s one-pass streaming moments.
+
+Telemetry mirrors ``ops/sweep.run_stats``: ``stream_stats()`` reports
+chunk counts, streamed bytes, compile counts (``<=1`` in steady state) and
+the transfer-wait share of wall time (overlap efficiency).
+"""
+from __future__ import annotations
+
+import os
+import time
+import warnings
+import weakref
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..columns import Dataset, NumericColumn, ObjectColumn, VectorColumn
+
+
+# ---------------------------------------------------------------------------
+# Env knobs
+# ---------------------------------------------------------------------------
+def _env_int(name: str, default: int) -> int:
+    """Int env knob; empty string (e.g. an unset CI matrix slot) = default."""
+    v = os.environ.get(name, "").strip()
+    return int(float(v)) if v else default
+
+
+def chunk_rows() -> int:
+    """Rows per streamed chunk (TMOG_TRANSFORM_CHUNK_ROWS, default 256Ki)."""
+    return max(1, _env_int("TMOG_TRANSFORM_CHUNK_ROWS", 262_144))
+
+
+def stream_buffers() -> int:
+    """In-flight chunk window (TMOG_STREAM_BUFFERS, default 2 = double
+    buffering: chunk k+1 uploads while chunk k computes)."""
+    return max(1, _env_int("TMOG_STREAM_BUFFERS", 2))
+
+
+def enabled() -> bool:
+    """TMOG_STREAM=0 disables streaming (restores the pre-stream host path
+    above TMOG_FUSE_MAX_ROWS)."""
+    return os.environ.get("TMOG_STREAM", "1") != "0"
+
+
+def handoff_budget_bytes() -> int:
+    """Device-byte budget for keeping selector-bound output chunks resident
+    (TMOG_STREAM_HANDOFF_BYTES, default 2 GiB).  Above it the handoff is
+    skipped and the selector re-uploads from host as before."""
+    return _env_int("TMOG_STREAM_HANDOFF_BYTES", 2_147_483_648)
+
+
+# ---------------------------------------------------------------------------
+# Telemetry (ops/sweep.run_stats pattern)
+# ---------------------------------------------------------------------------
+_stats: Dict[str, Any] = {}
+
+
+def reset_stream_stats() -> None:
+    _stats.clear()
+    _stats.update(
+        streams=0, chunks=0, rows=0, pad_rows=0, chunk_rows=0,
+        stages_fused=0, stages_host=0, layers=0,
+        terminals=0, device_only=0,
+        bytes_in=0.0, bytes_out=0.0, compiles=0,
+        device_handoffs=0, handoff_bytes=0.0,
+        upload_s=0.0, pull_wait_s=0.0, wall_s=0.0,
+        fallbacks=[],
+    )
+
+
+reset_stream_stats()
+
+
+def stream_stats() -> Dict[str, Any]:
+    out = dict(_stats)
+    out["fallbacks"] = list(_stats["fallbacks"])
+    wall = out["wall_s"]
+    # device-busy vs transfer-wait: share of stream wall NOT spent blocked
+    # on host-side chunk prep/upload or on output pulls
+    out["overlap_efficiency"] = (
+        max(0.0, 1.0 - (out["pull_wait_s"] + out["upload_s"]) / wall)
+        if wall > 0 else 0.0)
+    out["transform_rows_per_sec"] = out["rows"] / wall if wall > 0 else 0.0
+    return out
+
+
+def record_fallback(reason: str, **detail: Any) -> None:
+    _stats["fallbacks"].append({"reason": reason, **detail})
+
+
+# ---------------------------------------------------------------------------
+# Plan
+# ---------------------------------------------------------------------------
+class _ProxyCol:
+    """Plan-time stand-in for a device-resident intermediate: carries only
+    what ``jax_out_metadata`` implementations read (.metadata/.width/.ftype)."""
+
+    def __init__(self, ftype, metadata=None, width=None):
+        self.ftype = ftype
+        self.metadata = metadata
+        self.width = width
+
+
+@dataclass
+class _StreamStage:
+    stage: Any
+    prep: bool                                  # per-chunk jax_host_prep
+    arg_specs: Tuple[Tuple[str, str], ...]      # (kind, column name)
+    out_name: str
+    out_kind: str                               # "numeric" | "vector"
+    ftype: Any
+    metadata: Any                               # VectorMetadata (vector outs)
+    terminal: bool = True
+
+
+@dataclass
+class StreamPlan:
+    stages: List[_StreamStage]
+    host_layers: List[List[Any]]                # per input layer, unfused rest
+    base_numeric: List[str]
+    base_vector: List[str]
+    handoff: Set[str] = field(default_factory=set)
+    key: Tuple = ()
+
+    @property
+    def n_stream(self) -> int:
+        return len(self.stages)
+
+
+def _try_plan_stage(t, ds: Dataset, internal: Dict[str, str],
+                    proxies: Dict[str, Any]) -> Optional[_StreamStage]:
+    """One stage's slot in the streamed program, or None -> host path.
+
+    Stream-fusable = has ``jax_transform``, single output, and every input
+    is either a base Numeric/Vector column of ``ds`` or the output of an
+    earlier fused stage (device-resident).  ``jax_host_prep`` stages fuse
+    only when ALL inputs are base columns — host prep needs host data, so a
+    chain through a device-resident intermediate is cut here (the stage and
+    its dependents run host-side after the stream, preserving DAG order).
+    """
+    if not (hasattr(t, "jax_transform") and getattr(t, "n_outputs", 0) == 1):
+        return None
+    # chunk-safety is opt-out: the fused-layer protocol is row-wise by
+    # construction (every shipped jax_transform maps input row i to output
+    # row i with no data-dependent shapes); a stage whose device math needs
+    # the whole column at once must set jax_chunkable = False to stay on
+    # the single-launch / host paths
+    if not getattr(t, "jax_chunkable", True):
+        return None
+    names = [f.name for f in t.inputs]
+    if hasattr(t, "jax_host_prep"):
+        if any(nm in internal for nm in names):
+            return None
+        cols = [ds.columns.get(nm) for nm in names]
+        if any(c is None for c in cols):
+            return None
+        ready = getattr(t, "jax_host_ready", None)
+        if ready is not None and not ready(cols):
+            return None
+        prep, specs, in_cols = True, [], cols
+    else:
+        prep, specs, in_cols = False, [], []
+        for nm in names:
+            if nm in internal:
+                if internal[nm] == "numeric":
+                    specs += [("inv", nm), ("inm", nm)]
+                else:
+                    specs.append(("iv", nm))
+                in_cols.append(proxies[nm])
+            else:
+                c = ds.columns.get(nm)
+                if isinstance(c, NumericColumn):
+                    specs += [("nv", nm), ("nm", nm)]
+                elif isinstance(c, VectorColumn):
+                    specs.append(("bv", nm))
+                else:
+                    return None
+                in_cols.append(c)
+    out_feat = t.get_outputs()[0]
+    kind = ("numeric" if getattr(t, "jax_output", "vector") == "numeric"
+            else "vector")
+    vm = None
+    if kind == "vector":
+        try:
+            # per-chunk metadata reuse: built ONCE here, never per chunk
+            vm = t.jax_out_metadata(in_cols)
+        except Exception:
+            return None  # proxy lacked what this stage needs -> host path
+    return _StreamStage(stage=t, prep=prep, arg_specs=tuple(specs),
+                        out_name=out_feat.name, out_kind=kind,
+                        ftype=out_feat.ftype, metadata=vm)
+
+
+def build_plan(ds: Dataset, layers: Sequence[Sequence[Any]],
+               live: Optional[Set[str]] = None,
+               handoff: Optional[Set[str]] = None) -> Optional[StreamPlan]:
+    """Compile-plan a run of DAG layers into one streamed program.
+
+    ``live``: column names needed after these layers (None = keep every
+    output).  Fused outputs consumed only inside the plan and not live are
+    never materialized to host — the ``_dead_columns``-style liveness win.
+    ``handoff``: names whose device chunks should stay resident for the
+    model-selector handoff.  Returns None when fewer than two stages fuse
+    (no cross-stage win; callers fall back to the per-layer paths).
+    """
+    internal: Dict[str, str] = {}
+    proxies: Dict[str, Any] = {}
+    stages: List[_StreamStage] = []
+    host_layers: List[List[Any]] = []
+    base_numeric: List[str] = []
+    base_vector: List[str] = []
+    seen: Set[str] = set()
+
+    for layer in layers:
+        host_this: List[Any] = []
+        for t in layer:
+            entry = _try_plan_stage(t, ds, internal, proxies)
+            if entry is None:
+                host_this.append(t)
+                continue
+            stages.append(entry)
+            internal[entry.out_name] = entry.out_kind
+            if entry.out_kind == "numeric":
+                proxies[entry.out_name] = _ProxyCol(entry.ftype)
+            else:
+                vm = entry.metadata
+                proxies[entry.out_name] = _ProxyCol(
+                    T.OPVector, metadata=vm,
+                    width=len(vm.columns) if vm is not None else None)
+            for kind, nm in entry.arg_specs:
+                if kind in ("nv", "nm") and nm not in seen:
+                    seen.add(nm)
+                    base_numeric.append(nm)
+                elif kind == "bv" and nm not in seen:
+                    seen.add(nm)
+                    base_vector.append(nm)
+        host_layers.append(host_this)
+
+    if len(stages) < 2:
+        return None
+
+    host_inputs = {f.name for lay in host_layers for t in lay
+                   for f in t.inputs}
+    for e in stages:
+        e.terminal = (e.out_name in host_inputs
+                      or live is None or e.out_name in live)
+    hand = set(handoff or ()) & {e.out_name for e in stages if e.terminal}
+    key = (tuple(id(e.stage) for e in stages),
+           tuple(e.arg_specs for e in stages),
+           tuple(e.terminal for e in stages))
+    return StreamPlan(stages=stages, host_layers=host_layers,
+                      base_numeric=base_numeric, base_vector=base_vector,
+                      handoff=hand, key=key)
+
+
+# ---------------------------------------------------------------------------
+# Jitted per-chunk program (bounded cache, one compile per plan shape)
+# ---------------------------------------------------------------------------
+_PROGRAMS: "OrderedDict[Tuple, Tuple[Any, List[_StreamStage]]]" = OrderedDict()
+_PROGRAMS_MAX = 16
+
+
+def _program_for(plan: StreamPlan):
+    import jax
+
+    cached = _PROGRAMS.get(plan.key)
+    if cached is None:
+        stages = list(plan.stages)
+
+        def program(args):
+            env: Dict[str, Any] = {}
+            outs: Dict[str, Any] = {}
+            for si, e in enumerate(stages):
+                if e.prep:
+                    call = list(args[f"p{si}"])
+                else:
+                    call = []
+                    for kind, nm in e.arg_specs:
+                        if kind == "iv":
+                            call.append(env[nm])
+                        elif kind == "inv":
+                            call.append(env[nm][0])
+                        elif kind == "inm":
+                            call.append(env[nm][1])
+                        else:
+                            call.append(args[f"{kind}:{nm}"])
+                res = e.stage.jax_transform(*call)
+                env[e.out_name] = res
+                if e.terminal:
+                    outs[e.out_name] = res
+            return outs
+
+        # donated inputs: each chunk's upload buffers are dead after the
+        # launch, so XLA may write outputs over them
+        cached = (jax.jit(program, donate_argnums=(0,)), stages)
+        _PROGRAMS[plan.key] = cached
+        while len(_PROGRAMS) > _PROGRAMS_MAX:
+            _PROGRAMS.popitem(last=False)
+    else:
+        _PROGRAMS.move_to_end(plan.key)
+    return cached[0]
+
+
+def _cache_size(jitted) -> Optional[int]:
+    try:
+        return int(jitted._cache_size())
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Chunk building
+# ---------------------------------------------------------------------------
+def _slice_col(col, lo: int, hi: int):
+    if isinstance(col, NumericColumn):
+        return NumericColumn(col.ftype, col.values[lo:hi], col.mask[lo:hi])
+    if isinstance(col, VectorColumn):
+        return VectorColumn(col.ftype, col.values[lo:hi], col.metadata)
+    if isinstance(col, ObjectColumn):
+        return ObjectColumn(col.ftype, col.values[lo:hi])
+    raise TypeError(f"cannot slice column {type(col).__name__} for streaming")
+
+
+def _pad0(a: np.ndarray, pad: int) -> np.ndarray:
+    """Zero-pad along axis 0 to the constant chunk shape.  Padded rows are
+    masked out (numeric masks pad False) and sliced off every pulled output,
+    so their values are free to be garbage — zeros keep XLA finite-safe."""
+    if not pad:
+        return a
+    return np.concatenate(
+        [a, np.zeros((pad,) + a.shape[1:], a.dtype)], axis=0)
+
+
+def _host_chunk_args(plan: StreamPlan, ds: Dataset, lo: int, hi: int,
+                     C: int) -> Tuple[Dict[str, Any], float]:
+    rows = hi - lo
+    pad = C - rows
+    args: Dict[str, Any] = {}
+    nbytes = 0.0
+    for nm in plan.base_numeric:
+        col = ds[nm]
+        v = _pad0(np.ascontiguousarray(col.values[lo:hi], np.float32), pad)
+        m = _pad0(np.ascontiguousarray(col.mask[lo:hi]), pad)
+        args[f"nv:{nm}"] = v
+        args[f"nm:{nm}"] = m
+        nbytes += v.nbytes + m.nbytes
+    for nm in plan.base_vector:
+        col = ds[nm]
+        v = _pad0(np.ascontiguousarray(col.values[lo:hi], np.float32), pad)
+        args[f"bv:{nm}"] = v
+        nbytes += v.nbytes
+    for si, e in enumerate(plan.stages):
+        if not e.prep:
+            continue
+        cols = [_slice_col(ds[f.name], lo, hi) for f in e.stage.inputs]
+        preps = []
+        for a in e.stage.jax_host_prep(cols):
+            a = np.asarray(a)
+            if a.shape[:1] != (rows,):
+                raise ValueError(
+                    f"jax_host_prep of {e.stage} is not row-aligned "
+                    f"({a.shape} for {rows} rows) — not chunk-safe")
+            a = _pad0(a, pad)
+            preps.append(a)
+            nbytes += a.nbytes
+        args[f"p{si}"] = preps
+    return args, nbytes
+
+
+# ---------------------------------------------------------------------------
+# Device-view registry (model-selector handoff)
+# ---------------------------------------------------------------------------
+_views: Dict[int, Dict[str, Any]] = {}
+
+
+def _register_view(host_arr: np.ndarray, chunks: List[Tuple[Any, int]],
+                   n_rows: int) -> bool:
+    """Remember the device-resident chunks behind an assembled host matrix,
+    keyed (weakly) by the host array's identity — the devcache idiom."""
+    total = sum(int(a.nbytes) * r // max(1, a.shape[0]) for a, r in chunks)
+    if total > handoff_budget_bytes():
+        record_fallback("handoff_over_budget", bytes=total)
+        return False
+    key = id(host_arr)
+    try:
+        ref = weakref.ref(host_arr, lambda _r, k=key: _views.pop(k, None))
+    except TypeError:
+        return False
+    _views[key] = {"_ref": ref, "chunks": list(chunks), "full": None,
+                   "rows": n_rows}
+    return True
+
+
+def device_view(host_arr) -> Optional[Any]:
+    """The device-resident copy of a streamed terminal matrix, or None.
+    Chunks are concatenated lazily on first use (tail padding sliced off)."""
+    ent = _views.get(id(host_arr))
+    if ent is None:
+        return None
+    if ent["full"] is None:
+        import jax.numpy as jnp
+
+        parts = [a if int(a.shape[0]) == r else a[:r]
+                 for a, r in ent["chunks"]]
+        ent["full"] = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        ent["chunks"] = []  # drop per-chunk refs; keep one buffer
+    return ent["full"]
+
+
+def handoff_rows(src_host, dst_host, idx) -> bool:
+    """Device-side row gather: when ``src_host`` has a streamed device view,
+    compute ``src[idx]`` on device and seed it into devcache under
+    ``dst_host``'s identity, so the sweep's ``device_array(dst, float32)``
+    resolves to the resident buffer and the host matrix never re-uploads."""
+    view = device_view(src_host)
+    if view is None:
+        return False
+    import jax.numpy as jnp
+
+    from ..utils import devcache
+
+    dev = jnp.take(view, jnp.asarray(np.asarray(idx)), axis=0)
+    if not devcache.seed(dst_host, dev, np.float32):
+        return False
+    _stats["device_handoffs"] += 1
+    _stats["handoff_bytes"] += float(dev.nbytes)
+    return True
+
+
+def clear_views() -> None:
+    _views.clear()
+
+
+# ---------------------------------------------------------------------------
+# Execution
+# ---------------------------------------------------------------------------
+def execute(plan: StreamPlan, ds: Dataset) -> Dict[str, Any]:
+    """Stream ``ds`` through the plan's jitted per-chunk program.
+
+    Returns the materialized terminal columns (name -> Column).  Uses a
+    bounded in-flight window: JAX dispatch is async, so while chunk k's
+    program runs, chunk k+1's host slices are prepared and uploaded; pulls
+    block only when the window (TMOG_STREAM_BUFFERS) is full.
+    """
+    import jax
+
+    C = chunk_rows()
+    B = stream_buffers()
+    n = len(ds)
+    jitted = _program_for(plan)
+    cs_before = _cache_size(jitted)
+    bytes_in0 = _stats["bytes_in"]
+    bytes_out0 = _stats["bytes_out"]
+    t_wall = time.perf_counter()
+
+    out_vals: Dict[str, np.ndarray] = {}
+    out_masks: Dict[str, np.ndarray] = {}
+    hand_chunks: Dict[str, List[Tuple[Any, int]]] = \
+        {nm: [] for nm in plan.handoff}
+    terminals = [e for e in plan.stages if e.terminal]
+
+    def drain(item) -> None:
+        lo, rows, outs = item
+        t0 = time.perf_counter()
+        for e in terminals:
+            o = outs[e.out_name]
+            if e.out_kind == "numeric":
+                hv = np.asarray(o[0])
+                hm = np.asarray(o[1])
+                if e.out_name not in out_vals:
+                    out_vals[e.out_name] = np.empty(n, hv.dtype)
+                    out_masks[e.out_name] = np.empty(n, bool)
+                out_vals[e.out_name][lo:lo + rows] = hv[:rows]
+                out_masks[e.out_name][lo:lo + rows] = hm[:rows]
+                _stats["bytes_out"] += float(
+                    rows * (hv.itemsize + hm.itemsize))
+            else:
+                hv = np.asarray(o)
+                if e.out_name not in out_vals:
+                    out_vals[e.out_name] = np.empty((n, hv.shape[1]),
+                                                    np.float32)
+                out_vals[e.out_name][lo:lo + rows] = hv[:rows]
+                _stats["bytes_out"] += float(rows * hv.shape[1] * 4)
+        _stats["pull_wait_s"] += time.perf_counter() - t0
+
+    inflight: deque = deque()
+    n_chunks = 0
+    for lo in range(0, n, C):
+        hi = min(lo + C, n)
+        rows = hi - lo
+        t0 = time.perf_counter()
+        host_args, nbytes = _host_chunk_args(plan, ds, lo, hi, C)
+        dev_args = jax.device_put(host_args)
+        with warnings.catch_warnings():
+            # XLA can't reuse every donated buffer (e.g. bool masks with no
+            # same-shape output); that's expected, not actionable
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            outs = jitted(dev_args)  # async dispatch; donates the uploads
+        _stats["upload_s"] += time.perf_counter() - t0
+        _stats["bytes_in"] += nbytes
+        _stats["pad_rows"] += C - rows
+        n_chunks += 1
+        for nm in plan.handoff:
+            hand_chunks[nm].append((outs[nm], rows))
+        inflight.append((lo, rows, outs))
+        while len(inflight) > B:
+            drain(inflight.popleft())
+    while inflight:
+        drain(inflight.popleft())
+
+    cs_after = _cache_size(jitted)
+    if cs_before is not None and cs_after is not None:
+        _stats["compiles"] += max(0, cs_after - cs_before)
+    _stats["streams"] += 1
+    _stats["chunks"] += n_chunks
+    _stats["chunk_rows"] = C
+    _stats["rows"] += n
+    _stats["terminals"] += len(terminals)
+    _stats["device_only"] += len(plan.stages) - len(terminals)
+    wall = time.perf_counter() - t_wall
+    _stats["wall_s"] += wall
+
+    from ..utils import flops
+
+    flops.record_streamed(_stats["bytes_in"] - bytes_in0,
+                          _stats["bytes_out"] - bytes_out0, n_chunks)
+
+    new_cols: Dict[str, Any] = {}
+    for e in terminals:
+        if e.out_kind == "numeric":
+            new_cols[e.out_name] = NumericColumn(
+                e.ftype, out_vals[e.out_name], out_masks[e.out_name])
+        else:
+            new_cols[e.out_name] = VectorColumn(
+                T.OPVector, out_vals[e.out_name], e.metadata)
+    for nm, chunks in hand_chunks.items():
+        if chunks and nm in new_cols:
+            _register_view(new_cols[nm].values, chunks, n)
+    return new_cols
+
+
+class _StreamLabel:
+    """Listener label for one streamed multi-layer launch."""
+
+    def __init__(self, plan: StreamPlan):
+        names = [getattr(e.stage, "operation_name", "?") for e in plan.stages]
+        self.operation_name = "streamed[" + "+".join(names) + "]"
+        self.uid = "streamed:" + ",".join(
+            getattr(e.stage, "uid", "?") for e in plan.stages)
+
+
+def apply_streamed(ds: Dataset, layers: Sequence[Sequence[Any]],
+                   live: Optional[Set[str]] = None,
+                   handoff: Optional[Set[str]] = None) -> Optional[Dataset]:
+    """Apply a run of transformer layers via the streaming executor.
+
+    Returns the transformed Dataset, or None when streaming does not apply
+    (disabled, empty data, or fewer than two fusable stages) — callers fall
+    back to the per-layer paths.  Unfused stages run host-side AFTER the
+    stream in their original layer order (their stream-produced inputs are
+    materialized terminals by construction).
+    """
+    if not enabled():
+        return None
+    n = len(ds)
+    if n == 0:
+        return None
+    plan = build_plan(ds, layers, live=live, handoff=handoff)
+    if plan is None:
+        record_fallback("too_few_fusable_stages",
+                        layers=len(layers),
+                        stages=sum(len(l) for l in layers))
+        return None
+    from . import dag as dag_util
+
+    _stats["stages_fused"] += plan.n_stream
+    _stats["stages_host"] += sum(len(l) for l in plan.host_layers)
+    _stats["layers"] += len(layers)
+    with dag_util._maybe_time(_StreamLabel(plan), "transform", n):
+        new_cols = execute(plan, ds)
+    ds = ds.with_columns(new_cols)
+    for layer in plan.host_layers:
+        if not layer:
+            continue
+        new: Dict[str, Any] = {}
+        for t in layer:
+            out_feats = t.get_outputs()
+            with dag_util._maybe_time(t, "transform", n):
+                col = t.transform_dataset(ds)
+            if t.n_outputs == 1:
+                new[out_feats[0].name] = col
+            else:
+                for f, c in zip(out_feats, col):
+                    new[f.name] = c
+        ds = ds.with_columns(new)
+    return ds

@@ -159,6 +159,14 @@ class OpWorkflow(OpWorkflowCore):
 
     # ---- training (OpWorkflow.scala:347) -----------------------------------
     def train(self, params: Optional[Dict[str, Any]] = None) -> "OpWorkflowModel":
+        from . import stream
+
+        # per-train streaming telemetry window (ops/sweep.reset_run_stats
+        # cadence): stream_stats() after train() reports THIS run's chunk
+        # counts / streamed bytes / compiles, and stale device views from a
+        # prior train cannot serve a new fit's handoff
+        stream.reset_stream_stats()
+        stream.clear_views()
         data = self._generate_raw_data(params)
 
         if self.raw_feature_filter is not None:
