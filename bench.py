@@ -278,6 +278,9 @@ def transform_bench():
     with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "STREAM_BENCH.json"), "w") as f:
         json.dump(report, f, indent=1)
+    from transmogrifai_tpu import obs
+
+    obs.write_record("bench", extra={"report": report})
 
 
 def make_selector(seed: int = 42):
@@ -455,6 +458,9 @@ def main():
     if fallback:
         out["backend_fallback"] = fallback
     print(json.dumps(out))
+    from transmogrifai_tpu import obs
+
+    obs.write_record("bench", extra={"report": out})
 
 
 if __name__ == "__main__":

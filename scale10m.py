@@ -245,6 +245,9 @@ def main():
     with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "SCALE_r05.json"), "w") as f:
         json.dump(out, f, indent=1)
+    from transmogrifai_tpu import obs
+
+    obs.write_record("scale", extra={"report": out})
 
 
 if __name__ == "__main__":

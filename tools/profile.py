@@ -1,0 +1,193 @@
+"""Kernel micro-profiler (dev tool): RF/GBT hot shapes, one entry point.
+
+Consolidates the former ``profile_trees.py`` / ``profile_trees2.py`` /
+``profile_trees3.py`` / ``profile_trace.py`` into subcommands:
+
+- ``trees``        — the RF depth/frontier/chunk matrix + GBT batch cases at
+  the Titanic hot shapes (n=891, d=24, 32 bins), mean-of-reps timing;
+- ``trees-beam``   — the histogram-precision (TMOG_HIST_BF16) and frontier-
+  beam variants at depth 12;
+- ``trees-stats``  — min/median timing of the three sweep-representative RF
+  cases + the GBT batch case (noise-robust numbers for before/after diffs);
+- ``trace``        — one warmed depth-12 forest build under
+  ``jax.profiler.trace`` (XLA-level, for TensorBoard).
+
+``--trace out.json`` on any subcommand additionally records obs spans
+(``profile.case`` per timed case) and exports Chrome trace-event JSON
+loadable in Perfetto — the span tracer the rest of the repo shares
+(transmogrifai_tpu/obs).  Every run appends a ``profile`` row to the
+telemetry JSONL (TMOG_TELEMETRY or ./telemetry.jsonl).
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from bench import init_backend
+
+parser = argparse.ArgumentParser(description=__doc__)
+parser.add_argument("cmd", nargs="?", default="trees",
+                    choices=["trees", "trees-beam", "trees-stats", "trace"])
+parser.add_argument("--reps", type=int, default=0,
+                    help="timing repetitions (default: 3, trees-stats 6)")
+parser.add_argument("--trace", default="",
+                    help="record obs spans and export Chrome trace-event "
+                         "JSON here (open in Perfetto)")
+cli = parser.parse_args()
+
+init_backend()
+import jax
+import jax.numpy as jnp
+
+from transmogrifai_tpu import obs
+from transmogrifai_tpu.obs import trace as obs_trace
+from transmogrifai_tpu.ops import trees as Tr
+
+if cli.trace:
+    obs_trace.enable(cli.trace)
+
+# the Titanic hot shapes every sweep-kernel case below runs at
+n, d = 891, 24
+rng = np.random.default_rng(0)
+X = rng.normal(size=(n, d)).astype(np.float32)
+y = (rng.random(n) < 0.4).astype(np.float32)
+Xb, _ = Tr.quantize(X, 32)
+G = -y[:, None]
+H = np.ones(n, np.float32)
+
+
+def timed_mean(fn, label, reps):
+    with obs_trace.span("profile.case", case=label, reps=reps):
+        fn()  # compile
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            jax.block_until_ready(fn())
+        dt = (time.perf_counter() - t0) / reps
+    print(f"{label:48s} {dt * 1e3:9.1f} ms")
+    return dt
+
+
+def timed_minmed(fn, label, reps):
+    with obs_trace.span("profile.case", case=label, reps=reps):
+        fn()  # compile
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn())
+            ts.append(time.perf_counter() - t0)
+    print(f"{label:44s} min {min(ts) * 1e3:8.1f}  "
+          f"med {float(np.median(ts)) * 1e3:8.1f} ms")
+    return min(ts)
+
+
+def rf_runner(TT, depth, frontier, chunk):
+    wt = rng.poisson(1.0, size=(TT, n)).astype(np.float32)
+    fm = (rng.random((TT, d)) < 0.3).astype(np.float32)
+    mcw = np.full(TT, 10.0, np.float32)
+    a = [jnp.asarray(v) for v in (Xb, G, H, wt, fm, mcw)]
+
+    def run():
+        return Tr.fit_forest_chunked(*a, max_depth=depth, n_bins=32,
+                                     chunk=chunk, frontier=frontier)
+
+    return run
+
+
+def rf_case(timer, TT, depth, frontier, chunk, label, reps, env=None):
+    if env:
+        for k, v in env.items():
+            os.environ[k] = v
+    try:
+        return timer(rf_runner(TT, depth, frontier, chunk), label, reps)
+    finally:
+        if env:
+            for k in env:
+                os.environ.pop(k)
+
+
+def gbt_runner(n_rounds=200, max_depth=10, frontier=64, B=6):
+    rw = np.ones((n_rounds, n), np.float32)
+    fms = np.ones((n_rounds, d), np.float32)
+    kw = dict(loss="logistic", n_rounds=n_rounds, max_depth=max_depth,
+              n_bins=32, frontier=frontier,
+              eta_b=jnp.full(B, 0.02), reg_lambda_b=jnp.full(B, 1.0),
+              gamma_b=jnp.full(B, 0.8), min_child_weight_b=jnp.full(B, 1.0))
+    a = [jnp.asarray(v) for v in (Xb, y, np.ones((B, n), np.float32),
+                                  rw, fms)]
+
+    def run():
+        return Tr.fit_gbt_batch(a[0], a[1], a[2], a[3], a[4], **kw)
+
+    return run
+
+
+def cmd_trees(reps):
+    """The sweep-representative RF matrix + GBT batch cases (means)."""
+    from transmogrifai_tpu.ops.trees import forest_chunk_size
+
+    for depth, frontier in ((3, 8), (6, 64), (12, 128)):
+        cs = forest_chunk_size(depth, 32, d, 1, frontier)
+        TT = 900
+        chunk = min(cs, TT)
+        TTp = TT + ((-TT) % chunk)
+        rf_case(timed_mean, TTp, depth, frontier, chunk,
+                f"RF d={depth} M={frontier} TT={TTp} chunk={chunk}", reps)
+    rf_case(timed_mean, 900, 12, 128, 900, "RF d=12 M=128 one chunk of 900",
+            reps)
+    rf_case(timed_mean, 900, 12, 128, 300, "RF d=12 M=128 chunk=300", reps)
+    rf_case(timed_mean, 896, 12, 128, 128, "RF d=12 M=128 chunk=128", reps)
+    rf_case(timed_mean, 900, 12, 128, 900, "RF d=12 segsum one chunk", reps,
+            env={"TMOG_HIST_MATMUL": "0"})
+    timed_mean(gbt_runner(n_rounds=200),
+               "XGB batch=6 rounds=200 d=10 M=64", reps)
+    timed_mean(gbt_runner(n_rounds=20),
+               "XGB batch=6 rounds=20 d=10 M=64", reps)
+
+
+def cmd_trees_beam(reps):
+    """Histogram precision (bf16 vs f32) and frontier-beam width variants."""
+    rf_case(timed_mean, 900, 12, 128, 900, "RF d=12 M=128 (bf16 mm)", reps)
+    rf_case(timed_mean, 900, 12, 128, 900, "RF d=12 M=128 f32 mm", reps,
+            env={"TMOG_HIST_BF16": "0"})
+    rf_case(timed_mean, 900, 12, 64, 900, "RF d=12 M=64 beam", reps)
+    rf_case(timed_mean, 900, 12, 32, 900, "RF d=12 M=32 beam", reps)
+    rf_case(timed_mean, 900, 8, 128, 900, "RF d=8 M=128", reps)
+    rf_case(timed_mean, 112, 12, 128, 112, "RF d=12 M=128 TT=112", reps)
+
+
+def cmd_trees_stats(reps):
+    """min/median of the three sweep-representative cases (diff-stable)."""
+    rf_case(timed_minmed, 900, 3, 8, 900, "RF d=3  M=8   TT=900", reps)
+    rf_case(timed_minmed, 900, 6, 64, 900, "RF d=6  M=64  TT=900", reps)
+    rf_case(timed_minmed, 900, 12, 128, 900, "RF d=12 M=128 TT=900", reps)
+    timed_minmed(gbt_runner(n_rounds=200),
+                 "XGB batch=6 rounds=200 d=10 M=64", reps)
+
+
+def cmd_trace(reps):
+    """One warmed depth-12 forest build under jax.profiler.trace."""
+    run = rf_runner(900, 12, 128, 900)
+    jax.block_until_ready(run())
+    out = "/tmp/jaxtrace"
+    with jax.profiler.trace(out):
+        with obs_trace.span("profile.case", case="RF d=12 jax.profiler"):
+            jax.block_until_ready(run())
+    print(f"trace done -> {out}")
+
+
+if cli.cmd == "trees":
+    cmd_trees(cli.reps or 3)
+elif cli.cmd == "trees-beam":
+    cmd_trees_beam(cli.reps or 3)
+elif cli.cmd == "trees-stats":
+    cmd_trees_stats(cli.reps or 6)
+else:
+    cmd_trace(cli.reps or 1)
+
+if cli.trace:
+    print(f"obs trace -> {obs_trace.export(cli.trace)}")
+obs.write_record("profile", extra={"cmd": cli.cmd})

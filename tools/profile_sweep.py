@@ -202,12 +202,16 @@ def profile_rowsharded(n_data: int, n_model: int, reps: int = 3) -> None:
     flops.disable()
 
 
+from transmogrifai_tpu import obs  # noqa: E402
+
 if args.data_shards > 0:
     profile_rowsharded(args.data_shards, max(args.shards, 1))
+    obs.write_record("profile_sweep", extra={"mode": "rowsharded"})
     sys.exit(0)
 
 if args.shards > 0:
     profile_shards(args.shards)
+    obs.write_record("profile_sweep", extra={"mode": "shards"})
     sys.exit(0)
 
 rf = D.random_forest_grid()
@@ -223,3 +227,4 @@ timed("XGB x2", [(OpXGBoostClassifier(), D.xgboost_grid())])
 
 from transmogrifai_tpu.ops import sweep as sweep_ops  # noqa: E402
 _print_gbt_telemetry(sweep_ops)
+obs.write_record("profile_sweep", extra={"mode": "families"})

@@ -1,0 +1,55 @@
+"""Unified observability core: span tracing, one metrics registry, per-run
+telemetry records.
+
+Three pieces, one import point:
+
+- :mod:`~transmogrifai_tpu.obs.trace` — thread-safe nested span tracer with
+  Chrome-trace-event JSON export (loads in Perfetto).  ``TMOG_TRACE=
+  path.json`` enables; zero overhead and no allocation when off; bounded
+  ring buffer (``TMOG_TRACE_BUF``) when on.
+- :mod:`~transmogrifai_tpu.obs.registry` — named counters/gauges/histograms
+  plus scoped sinks.  The legacy surfaces (``ops/sweep.run_stats``,
+  ``workflow/stream.stream_stats``, ``utils/flops`` buckets,
+  ``serve.ServeMetrics``) are backward-compatible views over it.
+- :mod:`~transmogrifai_tpu.obs.record` — schema-versioned JSONL rows
+  snapshotting the registry + run context: the training-row format for the
+  ROADMAP learned TPU cost model.
+
+``obs.snapshot()`` returns the union: a superset of what ``run_stats() +
+stream_stats() + flops.totals() + ServeMetrics.snapshot()`` used to give,
+under the keys ``sweep`` / ``stream`` / ``flops`` / ``serve``.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from . import record, registry, trace
+from .record import write_record
+from .registry import (REGISTRY, SCHEMA_VERSION, prometheus_text,
+                       record_fallback, register_provider, scope)
+from .trace import complete, instant, span
+
+__all__ = ["trace", "registry", "record", "snapshot", "write_record",
+           "span", "instant", "complete", "scope", "register_provider",
+           "record_fallback", "prometheus_text", "REGISTRY",
+           "SCHEMA_VERSION"]
+
+
+def snapshot() -> Dict[str, Any]:
+    """One call, every telemetry surface.
+
+    Imports the legacy sink modules lazily so their registry scopes and
+    providers exist even if nothing else touched them this run — the
+    acceptance contract is that this dict is a superset of
+    ``run_stats() + stream_stats() + flops.totals() +
+    ServeMetrics.snapshot()``.
+    """
+    for mod in ("transmogrifai_tpu.ops.sweep",
+                "transmogrifai_tpu.workflow.stream",
+                "transmogrifai_tpu.utils.flops",
+                "transmogrifai_tpu.serve.metrics"):
+        try:
+            __import__(mod)
+        except Exception:  # a broken optional subsystem must not block obs
+            pass
+    return registry.snapshot()

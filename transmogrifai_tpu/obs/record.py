@@ -1,0 +1,103 @@
+"""Schema-versioned per-run telemetry records: one JSONL row per run.
+
+This is the single feature-extraction point the ROADMAP learned-cost-model
+item asks for.  Each call to :func:`write_record` appends ONE self-contained
+JSON line holding
+
+- ``schema`` / ``schema_version`` — the record format contract,
+- ``kind`` — which harness emitted it (``bench`` / ``scale`` /
+  ``profile_sweep`` / ``dryrun`` / ``tier1``),
+- ``context`` — the run's environment: platform, device kind/count, active
+  mesh request, every ``TMOG_*`` env knob, argv,
+- ``snapshot`` — the full ``obs.snapshot()``: sweep launches (per-shard
+  wall/compile), stream chunk counters, flops by fn/shape/device, per-axis
+  collective bytes, merged serve metrics,
+- any harness-specific ``extra`` (e.g. the bench's report dict).
+
+A learned TPU cost model (PAPERS.md: "A Learned Performance Model for
+TPUs", TpuGraphs) trains on exactly these rows: per-shape wall + FLOPs +
+collective bytes + compile counts, with the mesh/knob context as features.
+
+Emitters: ``bench.py``, ``scale10m.py``, ``tools/profile_sweep.py``,
+``__graft_entry__`` dryrun, and the tier-1 CI session (tests/conftest.py).
+Path: explicit argument > ``TMOG_TELEMETRY`` > ``telemetry.jsonl`` in cwd.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, Optional
+
+from .registry import SCHEMA_VERSION
+
+__all__ = ["SCHEMA", "telemetry_path", "run_context", "write_record"]
+
+SCHEMA = "tmog.run_record"
+DEFAULT_PATH = "telemetry.jsonl"
+
+
+def telemetry_path(path: Optional[str] = None) -> str:
+    return path or os.environ.get("TMOG_TELEMETRY", "").strip() or DEFAULT_PATH
+
+
+def run_context() -> Dict[str, Any]:
+    """Shape/mesh/env context for the row — the cost model's features."""
+    ctx: Dict[str, Any] = {
+        "argv": list(sys.argv),
+        "pid": os.getpid(),
+        "env": {k: v for k, v in sorted(os.environ.items())
+                if k.startswith("TMOG_")},
+        "jax_platforms": os.environ.get("JAX_PLATFORMS"),
+        "xla_flags": os.environ.get("XLA_FLAGS"),
+    }
+    try:  # backend facts only if JAX is already up — never initialize it here
+        import jax
+
+        devs = jax.devices()
+        ctx["platform"] = devs[0].platform
+        ctx["device_kind"] = devs[0].device_kind
+        ctx["device_count"] = len(devs)
+    except Exception:
+        pass
+    return ctx
+
+
+def write_record(kind: str, extra: Optional[Dict[str, Any]] = None,
+                 path: Optional[str] = None) -> Optional[str]:
+    """Append one telemetry row; returns the path written, or None if the
+    write failed (telemetry must never kill the run it describes)."""
+    from . import snapshot
+
+    row: Dict[str, Any] = {
+        "schema": SCHEMA,
+        "schema_version": SCHEMA_VERSION,
+        "ts": time.time(),
+        "kind": kind,
+        "context": run_context(),
+        "snapshot": snapshot(),
+    }
+    if extra:
+        row.update(extra)
+    out = telemetry_path(path)
+    try:
+        with open(out, "a") as f:
+            f.write(json.dumps(row, default=_json_default) + "\n")
+    except OSError:
+        return None
+    return out
+
+
+def _json_default(obj: Any) -> Any:
+    """Numpy scalars/arrays and other strays degrade to plain JSON."""
+    try:
+        import numpy as np
+
+        if isinstance(obj, np.ndarray):
+            return obj.tolist()
+        if isinstance(obj, np.generic):
+            return obj.item()
+    except Exception:
+        pass
+    return repr(obj)

@@ -71,6 +71,8 @@ except ImportError:  # the 0.4.x experimental home
     from jax.experimental.shard_map import shard_map as _shard_map
     _no_check = {"check_rep": False}
 
+from ..obs import registry as obs_registry
+from ..obs import trace
 from ..parallel import mesh as mesh_mod
 from ..parallel.mesh import mesh_all_gather, mesh_psum
 from ..utils import devcache, flops
@@ -515,7 +517,8 @@ def _replay_trace_events(spec, n: int, colls) -> None:
     # keyed on the subtraction flag too: flipping TMOG_HIST_SUBTRACT
     # mid-process must not replay the other configuration's savings
     key = (spec, int(n), Tr._hist_subtract())
-    events = tuple(c for c in colls if c[0] == "hist_subtracted")
+    events = tuple(c for c in colls
+                   if c[0] in ("hist_subtracted", "gbt_chain"))
     if events:
         _TRACE_EVENT_CACHE[key] = events
     else:
@@ -538,23 +541,28 @@ def run_sweep(spec, X, xbs: Tuple, y, train_w, val_w, blob):
     chain = _spec_gbt_chain(spec)
     if chain:
         entry["gbt_chain"] = chain
-    _run_stats["launches"].append(entry)
-    if split:
+    _sweep_scope.append("launches", entry)
+    with trace.span("sweep.launch", shards=1, candidates=C,
+                    split=bool(split)):
+        if chain:
+            trace.instant("gbt.chain", steps=chain["steps"],
+                          levels=chain["levels"])
+        if split:
+            with mesh_mod.trace_collectives() as colls:
+                scores = _run_scores(spec, X, tuple(xbs), y, train_w, blob)
+            _replay_trace_events(spec, n, colls)
+            out = _run_metrics(spec, y, scores, val_w)
+            flops.record("sweep.run_scores", _run_scores, spec, X,
+                         tuple(xbs), y, train_w, blob)
+            flops.record("sweep.run_metrics", _run_metrics, spec, y, scores,
+                         val_w)
+            return out
         with mesh_mod.trace_collectives() as colls:
-            scores = _run_scores(spec, X, tuple(xbs), y, train_w, blob)
+            out = _run(spec, X, tuple(xbs), y, train_w, val_w, blob)
         _replay_trace_events(spec, n, colls)
-        out = _run_metrics(spec, y, scores, val_w)
-        flops.record("sweep.run_scores", _run_scores, spec, X, tuple(xbs), y,
-                     train_w, blob)
-        flops.record("sweep.run_metrics", _run_metrics, spec, y, scores,
-                     val_w)
+        flops.record("sweep.run", _run, spec, X, tuple(xbs), y, train_w,
+                     val_w, blob)
         return out
-    with mesh_mod.trace_collectives() as colls:
-        out = _run(spec, X, tuple(xbs), y, train_w, val_w, blob)
-    _replay_trace_events(spec, n, colls)
-    flops.record("sweep.run", _run, spec, X, tuple(xbs), y, train_w, val_w,
-                 blob)
-    return out
 
 
 # ---------------------------------------------------------------------------
@@ -564,7 +572,12 @@ def run_sweep(spec, X, xbs: Tuple, y, train_w, val_w, blob):
 #: ``run_sweep`` ({"shards": 1, ...}) / ``run_sweep_partitioned`` call
 #: ({"shards": k, "per_shard": [...], ...}); the bench and the multichip
 #: dryrun read it to report ``sweep_shards`` + per-shard wall/compile times.
-_run_stats: Dict[str, List[Dict[str, Any]]] = {"launches": [], "fallbacks": []}
+#: Storage lives in the central obs registry (scope "sweep");
+#: ``run_stats()`` below is the backward-compatible view over it, and is
+#: also what ``obs.snapshot()["sweep"]`` reports.
+_sweep_scope = obs_registry.scope("sweep", defaults={
+    "launches": [], "fallbacks": [], "compiles": 0, "compile_s": 0.0})
+obs_registry.register_provider("sweep", lambda: run_stats())
 
 #: per-(name, spec, device, arg-signature) AOT executables.  jit's own cache
 #: would recompile nothing either, but going through ``.lower().compile()``
@@ -578,8 +591,7 @@ _aot_lock = threading.Lock()
 
 
 def reset_run_stats() -> None:
-    _run_stats["launches"] = []
-    _run_stats["fallbacks"] = []
+    _sweep_scope.reset()
 
 
 def record_fallback(reason: str, **detail) -> None:
@@ -588,15 +600,14 @@ def record_fallback(reason: str, **detail) -> None:
     The graceful-degradation contract: when rows are too few for the data
     axis or a custom estimator blocks fusion, the validator routes through
     the replicated path and RECORDS the reason here instead of erroring —
-    ``run_stats()['fallbacks']`` is the audit trail."""
-    entry: Dict[str, Any] = {"reason": reason}
-    entry.update(detail)
-    _run_stats["fallbacks"].append(entry)
+    ``run_stats()['fallbacks']`` is the audit trail.  Delegates to the one
+    central recorder (obs.registry.record_fallback, domain="sweep")."""
+    obs_registry.record_fallback("sweep", reason, **detail)
 
 
 def run_stats() -> Dict[str, Any]:
     """Aggregate view of launches since the last reset (host-side stats)."""
-    launches = [dict(e) for e in _run_stats["launches"]]
+    launches = _sweep_scope.list("launches")
     return {"launches": launches,
             "sweep_shards": max((e["shards"] for e in launches), default=0),
             "data_shards": max((e.get("data_shards", 1) for e in launches),
@@ -608,7 +619,11 @@ def run_stats() -> Dict[str, Any]:
             "gbt_chain_levels": max(
                 (e.get("gbt_chain", {}).get("levels", 0) for e in launches),
                 default=0),
-            "fallbacks": [dict(e) for e in _run_stats["fallbacks"]]}
+            # AOT compile telemetry (cache misses since reset); the per-shape
+            # compile-count feature of the learned-cost-model training row
+            "compiles": _sweep_scope.get("compiles"),
+            "compile_s": _sweep_scope.get("compile_s"),
+            "fallbacks": _sweep_scope.list("fallbacks")}
 
 
 def _aot(name: str, fn, spec, device, dyn_args) -> Tuple[Any, float, Tuple]:
@@ -623,9 +638,12 @@ def _aot(name: str, fn, spec, device, dyn_args) -> Tuple[Any, float, Tuple]:
     if hit is not None:
         return hit[0], 0.0, hit[1]
     t0 = time.perf_counter()
-    with mesh_mod.trace_collectives() as colls:
-        compiled = fn.lower(spec, *dyn_args).compile()
+    with trace.span("sweep.compile", fn=name, device=str(device)):
+        with mesh_mod.trace_collectives() as colls:
+            compiled = fn.lower(spec, *dyn_args).compile()
     dt = time.perf_counter() - t0
+    _sweep_scope.inc("compiles")
+    _sweep_scope.inc("compile_s", dt)
     with _aot_lock:
         # a racing thread may have compiled the same key; keep the first
         hit = _aot_cache.setdefault(key, (compiled, tuple(colls)))
@@ -711,57 +729,71 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
 
     def worker(shard, dev):
         t0 = time.perf_counter()
-        Xd, xbs_d, yd = _shard_arrays(shard, dev, X, xbs, y,
-                                      X_host, y_host, xb_bins)
-        tw = jax.device_put(jnp.asarray(train_w), dev)
-        vw = jax.device_put(jnp.asarray(val_w), dev)
-        bl = jax.device_put(jnp.asarray(shard.blob), dev)
-        C_s = len(shard.cis)
-        split = F * C_s * n * k > SPLIT_METRICS_ELEMS
-        records = []
-        if split:
-            args_s = (Xd, xbs_d, yd, tw, bl)
-            cs, dt_s, ev_s = _aot("sweep.run_scores", _run_scores, shard.spec,
-                                  dev, args_s)
-            scores = cs(*args_s)
-            args_m = (yd, scores, vw)
-            cm, dt_m, ev_m = _aot("sweep.run_metrics", _run_metrics,
-                                  shard.spec, dev, args_m)
-            out = cm(*args_m)
-            compile_s = dt_s + dt_m
-            records = [("sweep.run_scores", cs, args_s, ev_s),
-                       ("sweep.run_metrics", cm, args_m, ev_m)]
-        else:
-            args = (Xd, xbs_d, yd, tw, vw, bl)
-            c, compile_s, ev = _aot("sweep.run", _run, shard.spec, dev, args)
-            out = c(*args)
-            records = [("sweep.run", c, args, ev)]
-        # block in THIS thread only: other shards keep dispatching/running
-        out = np.asarray(out)
+        with trace.span("sweep.shard", device=str(dev),
+                        candidates=len(shard.cis)):
+            with trace.span("sweep.upload", device=str(dev)):
+                Xd, xbs_d, yd = _shard_arrays(shard, dev, X, xbs, y,
+                                              X_host, y_host, xb_bins)
+                tw = jax.device_put(jnp.asarray(train_w), dev)
+                vw = jax.device_put(jnp.asarray(val_w), dev)
+                bl = jax.device_put(jnp.asarray(shard.blob), dev)
+            C_s = len(shard.cis)
+            split = F * C_s * n * k > SPLIT_METRICS_ELEMS
+            records = []
+            if split:
+                args_s = (Xd, xbs_d, yd, tw, bl)
+                cs, dt_s, ev_s = _aot("sweep.run_scores", _run_scores,
+                                      shard.spec, dev, args_s)
+                with trace.span("sweep.dispatch", device=str(dev),
+                                split=True):
+                    scores = cs(*args_s)
+                    args_m = (yd, scores, vw)
+                    cm, dt_m, ev_m = _aot("sweep.run_metrics", _run_metrics,
+                                          shard.spec, dev, args_m)
+                    out = cm(*args_m)
+                compile_s = dt_s + dt_m
+                records = [("sweep.run_scores", cs, args_s, ev_s),
+                           ("sweep.run_metrics", cm, args_m, ev_m)]
+            else:
+                args = (Xd, xbs_d, yd, tw, vw, bl)
+                c, compile_s, ev = _aot("sweep.run", _run, shard.spec, dev,
+                                        args)
+                with trace.span("sweep.dispatch", device=str(dev),
+                                split=False):
+                    out = c(*args)
+                records = [("sweep.run", c, args, ev)]
+            # block in THIS thread only: other shards keep dispatching/running
+            with trace.span("sweep.gather", device=str(dev)):
+                out = np.asarray(out)
         return out, {"device": str(dev), "candidates": C_s,
                      "predicted_cost": float(shard.cost),
                      "compile_s": round(compile_s, 4), "split": bool(split),
                      "wall_s": round(time.perf_counter() - t0, 4)}, records
 
-    with ThreadPoolExecutor(max_workers=len(shards)) as pool:
-        results = list(pool.map(worker, shards, devices))
+    with trace.span("sweep.launch", shards=len(shards),
+                    candidates=int(n_candidates)):
+        chain = _max_gbt_chain([s.spec for s in shards])
+        if chain:
+            trace.instant("gbt.chain", steps=chain["steps"],
+                          levels=chain["levels"])
+        with ThreadPoolExecutor(max_workers=len(shards)) as pool:
+            results = list(pool.map(worker, shards, devices))
 
-    M = results[0][0].shape[-1]
-    metrics = np.zeros((F, n_candidates, M), np.float32)
-    per_shard = []
-    for (out, stat, records), shard, dev in zip(results, shards, devices):
-        metrics[:, np.asarray(shard.cis, np.int64), :] = out
-        per_shard.append(stat)
-        for name, compiled, args, events in records:
-            flops.record_compiled(name, compiled, args, device=dev)
-            flops.record_collectives(events, device=dev)
+        M = results[0][0].shape[-1]
+        metrics = np.zeros((F, n_candidates, M), np.float32)
+        per_shard = []
+        for (out, stat, records), shard, dev in zip(results, shards, devices):
+            metrics[:, np.asarray(shard.cis, np.int64), :] = out
+            per_shard.append(stat)
+            for name, compiled, args, events in records:
+                flops.record_compiled(name, compiled, args, device=dev)
+                flops.record_collectives(events, device=dev)
     entry = {"shards": len(shards), "candidates": int(n_candidates),
              "wall_s": round(time.perf_counter() - t_all, 4),
              "per_shard": per_shard}
-    chain = _max_gbt_chain([s.spec for s in shards])
     if chain:
         entry["gbt_chain"] = chain
-    _run_stats["launches"].append(entry)
+    _sweep_scope.append("launches", entry)
     return metrics
 
 
@@ -780,9 +812,14 @@ def _aot_rs(spec, submesh, n_orig: int, dyn_args) -> Tuple[Any, float, Tuple]:
     if hit is not None:
         return hit[0], 0.0, hit[1]
     t0 = time.perf_counter()
-    with mesh_mod.trace_collectives() as colls:
-        compiled = _run_rs.lower(spec, submesh, n_orig, *dyn_args).compile()
+    with trace.span("sweep.compile", fn="sweep.run_rs",
+                    devices=len(np.asarray(submesh.devices).flat)):
+        with mesh_mod.trace_collectives() as colls:
+            compiled = _run_rs.lower(spec, submesh, n_orig,
+                                     *dyn_args).compile()
     dt = time.perf_counter() - t0
+    _sweep_scope.inc("compiles")
+    _sweep_scope.inc("compile_s", dt)
     with _aot_lock:
         # a racing thread may have compiled the same key; keep the first
         hit = _aot_cache.setdefault(key, (compiled, tuple(colls)))
@@ -862,22 +899,31 @@ def run_sweep_rowsharded(shards, X, xbs: Tuple, y, train_w, val_w,
     def worker(shard, j):
         t0 = time.perf_counter()
         submesh = Mesh(grid[:, j], (mesh_mod.DATA_AXIS,))
-        Xd, xbs_d, yd, n_orig = _rs_arrays(submesh, X, xbs, y,
-                                           X_host, y_host, xb_bins)
-        n_pad = int(Xd.shape[0])
-        fold_sh = NamedSharding(submesh, P(None, mesh_mod.DATA_AXIS))
-        tw = jax.device_put(
-            mesh_mod.pad_to_multiple(tw_host, n_data, axis=1)[0], fold_sh)
-        vw = jax.device_put(
-            mesh_mod.pad_to_multiple(vw_host, n_data, axis=1)[0], fold_sh)
-        bl = jax.device_put(np.asarray(shard.blob, np.float32),
-                            NamedSharding(submesh, P()))
-        args = (Xd, xbs_d, yd, tw, vw, bl)
-        compiled, compile_s, colls = _aot_rs(shard.spec, submesh, n_orig,
-                                             args)
-        out = compiled(*args)
-        # block in THIS thread only: other columns keep dispatching/running
-        out = np.asarray(out)
+        with trace.span("sweep.shard", column=j, data_shards=int(n_data),
+                        candidates=len(shard.cis)):
+            with trace.span("sweep.upload", column=j):
+                Xd, xbs_d, yd, n_orig = _rs_arrays(submesh, X, xbs, y,
+                                                   X_host, y_host, xb_bins)
+                n_pad = int(Xd.shape[0])
+                fold_sh = NamedSharding(submesh,
+                                        P(None, mesh_mod.DATA_AXIS))
+                tw = jax.device_put(
+                    mesh_mod.pad_to_multiple(tw_host, n_data, axis=1)[0],
+                    fold_sh)
+                vw = jax.device_put(
+                    mesh_mod.pad_to_multiple(vw_host, n_data, axis=1)[0],
+                    fold_sh)
+                bl = jax.device_put(np.asarray(shard.blob, np.float32),
+                                    NamedSharding(submesh, P()))
+            args = (Xd, xbs_d, yd, tw, vw, bl)
+            compiled, compile_s, colls = _aot_rs(shard.spec, submesh, n_orig,
+                                                 args)
+            with trace.span("sweep.dispatch", column=j):
+                out = compiled(*args)
+            # block in THIS thread only: other columns keep
+            # dispatching/running
+            with trace.span("sweep.gather", column=j):
+                out = np.asarray(out)
         label = ",".join(str(d) for d in grid[:, j])
         stat = {"devices": [str(d) for d in grid[:, j]],
                 "candidates": len(shard.cis),
@@ -888,8 +934,15 @@ def run_sweep_rowsharded(shards, X, xbs: Tuple, y, train_w, val_w,
         return out, stat, ("sweep.run_rs", compiled, args, label, colls,
                            n_orig, n_pad)
 
-    with ThreadPoolExecutor(max_workers=len(shards)) as pool:
-        results = list(pool.map(worker, shards, range(len(shards))))
+    with trace.span("sweep.launch", shards=len(shards),
+                    data_shards=int(n_data), rowsharded=True,
+                    candidates=int(n_candidates)):
+        chain = _max_gbt_chain([s.spec for s in shards])
+        if chain:
+            trace.instant("gbt.chain", steps=chain["steps"],
+                          levels=chain["levels"])
+        with ThreadPoolExecutor(max_workers=len(shards)) as pool:
+            results = list(pool.map(worker, shards, range(len(shards))))
 
     M = results[0][0].shape[-1]
     metrics = np.zeros((F, n_candidates, M), np.float32)
@@ -903,8 +956,8 @@ def run_sweep_rowsharded(shards, X, xbs: Tuple, y, train_w, val_w,
         flops.record_compiled(name, compiled, args, device=label)
         flops.record_collectives(colls, device=label)
         for kind, axis, nbytes in colls:
-            if kind == "hist_subtracted":
-                continue  # flops-savings event, not mesh traffic
+            if kind in ("hist_subtracted", "gbt_chain"):
+                continue  # kernel trace events, not mesh traffic
             agg = coll_agg.setdefault(axis, {"count": 0.0, "bytes": 0.0})
             agg["count"] += 1
             agg["bytes"] += nbytes
@@ -921,8 +974,7 @@ def run_sweep_rowsharded(shards, X, xbs: Tuple, y, train_w, val_w,
                  "y": n_pad // n_data * 4,
                  "X_replicated": n_orig * d * 4,
                  "y_replicated": n_orig * 4}}
-    chain = _max_gbt_chain([s.spec for s in shards])
     if chain:
         entry["gbt_chain"] = chain
-    _run_stats["launches"].append(entry)
+    _sweep_scope.append("launches", entry)
     return metrics

@@ -1117,6 +1117,7 @@ def _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int
     use_mm = _hist_via_matmul(n, Xb.shape[1], n_bins, c + 1)
     K = int(trees_per_round)
 
+    record_trace_event("gbt_chain", loss, n_rounds // max(K, 1))
     if K > 1:
         if n_rounds % K:
             raise ValueError(
@@ -1247,6 +1248,7 @@ def _gbt_batch_impl(Xb, y, w_batch, row_w_rounds, feat_mask_rounds, loss: str,
     Obin = bin_onehot(Xb, n_bins)
     F0 = jnp.broadcast_to(base_score_b[:, None, None], (B, n, c)).astype(jnp.float32)
     steps = n_rounds // K
+    record_trace_event("gbt_chain", loss, steps)
     rw_s = row_w_rounds.reshape(steps, K, n)
     fm_s = feat_mask_rounds.reshape(steps, K, d)
 

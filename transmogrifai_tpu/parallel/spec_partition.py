@@ -42,6 +42,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import trace
+
 
 @dataclass
 class ShardSpec:
@@ -76,31 +78,36 @@ def partition_spec(spec, blob: np.ndarray, n_shards: int, n_rows: int,
     """
     from ..impl.sweep_fragments import build_subspec, spec_units
 
-    units = spec_units(spec, n_rows, n_features, n_folds)
-    if n_shards <= 1:
-        cis = tuple(sorted(ci for u in units for ci in u.cis))
-        return [ShardSpec(spec, np.asarray(blob, np.float32), cis,
-                          sum(u.cost for u in units))]
+    with trace.span("sweep.partition", shards=int(n_shards),
+                    rows=int(n_rows)) as sp:
+        units = spec_units(spec, n_rows, n_features, n_folds)
+        if n_shards <= 1:
+            cis = tuple(sorted(ci for u in units for ci in u.cis))
+            return [ShardSpec(spec, np.asarray(blob, np.float32), cis,
+                              sum(u.cost for u in units))]
 
-    # LPT greedy over per-candidate atoms: (cost, unit, position-in-unit)
-    atoms = [(u.per_cand, u, p) for u in units for p in range(len(u.cis))]
-    atoms.sort(key=lambda a: -a[0])
-    # heap of (load, shard_index); picks[shard][unit.key] -> positions
-    heap = [(0.0, s) for s in range(n_shards)]
-    heapq.heapify(heap)
-    picks: List[Dict[Tuple[int, Optional[int]], List[int]]] = [
-        {} for _ in range(n_shards)]
-    loads = [0.0] * n_shards
-    for cost, unit, pos in atoms:
-        load, s = heapq.heappop(heap)
-        picks[s].setdefault(unit.key, []).append(pos)
-        loads[s] = load + cost
-        heapq.heappush(heap, (loads[s], s))
+        # LPT greedy over per-candidate atoms: (cost, unit, position-in-unit)
+        atoms = [(u.per_cand, u, p) for u in units
+                 for p in range(len(u.cis))]
+        atoms.sort(key=lambda a: -a[0])
+        # heap of (load, shard_index); picks[shard][unit.key] -> positions
+        heap = [(0.0, s) for s in range(n_shards)]
+        heapq.heapify(heap)
+        picks: List[Dict[Tuple[int, Optional[int]], List[int]]] = [
+            {} for _ in range(n_shards)]
+        loads = [0.0] * n_shards
+        for cost, unit, pos in atoms:
+            load, s = heapq.heappop(heap)
+            picks[s].setdefault(unit.key, []).append(pos)
+            loads[s] = load + cost
+            heapq.heappush(heap, (loads[s], s))
 
-    shards: List[ShardSpec] = []
-    for s in range(n_shards):
-        if not picks[s]:
-            continue
-        sub_spec, sub_blob, cis = build_subspec(spec, blob, picks[s], n_folds)
-        shards.append(ShardSpec(sub_spec, sub_blob, cis, loads[s]))
+        shards: List[ShardSpec] = []
+        for s in range(n_shards):
+            if not picks[s]:
+                continue
+            sub_spec, sub_blob, cis = build_subspec(spec, blob, picks[s],
+                                                    n_folds)
+            shards.append(ShardSpec(sub_spec, sub_blob, cis, loads[s]))
+        sp.set(candidates=sum(len(s.cis) for s in shards))
     return shards

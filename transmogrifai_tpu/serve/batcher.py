@@ -23,6 +23,7 @@ import time
 from concurrent.futures import Future
 from typing import Any, Dict, List, NamedTuple, Optional
 
+from ..obs import trace
 from .metrics import ServeMetrics
 from .registry import ModelRegistry, bucket_for
 
@@ -140,11 +141,13 @@ class MicroBatcher:
         bucket = bucket_for(n, entry.buckets)
         records = [p.record for p in batch] + [{} for _ in range(bucket - n)]
         t0 = time.monotonic()
-        with entry.in_flight():
-            try:
-                outputs = entry.batch(records)[:n]
-            except Exception:
-                outputs = self._fallback(entry, batch)
+        with trace.span("serve.batch", records=n, bucket=bucket,
+                        version=entry.version):
+            with entry.in_flight():
+                try:
+                    outputs = entry.batch(records)[:n]
+                except Exception:
+                    outputs = self._fallback(entry, batch)
         batch_ms = (time.monotonic() - t0) * 1000.0
         self.metrics.observe_batch(batch_ms, n, bucket)
         done = time.monotonic()
@@ -154,6 +157,10 @@ class MicroBatcher:
                 p.future.set_exception(out)
             else:
                 self.metrics.observe_request((done - p.enqueued_at) * 1000.0)
+                # queue wait + batch + resolution, timeline-aligned with the
+                # serve.batch span (same monotonic origin)
+                trace.complete("serve.request", p.enqueued_at, done,
+                               bucket=bucket)
                 p.future.set_result(Scored(entry.version, out))
 
     def _fallback(self, entry, batch: List[_Pending]) -> List[Any]:

@@ -1,76 +1,36 @@
 """Serving metrics: latency histograms, counters, and gauges.
 
-Exported two ways:
+Exported three ways:
 
-- as the JSON payload of the server's ``/metrics`` endpoint, and
+- as the JSON payload of the server's ``/metrics`` endpoint (and, via the
+  obs registry, its Prometheus text rendering),
 - into the runner's ``AppMetrics.custom`` through the existing
   ``utils/listener.py`` machinery (``OpListener.add_custom_provider``), so a
   ``Serve`` run writes the same numbers into ``app_metrics.json`` as every
-  other run type.
+  other run type,
+- merged across live instances into ``obs.snapshot()["serve"]`` (the
+  registry provider below) — the serving slice of the unified telemetry
+  record.
 
 All mutators take one lock; the snapshot is a consistent point-in-time copy.
+The histogram class itself lives in ``obs.registry`` (promoted there as
+:class:`~transmogrifai_tpu.obs.registry.LogHistogram`); this re-export keeps
+the historical ``serve.metrics.LatencyHistogram`` name working.
 """
 from __future__ import annotations
 
-import math
 import threading
-from typing import Any, Callable, Dict, List, Optional
+import weakref
+from typing import Any, Callable, Dict
 
+from ..obs import registry as obs_registry
+from ..obs.registry import LogHistogram as LatencyHistogram
 
-class LatencyHistogram:
-    """Log-spaced latency histogram (milliseconds).
+__all__ = ["LatencyHistogram", "ServeMetrics"]
 
-    64 buckets geometric from 0.05 ms with ratio 1.25 (~60 s span, ~12%
-    resolution) — coarse enough to be free, fine enough for p99 reporting.
-    Percentiles interpolate to the geometric midpoint of the hit bucket.
-    """
-
-    BASE_MS = 0.05
-    RATIO = 1.25
-    N_BUCKETS = 64
-
-    def __init__(self):
-        self.counts = [0] * self.N_BUCKETS
-        self.n = 0
-        self.sum_ms = 0.0
-        self.max_ms = 0.0
-
-    def _bucket(self, ms: float) -> int:
-        if ms <= self.BASE_MS:
-            return 0
-        i = int(math.log(ms / self.BASE_MS) / math.log(self.RATIO)) + 1
-        return min(i, self.N_BUCKETS - 1)
-
-    def record(self, ms: float) -> None:
-        self.counts[self._bucket(ms)] += 1
-        self.n += 1
-        self.sum_ms += ms
-        if ms > self.max_ms:
-            self.max_ms = ms
-
-    def percentile(self, p: float) -> float:
-        """p in [0, 100]; 0.0 when empty."""
-        if self.n == 0:
-            return 0.0
-        target = p / 100.0 * self.n
-        seen = 0
-        for i, c in enumerate(self.counts):
-            seen += c
-            if seen >= target:
-                lo = self.BASE_MS * self.RATIO ** (i - 1) if i else 0.0
-                hi = self.BASE_MS * self.RATIO ** i
-                return math.sqrt(max(lo, self.BASE_MS * 0.5) * hi) if lo else hi
-        return self.max_ms
-
-    def to_json(self) -> Dict[str, Any]:
-        return {
-            "count": self.n,
-            "mean_ms": (self.sum_ms / self.n) if self.n else 0.0,
-            "max_ms": self.max_ms,
-            "p50_ms": self.percentile(50),
-            "p95_ms": self.percentile(95),
-            "p99_ms": self.percentile(99),
-        }
+#: live ServeMetrics instances, merged by the "serve" snapshot provider.
+#: Weak so a torn-down batcher's metrics don't outlive it in snapshots.
+_instances: "weakref.WeakSet[ServeMetrics]" = weakref.WeakSet()
 
 
 class ServeMetrics:
@@ -101,6 +61,7 @@ class ServeMetrics:
         self.batch_latency = LatencyHistogram()
         #: gauges polled at snapshot time (e.g. live queue depth)
         self._gauges: Dict[str, Callable[[], Any]] = {}
+        _instances.add(self)
 
     # ---- mutators ----------------------------------------------------------
     def inc(self, name: str, by: int = 1) -> None:
@@ -125,6 +86,19 @@ class ServeMetrics:
             self._gauges[name] = fn
 
     # ---- export ------------------------------------------------------------
+    def _merge_into(self, acc: Dict[str, Any]) -> None:
+        """Fold this instance into a cross-instance accumulator (held under
+        this instance's lock; the accumulator is provider-local)."""
+        with self._lock:
+            for k in ("requests", "responses", "shed", "errors",
+                      "fallback_records", "fallback_batches", "batches",
+                      "occupancy_sum", "padded_rows", "swaps"):
+                acc[k] += getattr(self, k)
+            for b, c in self.bucket_counts.items():
+                acc["bucket_counts"][b] = acc["bucket_counts"].get(b, 0) + c
+            acc["request_latency"].merge(self.request_latency)
+            acc["batch_latency"].merge(self.batch_latency)
+
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             out: Dict[str, Any] = {
@@ -151,3 +125,32 @@ class ServeMetrics:
             except Exception:
                 out[name] = None
         return out
+
+
+def merged_snapshot() -> Dict[str, Any]:
+    """ServeMetrics.snapshot() shape, summed over every live instance (a
+    process may run several batchers; gauges are per-instance and excluded).
+    This is ``obs.snapshot()["serve"]``."""
+    acc: Dict[str, Any] = {
+        k: 0 for k in ("requests", "responses", "shed", "errors",
+                       "fallback_records", "fallback_batches", "batches",
+                       "occupancy_sum", "padded_rows", "swaps")}
+    acc["bucket_counts"] = {}
+    acc["request_latency"] = LatencyHistogram()
+    acc["batch_latency"] = LatencyHistogram()
+    n = 0
+    for m in list(_instances):
+        m._merge_into(acc)
+        n += 1
+    occ = acc.pop("occupancy_sum")
+    acc["batch_occupancy_mean"] = occ / acc["batches"] if acc["batches"] \
+        else 0.0
+    acc["bucket_counts"] = {str(k): v for k, v in
+                            sorted(acc["bucket_counts"].items())}
+    acc["request_latency"] = acc["request_latency"].to_json()
+    acc["batch_latency"] = acc["batch_latency"].to_json()
+    acc["instances"] = n
+    return acc
+
+
+obs_registry.register_provider("serve", merged_snapshot)

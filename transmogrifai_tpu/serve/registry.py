@@ -25,6 +25,7 @@ import time
 from typing import Dict, List, Optional, Sequence
 
 from ..local.scoring import BatchScoreFunction, ScoreFunction
+from ..obs import trace
 from ..workflow.model import OpWorkflowModel
 from .metrics import ServeMetrics
 
@@ -67,8 +68,10 @@ class ServingModel:
 
     def warmup(self) -> None:
         """Score null records at every bucket size (compiles all shapes)."""
-        for b in self.buckets:
-            self.batch([{} for _ in range(b)])
+        with trace.span("serve.warmup", version=self.version,
+                        buckets=len(self.buckets)):
+            for b in self.buckets:
+                self.batch([{} for _ in range(b)])
         self.warmed = True
 
     @contextlib.contextmanager
@@ -121,14 +124,16 @@ class ModelRegistry:
         entry = ServingModel(version, model, self.buckets)
         if warm:
             entry.warmup()  # raises -> deploy aborted, active model untouched
-        with self._lock:
-            old, self._active = self._active, entry
-            entry.deployed_at_ms = int(time.time() * 1000)
-            self._history.append(version)
-        if self.metrics is not None:
-            self.metrics.inc("swaps")
+        with trace.span("serve.swap", version=version):
+            with self._lock:
+                old, self._active = self._active, entry
+                entry.deployed_at_ms = int(time.time() * 1000)
+                self._history.append(version)
+            if self.metrics is not None:
+                self.metrics.inc("swaps")
         if old is not None:
-            old.drain(drain_timeout_s)
+            with trace.span("serve.drain", version=old.version):
+                old.drain(drain_timeout_s)
         return entry
 
     def active(self) -> ServingModel:

@@ -12,7 +12,9 @@ Endpoints:
   ``{"records": [...]}``; response carries the scoring model's version.
 - ``POST /models``  — hot-swap: ``{"path": "<saved model dir>",
   "version": "v2"?}`` loads, warms and atomically swaps via the registry.
-- ``GET /metrics``  — serve metrics snapshot + registry/queue state.
+- ``GET /metrics``  — serve metrics snapshot + registry/queue state;
+  ``GET /metrics?format=prometheus`` renders the full obs registry snapshot
+  (sweep/stream/flops/serve) in Prometheus text exposition format.
 - ``GET /models``   — registry info (active version, history, buckets).
 - ``GET /healthz``  — 200 once a warmed model is active, else 503.
 """
@@ -23,7 +25,9 @@ import threading
 from concurrent.futures import TimeoutError as FutureTimeoutError
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
+from urllib.parse import parse_qs, urlsplit
 
+from .. import obs
 from .batcher import MicroBatcher, ShedError
 from .metrics import ServeMetrics
 from .registry import ModelRegistry
@@ -120,9 +124,25 @@ def _make_handler(server: "ModelServer"):
             length = int(self.headers.get("Content-Length") or 0)
             return json.loads(self.rfile.read(length) or b"null")
 
+        def _reply_text(self, status: int, text: str) -> None:
+            body = text.encode()
+            self.send_response(status)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         # ---- GET -----------------------------------------------------------
         def do_GET(self):
-            if self.path == "/metrics":
+            url = urlsplit(self.path)
+            if url.path == "/metrics":
+                fmt = parse_qs(url.query).get("format", [""])[0]
+                if fmt == "prometheus":
+                    # the unified registry (sweep/stream/flops/serve), text
+                    # exposition — same numbers as the JSON payload
+                    self._reply_text(200, obs.prometheus_text(obs.snapshot()))
+                    return
                 self._reply(200, {"serve": server.metrics.snapshot(),
                                   "registry": server.registry.info()})
             elif self.path == "/models":

@@ -28,6 +28,8 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 
+from ..obs import registry as obs_registry
+
 _enabled: bool = bool(int(os.environ.get("TMOG_COUNT_FLOPS", "0") or 0))
 _totals: Dict[str, float] = {"flops": 0.0, "bytes_accessed": 0.0, "calls": 0.0}
 _by_fn: Dict[str, Dict[str, Any]] = {}
@@ -40,6 +42,11 @@ _collectives: Dict[str, Dict[str, float]] = {}
 #: adjustment — this bucket records the AVOIDED build FLOPs separately
 #: (trace-time estimates: loop bodies counted once, like the collectives).
 _hist_subtracted: Dict[str, float] = {"levels": 0.0, "flops_avoided": 0.0}
+#: GBT boosting-chain telemetry from the trees kernels' trace events: how
+#: many sequential scan launches carried a boosting chain and the longest
+#: chain (scan steps) any of them dispatched — the critical-path number the
+#: round-collapse attacks
+_gbt_chain: Dict[str, float] = {"chains": 0.0, "steps_max": 0.0}
 #: streamed transform-pipeline traffic (workflow/stream.py): bytes pushed
 #: through device_put per chunk and pulled back for terminal columns, plus
 #: the chunk/launch counts — the "intermediates never leave the device"
@@ -69,6 +76,7 @@ def reset() -> None:
     _by_device.clear()
     _collectives.clear()
     _hist_subtracted.update(levels=0.0, flops_avoided=0.0)
+    _gbt_chain.update(chains=0.0, steps_max=0.0)
     _streamed.update(bytes_in=0.0, bytes_out=0.0, chunks=0.0, streams=0.0)
 
 
@@ -98,8 +106,14 @@ def totals() -> Dict[str, Any]:
         for k, v in _by_device.items()}
     out["collectives"] = {k: dict(v) for k, v in _collectives.items()}
     out["hist_subtracted"] = dict(_hist_subtracted)
+    out["gbt_chain"] = dict(_gbt_chain)
     out["streamed"] = dict(_streamed)
     return out
+
+
+#: obs.snapshot()["flops"] is this module's totals() — the registry never
+#: duplicates the buckets, it reads them through the provider
+obs_registry.register_provider("flops", totals)
 
 
 def record_streamed(bytes_in: float, bytes_out: float, chunks: int) -> None:
@@ -136,6 +150,13 @@ def record_collectives(colls, device=None) -> None:
             # parallel.mesh.record_trace_event)
             _hist_subtracted["levels"] += 1
             _hist_subtracted["flops_avoided"] += nbytes
+            continue
+        if kind == "gbt_chain":
+            # not traffic either: a trees-kernel trace event carrying the
+            # boosting scan length (post round-collapse) of one launch
+            _gbt_chain["chains"] += 1
+            _gbt_chain["steps_max"] = max(_gbt_chain["steps_max"],
+                                          float(nbytes))
             continue
         agg = _collectives.setdefault(
             axis, {"count": 0.0, "bytes": 0.0})
@@ -223,7 +244,8 @@ def _cost(fn, args, kwargs) -> Optional[Dict[str, Any]]:
         return {"flops": float(ca.get("flops", 0.0)),
                 "bytes_accessed": float(ca.get("bytes accessed",
                                                ca.get("bytes_accessed", 0.0))),
-                "events": tuple(c for c in colls if c[0] == "hist_subtracted")}
+                "events": tuple(c for c in colls
+                                if c[0] in ("hist_subtracted", "gbt_chain"))}
     except Exception:
         return None
 

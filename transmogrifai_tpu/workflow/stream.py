@@ -52,6 +52,8 @@ import numpy as np
 
 from .. import types as T
 from ..columns import Dataset, NumericColumn, ObjectColumn, VectorColumn
+from ..obs import registry as obs_registry
+from ..obs import trace
 
 
 # ---------------------------------------------------------------------------
@@ -88,30 +90,27 @@ def handoff_budget_bytes() -> int:
 
 
 # ---------------------------------------------------------------------------
-# Telemetry (ops/sweep.run_stats pattern)
+# Telemetry (ops/sweep.run_stats pattern) — storage lives in the central obs
+# registry (scope "stream"); stream_stats() below is the backward-compatible
+# view over it, and is also what obs.snapshot()["stream"] reports.
 # ---------------------------------------------------------------------------
-_stats: Dict[str, Any] = {}
+_stream_scope = obs_registry.scope("stream", defaults=dict(
+    streams=0, chunks=0, rows=0, pad_rows=0, chunk_rows=0,
+    stages_fused=0, stages_host=0, layers=0,
+    terminals=0, device_only=0,
+    bytes_in=0.0, bytes_out=0.0, compiles=0,
+    device_handoffs=0, handoff_bytes=0.0,
+    upload_s=0.0, pull_wait_s=0.0, wall_s=0.0,
+    fallbacks=[],
+))
 
 
 def reset_stream_stats() -> None:
-    _stats.clear()
-    _stats.update(
-        streams=0, chunks=0, rows=0, pad_rows=0, chunk_rows=0,
-        stages_fused=0, stages_host=0, layers=0,
-        terminals=0, device_only=0,
-        bytes_in=0.0, bytes_out=0.0, compiles=0,
-        device_handoffs=0, handoff_bytes=0.0,
-        upload_s=0.0, pull_wait_s=0.0, wall_s=0.0,
-        fallbacks=[],
-    )
-
-
-reset_stream_stats()
+    _stream_scope.reset()
 
 
 def stream_stats() -> Dict[str, Any]:
-    out = dict(_stats)
-    out["fallbacks"] = list(_stats["fallbacks"])
+    out = _stream_scope.snapshot()
     wall = out["wall_s"]
     # device-busy vs transfer-wait: share of stream wall NOT spent blocked
     # on host-side chunk prep/upload or on output pulls
@@ -122,8 +121,13 @@ def stream_stats() -> Dict[str, Any]:
     return out
 
 
+obs_registry.register_provider("stream", stream_stats)
+
+
 def record_fallback(reason: str, **detail: Any) -> None:
-    _stats["fallbacks"].append({"reason": reason, **detail})
+    """Delegates to the one central recorder (obs.registry.record_fallback,
+    domain="stream"); ``stream_stats()["fallbacks"]`` is the audit trail."""
+    obs_registry.record_fallback("stream", reason, **detail)
 
 
 # ---------------------------------------------------------------------------
@@ -460,8 +464,8 @@ def handoff_rows(src_host, dst_host, idx) -> bool:
     dev = jnp.take(view, jnp.asarray(np.asarray(idx)), axis=0)
     if not devcache.seed(dst_host, dev, np.float32):
         return False
-    _stats["device_handoffs"] += 1
-    _stats["handoff_bytes"] += float(dev.nbytes)
+    _stream_scope.inc("device_handoffs")
+    _stream_scope.inc("handoff_bytes", float(dev.nbytes))
     return True
 
 
@@ -487,8 +491,8 @@ def execute(plan: StreamPlan, ds: Dataset) -> Dict[str, Any]:
     n = len(ds)
     jitted = _program_for(plan)
     cs_before = _cache_size(jitted)
-    bytes_in0 = _stats["bytes_in"]
-    bytes_out0 = _stats["bytes_out"]
+    bytes_in0 = _stream_scope.get("bytes_in")
+    bytes_out0 = _stream_scope.get("bytes_out")
     t_wall = time.perf_counter()
 
     out_vals: Dict[str, np.ndarray] = {}
@@ -500,69 +504,77 @@ def execute(plan: StreamPlan, ds: Dataset) -> Dict[str, Any]:
     def drain(item) -> None:
         lo, rows, outs = item
         t0 = time.perf_counter()
-        for e in terminals:
-            o = outs[e.out_name]
-            if e.out_kind == "numeric":
-                hv = np.asarray(o[0])
-                hm = np.asarray(o[1])
-                if e.out_name not in out_vals:
-                    out_vals[e.out_name] = np.empty(n, hv.dtype)
-                    out_masks[e.out_name] = np.empty(n, bool)
-                out_vals[e.out_name][lo:lo + rows] = hv[:rows]
-                out_masks[e.out_name][lo:lo + rows] = hm[:rows]
-                _stats["bytes_out"] += float(
-                    rows * (hv.itemsize + hm.itemsize))
-            else:
-                hv = np.asarray(o)
-                if e.out_name not in out_vals:
-                    out_vals[e.out_name] = np.empty((n, hv.shape[1]),
-                                                    np.float32)
-                out_vals[e.out_name][lo:lo + rows] = hv[:rows]
-                _stats["bytes_out"] += float(rows * hv.shape[1] * 4)
-        _stats["pull_wait_s"] += time.perf_counter() - t0
+        with trace.span("stream.chunk.pull", lo=lo, rows=rows):
+            for e in terminals:
+                o = outs[e.out_name]
+                if e.out_kind == "numeric":
+                    hv = np.asarray(o[0])
+                    hm = np.asarray(o[1])
+                    if e.out_name not in out_vals:
+                        out_vals[e.out_name] = np.empty(n, hv.dtype)
+                        out_masks[e.out_name] = np.empty(n, bool)
+                    out_vals[e.out_name][lo:lo + rows] = hv[:rows]
+                    out_masks[e.out_name][lo:lo + rows] = hm[:rows]
+                    _stream_scope.inc("bytes_out", float(
+                        rows * (hv.itemsize + hm.itemsize)))
+                else:
+                    hv = np.asarray(o)
+                    if e.out_name not in out_vals:
+                        out_vals[e.out_name] = np.empty((n, hv.shape[1]),
+                                                        np.float32)
+                    out_vals[e.out_name][lo:lo + rows] = hv[:rows]
+                    _stream_scope.inc("bytes_out",
+                                      float(rows * hv.shape[1] * 4))
+        _stream_scope.inc("pull_wait_s", time.perf_counter() - t0)
 
     inflight: deque = deque()
     n_chunks = 0
-    for lo in range(0, n, C):
-        hi = min(lo + C, n)
-        rows = hi - lo
-        t0 = time.perf_counter()
-        host_args, nbytes = _host_chunk_args(plan, ds, lo, hi, C)
-        dev_args = jax.device_put(host_args)
-        with warnings.catch_warnings():
-            # XLA can't reuse every donated buffer (e.g. bool masks with no
-            # same-shape output); that's expected, not actionable
-            warnings.filterwarnings(
-                "ignore", message="Some donated buffers were not usable")
-            outs = jitted(dev_args)  # async dispatch; donates the uploads
-        _stats["upload_s"] += time.perf_counter() - t0
-        _stats["bytes_in"] += nbytes
-        _stats["pad_rows"] += C - rows
-        n_chunks += 1
-        for nm in plan.handoff:
-            hand_chunks[nm].append((outs[nm], rows))
-        inflight.append((lo, rows, outs))
-        while len(inflight) > B:
+    with trace.span("stream.execute", rows=n, chunk_rows=C, window=B):
+        for lo in range(0, n, C):
+            hi = min(lo + C, n)
+            rows = hi - lo
+            t0 = time.perf_counter()
+            with trace.span("stream.chunk.upload", lo=lo, rows=rows):
+                host_args, nbytes = _host_chunk_args(plan, ds, lo, hi, C)
+                dev_args = jax.device_put(host_args)
+                with warnings.catch_warnings():
+                    # XLA can't reuse every donated buffer (e.g. bool masks
+                    # with no same-shape output); that's expected, not
+                    # actionable
+                    warnings.filterwarnings(
+                        "ignore",
+                        message="Some donated buffers were not usable")
+                    # async dispatch; donates the uploads
+                    outs = jitted(dev_args)
+            _stream_scope.inc("upload_s", time.perf_counter() - t0)
+            _stream_scope.inc("bytes_in", nbytes)
+            _stream_scope.inc("pad_rows", C - rows)
+            n_chunks += 1
+            for nm in plan.handoff:
+                hand_chunks[nm].append((outs[nm], rows))
+            inflight.append((lo, rows, outs))
+            while len(inflight) > B:
+                drain(inflight.popleft())
+        while inflight:
             drain(inflight.popleft())
-    while inflight:
-        drain(inflight.popleft())
 
     cs_after = _cache_size(jitted)
     if cs_before is not None and cs_after is not None:
-        _stats["compiles"] += max(0, cs_after - cs_before)
-    _stats["streams"] += 1
-    _stats["chunks"] += n_chunks
-    _stats["chunk_rows"] = C
-    _stats["rows"] += n
-    _stats["terminals"] += len(terminals)
-    _stats["device_only"] += len(plan.stages) - len(terminals)
+        _stream_scope.inc("compiles", max(0, cs_after - cs_before))
+    _stream_scope.inc("streams")
+    _stream_scope.inc("chunks", n_chunks)
+    _stream_scope.set("chunk_rows", C)
+    _stream_scope.inc("rows", n)
+    _stream_scope.inc("terminals", len(terminals))
+    _stream_scope.inc("device_only", len(plan.stages) - len(terminals))
     wall = time.perf_counter() - t_wall
-    _stats["wall_s"] += wall
+    _stream_scope.inc("wall_s", wall)
 
     from ..utils import flops
 
-    flops.record_streamed(_stats["bytes_in"] - bytes_in0,
-                          _stats["bytes_out"] - bytes_out0, n_chunks)
+    flops.record_streamed(_stream_scope.get("bytes_in") - bytes_in0,
+                          _stream_scope.get("bytes_out") - bytes_out0,
+                          n_chunks)
 
     new_cols: Dict[str, Any] = {}
     for e in terminals:
@@ -612,9 +624,9 @@ def apply_streamed(ds: Dataset, layers: Sequence[Sequence[Any]],
         return None
     from . import dag as dag_util
 
-    _stats["stages_fused"] += plan.n_stream
-    _stats["stages_host"] += sum(len(l) for l in plan.host_layers)
-    _stats["layers"] += len(layers)
+    _stream_scope.inc("stages_fused", plan.n_stream)
+    _stream_scope.inc("stages_host", sum(len(l) for l in plan.host_layers))
+    _stream_scope.inc("layers", len(layers))
     with dag_util._maybe_time(_StreamLabel(plan), "transform", n):
         new_cols = execute(plan, ds)
     ds = ds.with_columns(new_cols)
