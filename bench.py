@@ -401,6 +401,16 @@ def main():
                  for s in l["per_shard"]]
     if per_shard:
         out["sweep_per_shard"] = per_shard
+    # predicted-vs-measured per-shard cost error (MAPE + makespan ratios):
+    # every bench run appends its own eval row to the telemetry record, so
+    # the learned cost model's eval set grows for free
+    try:
+        from transmogrifai_tpu import costmodel
+        cm_eval = costmodel.eval_launches(sweep_stats["launches"])
+        if cm_eval:
+            out["costmodel_eval"] = cm_eval
+    except Exception:
+        pass
     # row-sharded launches: per-axis collective traffic + the memory story
     # (peak per-device X/y bytes vs what full replication would have held)
     coll_axes = {}

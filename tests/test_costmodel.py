@@ -1,0 +1,276 @@
+"""Learned cost model: feature extraction, regressor, artifact, env knobs.
+
+Contracts under test (ISSUE 7):
+
+- golden JSONL rows -> stable feature vectors (exact values, fixed order),
+- missing / NaN / malformed fields degrade to 0.0 instead of raising,
+- a row with a bumped ``schema_version`` (and unknown extra fields) still
+  extracts — the extractor never hard-asserts the record schema,
+- train -> predict -> save -> load roundtrip is EXACT (bit-identical
+  parameters and predictions via JSON shortest-repr float serialization),
+- the training CLI (``python -m transmogrifai_tpu.costmodel``) trains,
+  checks and exits 0 even from an empty telemetry file,
+- the consolidated ``utils/env`` helpers are empty-string tolerant.
+"""
+import json
+import math
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.costmodel import eval_launches
+from transmogrifai_tpu.costmodel.features import (
+    FAMILIES, FEATURE_NAMES, family_units, feature_vector, iter_records,
+    shard_samples, stream_samples, synthetic_samples)
+from transmogrifai_tpu.costmodel.model import (ARTIFACT_SCHEMA, ARTIFACT_VERSION,
+                                               CostModel)
+from transmogrifai_tpu.obs.record import SCHEMA
+from transmogrifai_tpu.obs.registry import SCHEMA_VERSION
+from transmogrifai_tpu.utils import env
+
+
+def _golden_feat():
+    feat = {
+        "log_units": math.log1p(5.5e8),
+        "n_candidates": 7.0, "log_rows": math.log1p(891),
+        "log_features": math.log1p(20), "n_folds": 3.0,
+        "log_gbt_chain_levels": math.log1p(500), "depth_max": 12.0,
+        "log_bins_max": math.log1p(256), "data_shards": 2.0,
+        "log_rows_local": math.log1p(446),
+    }
+    units = {"linear": 1e6, "mlp": 0.0, "forest": 4.4e8, "gbt": 1.09e8}
+    cands = {"linear": 3, "mlp": 0, "forest": 3, "gbt": 1}
+    for f in FAMILIES:
+        feat[f"log_units_{f}"] = math.log1p(units[f])
+        feat[f"cand_{f}"] = float(cands[f])
+    return feat
+
+
+def _golden_row(feat, wall=1.25, compile_s=0.5, schema_version=SCHEMA_VERSION,
+                **extra):
+    row = {
+        "schema": SCHEMA, "schema_version": schema_version,
+        "ts": 1700000000.0, "kind": "bench",
+        "context": {"platform": "tpu", "device_kind": "TPU v5e",
+                    "device_count": 8, "env": {}},
+        "snapshot": {
+            "schema_version": schema_version,
+            "sweep": {"launches": [{
+                "shards": 2, "candidates": 28, "wall_s": wall,
+                "per_shard": [{
+                    "device": "TPU_0", "candidates": 7,
+                    "predicted_cost": 5.5e8, "compile_s": compile_s,
+                    "wall_s": wall, "feat": feat,
+                }],
+            }]},
+            "stream": {"streams": 1, "chunks": 4, "rows": 1000,
+                       "chunk_rows": 256, "buffers": 3, "wall_s": 2.0,
+                       "handoff_bytes": 1024.0},
+        },
+    }
+    row.update(extra)
+    return row
+
+
+def test_golden_rows_stable_vectors(tmp_path):
+    """Two golden JSONL rows extract to exactly the hand-computed vectors."""
+    feat = _golden_feat()
+    p = tmp_path / "t.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps(_golden_row(feat)) + "\n")
+        f.write("this line is garbage and must be skipped\n")
+        f.write(json.dumps(_golden_row(feat, wall=2.5)) + "\n")
+    rows = list(iter_records(str(p)))
+    assert len(rows) == 2
+    samples = shard_samples(rows)
+    assert len(samples) == 2
+    # runtime context merged in from the row
+    assert samples[0]["feat"]["device_count"] == 8
+    assert samples[0]["feat"]["is_tpu"] == 1.0
+    assert samples[0]["wall_s"] == 1.25
+    assert samples[0]["compile_s"] == 0.5
+    assert samples[0]["steady_s"] == pytest.approx(0.75)
+    expected = np.array([
+        feat["log_units"], feat["log_units_linear"], feat["log_units_mlp"],
+        feat["log_units_forest"], feat["log_units_gbt"],
+        7.0, 3.0, 0.0, 3.0, 1.0,
+        feat["log_rows"], feat["log_features"], 3.0,
+        feat["log_gbt_chain_levels"], 12.0, feat["log_bins_max"],
+        2.0, feat["log_rows_local"], 8.0, 1.0])
+    v = feature_vector(samples[0]["feat"])
+    assert v.shape == (len(FEATURE_NAMES),)
+    np.testing.assert_array_equal(v, expected)
+    # identical rows -> identical vectors (stability)
+    np.testing.assert_array_equal(v, feature_vector(samples[1]["feat"]))
+    # raw family units come back out of the log features
+    fu = family_units(samples[0]["feat"])
+    assert fu["forest"] == pytest.approx(4.4e8, rel=1e-12)
+
+
+def test_missing_and_nan_fields_degrade(tmp_path):
+    feat = {"log_units": float("nan"), "depth_max": float("inf"),
+            "n_candidates": "not-a-number", "unknown_field": 123.0}
+    v = feature_vector(feat)
+    assert v.shape == (len(FEATURE_NAMES),)
+    assert np.all(np.isfinite(v))
+    assert np.all(v == 0.0)  # every recognized field was missing/NaN/garbage
+    assert np.all(feature_vector(None) == 0.0)
+    assert np.all(feature_vector({}) == 0.0)
+    # per-shard entries without feat / without wall are skipped, not fatal
+    row = _golden_row(_golden_feat())
+    row["snapshot"]["sweep"]["launches"][0]["per_shard"].append(
+        {"device": "TPU_1", "wall_s": 1.0})          # no feat
+    row["snapshot"]["sweep"]["launches"][0]["per_shard"].append(
+        {"device": "TPU_2", "feat": {"log_units": 1.0}})  # no wall
+    row["snapshot"]["sweep"]["launches"].append("not-a-dict")
+    assert len(shard_samples([row, "not-a-row", None, {}])) == 1
+
+
+def test_schema_version_bump_still_extracts():
+    """A future row (schema_version + 1, unknown fields) must extract."""
+    row = _golden_row(_golden_feat(), schema_version=SCHEMA_VERSION + 1,
+                      new_toplevel_field={"x": 1})
+    row["snapshot"]["sweep"]["launches"][0]["per_shard"][0]["new_field"] = [1]
+    samples = shard_samples([row])
+    assert len(samples) == 1
+    assert np.all(np.isfinite(feature_vector(samples[0]["feat"])))
+    st = stream_samples([row])
+    assert len(st) == 1
+
+
+def test_stream_samples_golden():
+    st = stream_samples([_golden_row(_golden_feat())])
+    assert st == [{"chunk_rows": 256, "buffers": 3, "rows": 1000.0,
+                   "wall_s": 2.0, "rows_per_sec": 500.0,
+                   "handoff_bytes": 1024.0}]
+    # stream snapshots with zero rows/wall are not evidence
+    row = _golden_row(_golden_feat())
+    row["snapshot"]["stream"]["rows"] = 0
+    assert stream_samples([row]) == []
+
+
+def test_fit_predict_save_load_roundtrip_exact(tmp_path):
+    samples = synthetic_samples(64, seed=0)
+    st = stream_samples([_golden_row(_golden_feat())])
+    m = CostModel().fit(samples, stream_samples=st)
+    assert m.fitted and m.n_samples == 64
+    p = m.predict(samples[0]["feat"])
+    assert set(p) == {"wall_s", "compile_s", "calib_wall_s"}
+    assert all(math.isfinite(v) and v >= 0 for v in p.values())
+    assert p["wall_s"] > 0
+    # the proposal reflects the single observed stream config
+    assert m.stream_proposal()["chunk_rows"] == 256
+    assert m.stream_proposal()["buffers"] == 3
+
+    path = str(tmp_path / "cm.json")
+    m.save(path)
+    doc = json.load(open(path))
+    assert doc["schema"] == ARTIFACT_SCHEMA
+    assert doc["version"] == ARTIFACT_VERSION
+    m2 = CostModel.load(path)
+    # EXACT roundtrip: parameters and predictions bit-identical
+    assert m2.to_dict() == m.to_dict()
+    for s in samples[:8]:
+        assert m2.predict(s["feat"]) == m.predict(s["feat"])
+    for kind in ("fista", "newton", "svc", "mlp", "forest", "gbt"):
+        assert m2.unit_scale(kind) == m.unit_scale(kind)
+
+
+def test_calibration_recovers_family_scales():
+    """Strong families converge to the hidden ground truth; the fit's
+    predictions land within a loose held-in band (the CI smoke contract)."""
+    samples = synthetic_samples(64, seed=0)
+    m = CostModel().fit(samples)
+    # synthetic ground truth: forest 1e-8, gbt 6e-8 s/unit (features.py)
+    assert m.unit_scale("forest") == pytest.approx(1e-8, rel=0.25)
+    assert m.unit_scale("gbt") == pytest.approx(6e-8, rel=0.25)
+    preds = np.array([m.predict(s["feat"])["wall_s"] for s in samples])
+    meas = np.array([s["steady_s"] for s in samples])
+    assert np.all(np.isfinite(preds)) and np.all(preds > 0)
+    assert np.median(np.maximum(preds / meas, meas / preds)) < 2.0
+
+
+def test_unfit_model_raises():
+    m = CostModel()
+    with pytest.raises(RuntimeError):
+        m.predict({})
+    with pytest.raises(RuntimeError):
+        m.unit_scale("gbt")
+    with pytest.raises(RuntimeError):
+        m.to_dict()
+    with pytest.raises(ValueError):
+        m.fit([])
+
+
+def test_artifact_rejects_wrong_schema(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps({"schema": "something.else", "version": 1}))
+    with pytest.raises(ValueError):
+        CostModel.load(str(p))
+    p.write_text(json.dumps({"schema": ARTIFACT_SCHEMA,
+                             "version": ARTIFACT_VERSION + 1}))
+    with pytest.raises(ValueError):
+        CostModel.load(str(p))
+
+
+def test_eval_launches(monkeypatch):
+    monkeypatch.delenv("TMOG_COSTMODEL", raising=False)
+    launches = [{"shards": 2, "per_shard": [
+        {"predicted_cost": 1.0, "wall_s": 1.1, "compile_s": 0.1},
+        {"predicted_cost": 3.0, "wall_s": 3.1, "compile_s": 0.1}]}]
+    ev = eval_launches(launches)
+    assert ev is not None
+    # scale = 4.0s / 4.0 units -> predictions exactly match steady walls
+    assert ev["mape"] == 0.0
+    assert ev["measured_makespan_ratio"] == 1.5
+    assert ev["predicted_makespan_ratio"] == 1.5
+    assert ev["shards"] == 2
+    assert eval_launches([]) is None
+    assert eval_launches([{"shards": 1, "per_shard": [{}]}]) is None
+
+
+def test_cli_trains_and_checks(tmp_path, capsys):
+    from transmogrifai_tpu.costmodel.__main__ import main
+
+    out = str(tmp_path / "cm.json")
+    # empty telemetry + no fallback: graceful no-op
+    assert main(["--telemetry", str(tmp_path / "none.jsonl"),
+                 "--out", out]) == 0
+    # synthetic fallback: full train -> save -> load -> check
+    assert main(["--telemetry", str(tmp_path / "none.jsonl"), "--out", out,
+                 "--synthetic-fallback", "64", "--check"]) == 0
+    m = CostModel.load(out)
+    assert m.n_samples == 64
+    # real telemetry rows train too
+    p = tmp_path / "t.jsonl"
+    with open(p, "w") as f:
+        for i in range(10):
+            f.write(json.dumps(_golden_row(_golden_feat(),
+                                           wall=1.0 + 0.1 * i)) + "\n")
+    assert main(["--telemetry", str(p), "--out", out, "--min-samples", "8",
+                 "--check"]) == 0
+    assert CostModel.load(out).n_samples == 10
+
+
+def test_env_helpers(monkeypatch):
+    monkeypatch.setenv("T_X", "")
+    assert env.env_int("T_X", 7) == 7
+    assert env.env_float("T_X", 0.5) == 0.5
+    assert env.env_str("T_X", "d") == "d"
+    assert env.env_flag("T_X", True) is True
+    assert env.env_set("T_X") is False
+    monkeypatch.setenv("T_X", " 1e3 ")
+    assert env.env_int("T_X", 7) == 1000
+    assert env.env_set("T_X") is True
+    monkeypatch.setenv("T_X", "garbage")
+    assert env.env_int("T_X", 7) == 7
+    assert env.env_float("T_X", 0.5) == 0.5
+    monkeypatch.setenv("T_X", "0")
+    assert env.env_flag("T_X", True) is False
+    monkeypatch.setenv("T_X", "off")
+    assert env.env_flag("T_X") is False
+    monkeypatch.setenv("T_X", "1")
+    assert env.env_flag("T_X") is True
+    monkeypatch.delenv("T_X")
+    assert env.env_int("T_X", 7) == 7
+    assert env.env_set("T_X") is False

@@ -1,0 +1,319 @@
+"""Learned cost model driving the sweep partitioner + stream autotune.
+
+Acceptance contract (ISSUE 7):
+
+- with ``TMOG_COSTMODEL`` unset, spec partitioning and stream knob
+  selection are BIT-IDENTICAL to the analytic behavior: no provider
+  resolves, the ``spec_units`` floats are never touched, repeated calls
+  agree exactly, and the identity provider reproduces the same floats,
+- a model trained on >= 50 synthetic telemetry rows (whole-unit subsets
+  of the default 28-candidate grid, walls from a hidden per-family
+  ground truth) yields an LPT partition whose TRUE makespan is <= the
+  hand-tuned ``spec_units`` partition's at 2/4/8 shards — and strictly
+  better at 4,
+- activation is env-driven end to end: artifact at
+  ``TMOG_COSTMODEL_PATH`` + ``TMOG_COSTMODEL=1``, any failure falls back
+  to analytic and records a ``costmodel`` fallback,
+- the stream autotune proposal applies ONLY to knobs the user left unset
+  (empty string counts as unset) and is recorded in ``stream_stats()``,
+- partitioned sweep launches stamp per-shard ``feat`` dicts into
+  telemetry (the self-describing training rows everything above eats).
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from transmogrifai_tpu import costmodel
+from transmogrifai_tpu.costmodel.features import (shard_feature_dict,
+                                                  synthetic_samples,
+                                                  unit_family)
+from transmogrifai_tpu.costmodel.model import CostModel
+from transmogrifai_tpu.evaluators.classification import \
+    OpBinaryClassificationEvaluator
+from transmogrifai_tpu.impl.classification.logistic import OpLogisticRegression
+from transmogrifai_tpu.impl.classification.trees import (
+    OpRandomForestClassifier, OpXGBoostClassifier)
+from transmogrifai_tpu.impl.selector import defaults as D
+from transmogrifai_tpu.impl.sweep_fragments import (build_subspec,
+                                                    build_sweep_plan,
+                                                    spec_units)
+from transmogrifai_tpu.impl.tuning.validators import OpCrossValidation
+from transmogrifai_tpu.obs import registry as obs_registry
+from transmogrifai_tpu.ops import sweep as sweep_ops
+from transmogrifai_tpu.parallel.spec_partition import (_resolve_cost_provider,
+                                                       partition_spec,
+                                                       set_cost_provider)
+from transmogrifai_tpu.workflow import stream
+
+_KNOBS = ("TMOG_COSTMODEL", "TMOG_COSTMODEL_PATH",
+          "TMOG_TRANSFORM_CHUNK_ROWS", "TMOG_STREAM_BUFFERS",
+          "TMOG_STREAM_HANDOFF_BYTES")
+
+#: hidden ground truth for the synthetic telemetry: the analytic constants
+#: are wrong by these per-family factors (seconds = units * factor * T0)
+_T0 = 2e-8
+_TRUE_FACTOR = {"linear": 1.0, "mlp": 1.0, "forest": 0.3, "gbt": 8.0}
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for k in _KNOBS:
+        monkeypatch.delenv(k, raising=False)
+    costmodel.invalidate_cache()
+    obs_registry.scope("costmodel").reset()
+    yield
+    costmodel.invalidate_cache()
+
+
+@pytest.fixture(scope="module")
+def default_plan():
+    rng = np.random.default_rng(0)
+    n, d, F = 240, 12, 3
+    X = np.ascontiguousarray(rng.normal(size=(n, d)).astype(np.float32))
+    beta = rng.normal(size=d)
+    y = (X @ beta + 0.3 * rng.normal(size=n) > 0).astype(np.float32)
+    ev = OpBinaryClassificationEvaluator()
+    cv = OpCrossValidation(ev, num_folds=F, seed=7, mesh=None)
+    train_w, val_mask = cv.make_folds(n, None)
+    plan = build_sweep_plan(
+        [(OpLogisticRegression(max_iter=50), D.logistic_regression_grid()),
+         (OpRandomForestClassifier(), D.random_forest_grid()),
+         (OpXGBoostClassifier(), D.xgboost_grid())],
+        X, y, train_w, ev)
+    assert plan is not None and len(plan.spec[2]) == 28
+    return plan, train_w, val_mask, F
+
+
+def _partition(plan, F, k=4):
+    return partition_spec(plan.spec, plan.blob, k, plan.n_rows,
+                          plan.n_features, F)
+
+
+def _assert_same_partition(a, b):
+    assert len(a) == len(b)
+    for sa, sb in zip(a, b):
+        assert sa.cis == sb.cis
+        assert sa.cost == sb.cost  # EXACT float equality — bit-identical
+
+
+def _fallbacks():
+    return obs_registry.scope("costmodel").snapshot().get("fallbacks") or []
+
+
+# ---------------------------------------------------------------------------
+# Parity: TMOG_COSTMODEL unset -> analytic path, bit-identical
+# ---------------------------------------------------------------------------
+def test_parity_env_unset(default_plan):
+    plan, _, _, F = default_plan
+    assert _resolve_cost_provider() == (None, None)
+    a = _partition(plan, F)
+    b = _partition(plan, F)
+    _assert_same_partition(a, b)
+    # the identity provider routes through the provider machinery yet
+    # reproduces the exact same floats -> applying a provider is the ONLY
+    # thing that can change costs
+    prev = set_cost_provider(lambda u: u.per_cand)
+    try:
+        _assert_same_partition(a, _partition(plan, F))
+    finally:
+        set_cost_provider(prev)
+    assert _fallbacks() == []
+
+
+def test_enabled_but_artifact_missing_falls_back(default_plan, monkeypatch,
+                                                 tmp_path):
+    plan, _, _, F = default_plan
+    baseline = _partition(plan, F)
+    monkeypatch.setenv("TMOG_COSTMODEL", "1")
+    monkeypatch.setenv("TMOG_COSTMODEL_PATH", str(tmp_path / "nope.json"))
+    costmodel.invalidate_cache()
+    assert costmodel.active_model() is None
+    _assert_same_partition(baseline, _partition(plan, F))
+    assert any(f["reason"] == "artifact_missing" for f in _fallbacks())
+    # corrupt artifact: same story, different reason
+    p = tmp_path / "bad.json"
+    p.write_text("{not json")
+    monkeypatch.setenv("TMOG_COSTMODEL_PATH", str(p))
+    costmodel.invalidate_cache()
+    assert costmodel.active_model() is None
+    _assert_same_partition(baseline, _partition(plan, F))
+    assert any(f["reason"] == "artifact_load_failed" for f in _fallbacks())
+
+
+def test_bad_provider_values_fall_back(default_plan):
+    plan, _, _, F = default_plan
+    baseline = _partition(plan, F)
+    for bad in (lambda u: float("nan"), lambda u: 0.0, lambda u: -1.0):
+        prev = set_cost_provider(bad)
+        try:
+            _assert_same_partition(baseline, _partition(plan, F))
+        finally:
+            set_cost_provider(prev)
+    assert sum(f["reason"] == "provider_bad_cost" for f in _fallbacks()) == 3
+    prev = set_cost_provider(lambda u: 1 / 0)
+    try:
+        _assert_same_partition(baseline, _partition(plan, F))
+    finally:
+        set_cost_provider(prev)
+    assert any(f["reason"] == "provider_raised" for f in _fallbacks())
+
+
+def test_explicit_provider_count_balances(default_plan):
+    plan, _, _, F = default_plan
+    prev = set_cost_provider(lambda u: 1.0)
+    try:
+        shards = _partition(plan, F, k=4)
+    finally:
+        set_cost_provider(prev)
+    assert [s.n_candidates for s in shards] == [7, 7, 7, 7]
+    assert [s.cost for s in shards] == [7.0] * 4
+
+
+# ---------------------------------------------------------------------------
+# The acceptance bar: learned LPT makespan <= hand-tuned spec_units LPT
+# ---------------------------------------------------------------------------
+def _synthetic_telemetry_model(plan, F, n_rows=60, seed=11):
+    """>= 50 training rows: random WHOLE-unit subsets of the default grid
+    (whole units because per-candidate group costs are only stable under
+    ``build_subspec`` at unchanged group size), walls from the hidden
+    per-family ground truth -> features and targets are exactly the shapes
+    live telemetry records."""
+    units = spec_units(plan.spec, plan.n_rows, plan.n_features, F)
+    rng = np.random.default_rng(seed)
+    samples = []
+    while len(samples) < n_rows:
+        mask = rng.integers(0, 2, size=len(units))
+        chosen = [u for u, m in zip(units, mask) if m]
+        if not chosen:
+            continue
+        picks = {u.key: list(range(len(u.cis))) for u in chosen}
+        sub_spec, _blob, _cis = build_subspec(plan.spec, plan.blob, picks, F)
+        feat = shard_feature_dict(sub_spec, plan.n_rows, plan.n_features, F)
+        wall = sum(len(u.cis) * u.per_cand * _T0 *
+                   _TRUE_FACTOR[unit_family(u.kind)] for u in chosen)
+        samples.append({"feat": feat, "wall_s": wall + 0.3,
+                        "compile_s": 0.3, "steady_s": wall})
+    return CostModel().fit(samples), units
+
+
+def test_learned_partition_makespan(default_plan, monkeypatch, tmp_path):
+    plan, _, _, F = default_plan
+    model, units = _synthetic_telemetry_model(plan, F)
+    assert model.n_samples >= 50
+    # calibration learned the direction of the analytic model's error:
+    # gbt candidates are far more expensive per unit than forest ones
+    assert model.unit_scale("gbt") > 2 * model.unit_scale("forest")
+
+    true_cost = {ci: u.per_cand * _T0 * _TRUE_FACTOR[unit_family(u.kind)]
+                 for u in units for ci in u.cis}
+
+    def true_makespan(shards):
+        return max(sum(true_cost[ci] for ci in s.cis) for s in shards)
+
+    analytic = {k: _partition(plan, F, k) for k in (2, 4, 8)}
+    assert _resolve_cost_provider() == (None, None)
+
+    path = str(tmp_path / "cm.json")
+    model.save(path)
+    monkeypatch.setenv("TMOG_COSTMODEL", "1")
+    monkeypatch.setenv("TMOG_COSTMODEL_PATH", path)
+    costmodel.invalidate_cache()
+    provider, source = _resolve_cost_provider()
+    assert source == "learned" and provider is not None
+
+    for k in (2, 4, 8):
+        learned = _partition(plan, F, k)
+        # every candidate still lands exactly once
+        assert sorted(ci for s in learned for ci in s.cis) == list(range(28))
+        assert true_makespan(learned) <= true_makespan(analytic[k]) * 1.0001
+    # at 4 shards the recalibrated costs strictly beat the hand constants
+    assert (true_makespan(_partition(plan, F, 4))
+            < 0.99 * true_makespan(analytic[4]))
+    assert _fallbacks() == []
+
+
+# ---------------------------------------------------------------------------
+# Stream autotune: proposal only fills knobs the user left unset
+# ---------------------------------------------------------------------------
+def _stream_artifact(tmp_path):
+    m = CostModel().fit(
+        synthetic_samples(16),
+        stream_samples=[{"chunk_rows": 4096, "buffers": 3, "rows": 1e6,
+                         "wall_s": 2.0, "handoff_bytes": 1000.0}])
+    path = str(tmp_path / "cm.json")
+    m.save(path)
+    return path
+
+
+def test_stream_knob_parity_when_unset():
+    assert stream.chunk_rows() == 262_144
+    assert stream.stream_buffers() == 2
+    assert stream.handoff_budget_bytes() == 2_147_483_648
+
+
+def test_stream_autotune_applies_and_is_recorded(monkeypatch, tmp_path):
+    path = _stream_artifact(tmp_path)
+    monkeypatch.setenv("TMOG_COSTMODEL", "1")
+    monkeypatch.setenv("TMOG_COSTMODEL_PATH", path)
+    costmodel.invalidate_cache()
+    stream.reset_stream_stats()
+    assert stream.chunk_rows() == 4096
+    assert stream.stream_buffers() == 3
+    # 2x headroom over the biggest observed handoff
+    assert stream.handoff_budget_bytes() == 2000
+    auto = stream.stream_stats()["autotune"]
+    assert auto["chunk_rows"] == 4096 and auto["buffers"] == 3
+
+
+def test_stream_user_knob_wins_over_proposal(monkeypatch, tmp_path):
+    path = _stream_artifact(tmp_path)
+    monkeypatch.setenv("TMOG_COSTMODEL", "1")
+    monkeypatch.setenv("TMOG_COSTMODEL_PATH", path)
+    costmodel.invalidate_cache()
+    monkeypatch.setenv("TMOG_TRANSFORM_CHUNK_ROWS", "123")
+    assert stream.chunk_rows() == 123
+    # empty string counts as UNSET (CI matrix slots) -> proposal applies
+    monkeypatch.setenv("TMOG_TRANSFORM_CHUNK_ROWS", "")
+    assert stream.chunk_rows() == 4096
+    monkeypatch.setenv("TMOG_STREAM_BUFFERS", "5")
+    assert stream.stream_buffers() == 5
+
+
+def test_stream_knobs_ignore_model_when_disabled(monkeypatch, tmp_path):
+    path = _stream_artifact(tmp_path)
+    # artifact exists but TMOG_COSTMODEL is unset -> hard defaults
+    monkeypatch.setenv("TMOG_COSTMODEL_PATH", path)
+    costmodel.invalidate_cache()
+    assert stream.chunk_rows() == 262_144
+    assert stream.stream_buffers() == 2
+
+
+# ---------------------------------------------------------------------------
+# Live telemetry: partitioned launches stamp self-describing feat dicts
+# ---------------------------------------------------------------------------
+def test_partitioned_launch_records_feat():
+    rng = np.random.default_rng(3)
+    n, d, F = 120, 6, 2
+    X = np.ascontiguousarray(rng.normal(size=(n, d)).astype(np.float32))
+    y = (X[:, 0] > 0).astype(np.float32)
+    ev = OpBinaryClassificationEvaluator()
+    cv = OpCrossValidation(ev, num_folds=F, seed=1, mesh=None)
+    train_w, val_mask = cv.make_folds(n, None)
+    plan = build_sweep_plan(
+        [(OpLogisticRegression(max_iter=20),
+          [{"reg_param": 0.01, "elastic_net_param": 0.1},
+           {"reg_param": 0.1, "elastic_net_param": 0.5}])],
+        X, y, train_w, ev)
+    devs = jax.devices()
+    assert len(devs) >= 2
+    sweep_ops.reset_run_stats()
+    plan.run_sharded(train_w, val_mask, devs[:2])
+    launch = sweep_ops.run_stats()["launches"][-1]
+    assert len(launch["per_shard"]) == 2
+    for s in launch["per_shard"]:
+        feat = s["feat"]
+        assert feat["log_units"] > 0
+        assert feat["cand_linear"] == 1.0
+        assert feat["n_folds"] == 2.0
+        assert feat["log_rows"] == pytest.approx(np.log1p(120))

@@ -29,6 +29,11 @@ args.add_argument("--data-shards", type=int, default=0,
                   help="row-shard the default sweep over a (D x max(shards,1)) "
                        "mesh and print per-axis collective bytes + "
                        "replicated-vs-rowsharded peak per-device bytes")
+args.add_argument("--costmodel", action="store_true",
+                  help="predict-before-compile: load the trained cost model "
+                       "(TMOG_COSTMODEL_PATH) and print predicted per-shard "
+                       "wall BEFORE compiling, then predicted-vs-measured "
+                       "error (MAPE, makespan ratio) after the run")
 args = args.parse_args()
 
 platform, fb = init_backend()
@@ -79,8 +84,30 @@ def _print_gbt_telemetry(sweep_ops) -> None:
               "(TMOG_HIST_SUBTRACT=0 disables)")
 
 
-def profile_shards(n_shards: int, reps: int = 3) -> None:
-    """Predicted vs measured per-shard cost of the default 28-candidate grid."""
+def _load_costmodel():
+    """The trained artifact at TMOG_COSTMODEL_PATH, or None (with a note)."""
+    from transmogrifai_tpu import costmodel as cm
+    from transmogrifai_tpu.costmodel.model import CostModel
+
+    path = cm.model_path()
+    try:
+        model = CostModel.load(path)
+    except Exception as e:
+        print(f"costmodel: cannot load {path} ({e}); train one with "
+              "`python -m transmogrifai_tpu.costmodel`")
+        return None
+    print(f"costmodel: {path} (n_samples={model.n_samples}, "
+          f"t0={model.t0:.3e})")
+    return model
+
+
+def profile_shards(n_shards: int, reps: int = 3,
+                   use_costmodel: bool = False):
+    """Predicted vs measured per-shard cost of the default 28-candidate grid.
+
+    Returns the predicted-vs-measured eval dict (MAPE, makespan ratios)
+    when ``--costmodel`` supplied a trained model, else None — appended to
+    the run's JSONL record either way."""
     import jax
 
     from transmogrifai_tpu.impl.sweep_fragments import build_sweep_plan
@@ -98,7 +125,7 @@ def profile_shards(n_shards: int, reps: int = 3) -> None:
                             train_w, ev)
     if plan is None:
         print("default grid did not build a fused plan; nothing to profile")
-        return
+        return None
     from transmogrifai_tpu.ops import sweep as sweep_ops
     from transmogrifai_tpu.utils import flops
     flops.enable()
@@ -108,6 +135,26 @@ def profile_shards(n_shards: int, reps: int = 3) -> None:
                             plan.n_features, F)
     mx, mean = predicted_balance(shards)
     print(f"shards={len(shards)} predicted max/mean={mx / max(mean, 1e-9):.3f}")
+    model = _load_costmodel() if use_costmodel else None
+    model_preds = []
+    if model is not None:
+        # predict-before-compile: the learned wall estimate exists BEFORE
+        # any XLA lowering — this is what a scheduler could use to skip or
+        # re-balance a pathological partition up front
+        from transmogrifai_tpu.costmodel.features import shard_feature_dict
+        devs = jax.devices()
+        ctx = {"device_count": float(len(devs)),
+               "is_tpu": 1.0 if devs[0].platform == "tpu" else 0.0}
+        for i, sh in enumerate(shards):
+            feat = shard_feature_dict(sh.spec, plan.n_rows, plan.n_features,
+                                      F)
+            feat.update(ctx)
+            model_preds.append(model.predict(feat))
+        print("predict-before-compile (learned):")
+        for i, p in enumerate(model_preds):
+            print(f"  shard {i}: wall~{p['wall_s']:.4f}s "
+                  f"compile~{p['compile_s']:.2f}s "
+                  f"calib~{p['calib_wall_s']:.4f}s")
     tw = np.asarray(train_w, np.float32)
     vw = np.asarray(val_mask, np.float32)
     walls = []
@@ -129,8 +176,25 @@ def profile_shards(n_shards: int, reps: int = 3) -> None:
               f"{sh.cost / max(mean, 1e-9):9.3f} {w:10.4f} "
               f"{w / max(wmean, 1e-9):9.3f}")
     print(f"measured max/mean={max(walls) / max(wmean, 1e-9):.3f}")
+    cm_eval = None
+    if model_preds:
+        pred = np.array([p["wall_s"] for p in model_preds])
+        meas = np.array(walls)
+        cm_eval = {
+            "mape": round(float(np.mean(np.abs(pred - meas)
+                                        / np.maximum(meas, 1e-9))), 4),
+            "measured_makespan_ratio": round(
+                float(meas.max() / max(meas.mean(), 1e-9)), 4),
+            "predicted_makespan_ratio": round(
+                float(pred.max() / max(pred.mean(), 1e-9)), 4),
+            "shards": len(walls),
+        }
+        print(f"costmodel: MAPE={cm_eval['mape']:.3f} makespan ratio "
+              f"predicted={cm_eval['predicted_makespan_ratio']:.3f} "
+              f"measured={cm_eval['measured_makespan_ratio']:.3f}")
     _print_gbt_telemetry(sweep_ops)
     flops.disable()
+    return cm_eval
 
 
 def profile_rowsharded(n_data: int, n_model: int, reps: int = 3) -> None:
@@ -206,12 +270,25 @@ from transmogrifai_tpu import obs  # noqa: E402
 
 if args.data_shards > 0:
     profile_rowsharded(args.data_shards, max(args.shards, 1))
-    obs.write_record("profile_sweep", extra={"mode": "rowsharded"})
+    extra = {"mode": "rowsharded"}
+    try:
+        from transmogrifai_tpu import costmodel
+        from transmogrifai_tpu.ops import sweep as sweep_ops
+
+        cm_eval = costmodel.eval_launches(sweep_ops.run_stats()["launches"])
+        if cm_eval:
+            extra["costmodel_eval"] = cm_eval
+    except Exception:
+        pass
+    obs.write_record("profile_sweep", extra=extra)
     sys.exit(0)
 
 if args.shards > 0:
-    profile_shards(args.shards)
-    obs.write_record("profile_sweep", extra={"mode": "shards"})
+    cm_eval = profile_shards(args.shards, use_costmodel=args.costmodel)
+    extra = {"mode": "shards"}
+    if cm_eval:
+        extra["costmodel_eval"] = cm_eval
+    obs.write_record("profile_sweep", extra=extra)
     sys.exit(0)
 
 rf = D.random_forest_grid()

@@ -1,0 +1,176 @@
+"""Learned TPU cost model — telemetry-trained performance prediction.
+
+The sweep partitioner balances shards with hand-calibrated ``spec_units``
+constants (impl/sweep_fragments.py) and the streaming pipeline picks chunk
+and buffer sizes by raw env knob.  PR 6's ``obs/`` layer records the
+training data for free: per-shard wall + compile seconds, the fragment
+shape of every shard, stream chunk throughput, and the mesh/platform
+context, as schema-versioned JSONL rows.  Following "A Learned Performance
+Model for TPUs" (arXiv:2008.01040) and TpuGraphs (arXiv:2308.13490), this
+package closes the loop:
+
+- :mod:`features` — ONE feature-extraction point turning telemetry rows
+  into fixed feature vectors (tolerant of missing fields and
+  schema-version drift).
+- :mod:`model` — a small numpy-only regressor: log-space ridge on the
+  handcrafted fragment features (wall + compile heads) plus per-family
+  calibration scales regularized toward the analytic ``spec_units`` prior;
+  ``fit`` / ``predict`` / ``save`` / ``load`` with a versioned JSON
+  artifact at ``TMOG_COSTMODEL_PATH``.
+- consumers — ``parallel/spec_partition`` (learned LPT costs when
+  ``TMOG_COSTMODEL=1``, bit-identical ``spec_units`` fallback when not),
+  ``workflow/stream`` (autotuned chunk/buffer/handoff proposals, applied
+  only for knobs the user left unset), ``tools/profile_sweep.py
+  --costmodel`` (predict-before-compile), and ``bench.py`` (per-shard
+  predicted-vs-measured eval appended to every run record).
+
+Activation contract: everything here is OFF unless ``TMOG_COSTMODEL=1``
+AND a loadable artifact exists; any failure records a ``costmodel``
+fallback in ``obs`` and degrades to the analytic path.  Train via
+``python -m transmogrifai_tpu.costmodel``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+from ..utils.env import env_flag, env_str
+
+__all__ = [
+    "enabled", "model_path", "active_model", "invalidate_cache",
+    "eval_launches",
+]
+
+DEFAULT_ARTIFACT = "costmodel.json"
+
+
+def enabled() -> bool:
+    """``TMOG_COSTMODEL=1`` opts the learned model in (default off)."""
+    return env_flag("TMOG_COSTMODEL", False)
+
+
+def model_path() -> str:
+    """Artifact location: ``TMOG_COSTMODEL_PATH`` > ``costmodel.json``."""
+    return env_str("TMOG_COSTMODEL_PATH", DEFAULT_ARTIFACT)
+
+
+#: (path, mtime_ns) -> CostModel | None — one stat() per lookup, one load
+#: per artifact version; a rewritten artifact is picked up automatically.
+_cache: Dict[str, Any] = {}
+
+
+def invalidate_cache() -> None:
+    _cache.clear()
+
+
+def active_model():
+    """The loaded model when the learned path is opted in, else None.
+
+    Never raises: a missing/corrupt artifact records one ``costmodel``
+    fallback (per artifact version) and returns None so every consumer
+    falls back to the analytic constants bit-identically.
+    """
+    if not enabled():
+        return None
+    path = model_path()
+    try:
+        mtime = os.stat(path).st_mtime_ns
+    except OSError:
+        key = (path, None)
+        if key not in _cache:
+            _cache[key] = None
+            _record_fallback("artifact_missing", path=path)
+        return None
+    key = (path, mtime)
+    if key in _cache:
+        return _cache[key]
+    try:
+        from .model import CostModel
+
+        m = CostModel.load(path)
+    except Exception as e:
+        m = None
+        _record_fallback("artifact_load_failed", path=path, error=repr(e))
+    _cache.clear()
+    _cache[key] = m
+    return m
+
+
+def _record_fallback(reason: str, **detail: Any) -> None:
+    try:
+        from ..obs import registry as obs_registry
+
+        obs_registry.record_fallback("costmodel", reason, **detail)
+    except Exception:
+        pass
+
+
+def eval_launches(launches: List[Dict[str, Any]],
+                  model=None) -> Optional[Dict[str, Any]]:
+    """Predicted-vs-measured per-shard cost error over sweep launches.
+
+    ``launches`` is ``ops.sweep.run_stats()["launches"]``.  For every
+    multi-shard launch the analytic ``predicted_cost`` (spec_units) is
+    scaled to seconds by the launch's own total (relative cost is what LPT
+    consumes) and compared to the steady per-shard wall (wall − compile).
+    Returns None when no launch has comparable shards; otherwise a dict
+    with ``mape``, ``measured_makespan_ratio`` (max/mean steady wall),
+    ``predicted_makespan_ratio`` and, when ``model`` (or the active model)
+    can predict from recorded ``feat`` dicts, ``model_mape``.  Appended to
+    the bench / profile_sweep JSONL records so every run grows the eval
+    set.
+    """
+    import numpy as np
+
+    if model is None:
+        model = active_model()
+    preds: List[float] = []
+    steadies: List[float] = []
+    model_preds: List[float] = []
+    model_steadies: List[float] = []
+    n_launches = 0
+    for launch in launches or []:
+        per_shard = launch.get("per_shard") or []
+        if len(per_shard) < 2:
+            continue
+        walls = [s.get("wall_s") for s in per_shard]
+        costs = [s.get("predicted_cost") for s in per_shard]
+        if any(w is None or c is None for w, c in zip(walls, costs)):
+            continue
+        steady = [max(float(w) - float(s.get("compile_s") or 0.0), 1e-4)
+                  for w, s in zip(walls, per_shard)]
+        total_c = sum(float(c) for c in costs)
+        if total_c <= 0:
+            continue
+        scale = sum(steady) / total_c
+        n_launches += 1
+        preds.extend(float(c) * scale for c in costs)
+        steadies.extend(steady)
+        if model is not None:
+            for s, st in zip(per_shard, steady):
+                feat = s.get("feat")
+                if isinstance(feat, dict):
+                    try:
+                        p = float(model.predict(feat)["wall_s"])
+                    except Exception:
+                        continue
+                    if np.isfinite(p) and p > 0:
+                        model_preds.append(p)
+                        model_steadies.append(st)
+    if not steadies:
+        return None
+    p = np.asarray(preds)
+    m = np.asarray(steadies)
+    out = {
+        "launches": n_launches,
+        "shards": len(steadies),
+        "mape": round(float(np.mean(np.abs(p - m) / m)), 4),
+        "measured_makespan_ratio": round(float(m.max() / m.mean()), 4),
+        "predicted_makespan_ratio": round(float(p.max() / p.mean()), 4),
+    }
+    if model_preds:
+        mp = np.asarray(model_preds)
+        ms = np.asarray(model_steadies)
+        out["model_mape"] = round(float(np.mean(np.abs(mp - ms) / ms)), 4)
+        out["model_shards"] = len(model_preds)
+    return out

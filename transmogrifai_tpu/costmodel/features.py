@@ -1,0 +1,277 @@
+"""The one feature-extraction point for the learned cost model.
+
+Two producers meet here:
+
+- LIVE: ``ops/sweep`` stamps every per-shard launch telemetry entry with
+  ``shard_feature_dict(spec, ...)`` — the shard's static fragment shape as
+  a flat dict — so the JSONL rows ``obs/record.py`` writes are
+  self-describing training rows (no spec reconstruction needed offline).
+- OFFLINE: ``shard_samples`` / ``stream_samples`` walk recorded JSONL rows
+  back into (feature dict, measured seconds) training samples, and
+  ``feature_vector`` turns a feature dict into the fixed-order vector the
+  regressor consumes.
+
+Robustness contract (tested): missing fields become 0.0, NaN/inf values
+become 0.0, unknown extra fields are ignored, and a row with a bumped
+``schema_version`` still extracts — the extractor reads only what it
+recognizes and never hard-asserts the schema.
+"""
+from __future__ import annotations
+
+import json
+import math
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+__all__ = [
+    "FEATURE_NAMES", "FAMILIES", "unit_family", "shard_feature_dict",
+    "feature_vector", "family_units", "iter_records", "shard_samples",
+    "stream_samples", "synthetic_samples",
+]
+
+#: fragment-kind -> cost family (the calibration granularity; the three
+#: linear solvers share one seconds-per-unit scale)
+FAMILIES = ("linear", "mlp", "forest", "gbt")
+_KIND_FAMILY = {"fista": "linear", "newton": "linear", "svc": "linear",
+                "mlp": "mlp", "forest": "forest", "gbt": "gbt"}
+
+#: fixed feature order — the regressor's input contract.  Append-only:
+#: vectors from old artifacts stay aligned by name, never by position.
+FEATURE_NAMES = (
+    "log_units",            # log1p of total analytic spec_units (the prior)
+    "log_units_linear", "log_units_mlp", "log_units_forest", "log_units_gbt",
+    "n_candidates", "cand_linear", "cand_mlp", "cand_forest", "cand_gbt",
+    "log_rows", "log_features", "n_folds",
+    "log_gbt_chain_levels",  # sequential boosting chain after round-collapse
+    "depth_max", "log_bins_max",
+    "data_shards", "log_rows_local",
+    "device_count", "is_tpu",
+)
+
+
+def unit_family(kind: str) -> str:
+    """Cost family of a ``SweepUnit.kind`` (unknown kinds -> "linear")."""
+    return _KIND_FAMILY.get(kind, "linear")
+
+
+def _finite(v: Any, default: float = 0.0) -> float:
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return default
+    return f if math.isfinite(f) else default
+
+
+def shard_feature_dict(spec, n_rows: int, n_features: int, n_folds: int,
+                       data_shards: int = 1,
+                       rows_local: Optional[int] = None) -> Dict[str, float]:
+    """Static fragment-shape features of one shard's sub-spec.
+
+    Computed at launch time by ``ops/sweep`` (stamped into the per-shard
+    telemetry entry) and at predict time by ``tools/profile_sweep.py``.
+    ``device_count`` / ``is_tpu`` are runtime context merged in later (by
+    ``shard_samples`` from the recorded row, or by the live caller).
+    """
+    from ..impl.sweep_fragments import spec_units
+
+    units = spec_units(spec, int(n_rows), int(n_features), int(n_folds))
+    fam_units = {f: 0.0 for f in FAMILIES}
+    fam_cands = {f: 0 for f in FAMILIES}
+    for u in units:
+        fam = unit_family(getattr(u, "kind", ""))
+        fam_units[fam] += u.cost
+        fam_cands[fam] += len(u.cis)
+    depth_max = 0
+    bins_max = 0
+    chain_levels = 0
+    for frag in spec[1]:
+        if frag[0] == "forest":
+            for g in frag[2]:
+                depth_max = max(depth_max, int(g[1]))
+                bins_max = max(bins_max, int(g[4]))
+        elif frag[0] == "gbt":
+            for g in frag[3]:
+                depth_max = max(depth_max, int(g[2]))
+                bins_max = max(bins_max, int(g[4]))
+                k = max(int(g[11]), 1)
+                steps = -(-int(g[1]) // k)
+                chain_levels = max(chain_levels, steps * int(g[2]))
+    total = sum(fam_units.values())
+    rl = int(rows_local) if rows_local else int(n_rows)
+    feat: Dict[str, float] = {
+        "log_units": math.log1p(total),
+        "n_candidates": float(sum(fam_cands.values())),
+        "log_rows": math.log1p(max(int(n_rows), 0)),
+        "log_features": math.log1p(max(int(n_features), 0)),
+        "n_folds": float(n_folds),
+        "log_gbt_chain_levels": math.log1p(chain_levels),
+        "depth_max": float(depth_max),
+        "log_bins_max": math.log1p(bins_max),
+        "data_shards": float(max(int(data_shards), 1)),
+        "log_rows_local": math.log1p(max(rl, 0)),
+    }
+    for f in FAMILIES:
+        feat[f"log_units_{f}"] = math.log1p(fam_units[f])
+        feat[f"cand_{f}"] = float(fam_cands[f])
+    return feat
+
+
+def feature_vector(feat: Dict[str, Any]) -> np.ndarray:
+    """Fixed-order float64 vector; missing / non-numeric / non-finite
+    entries degrade to 0.0 (never raises on a malformed dict)."""
+    if not isinstance(feat, dict):
+        feat = {}
+    return np.array([_finite(feat.get(name)) for name in FEATURE_NAMES],
+                    dtype=np.float64)
+
+
+def family_units(feat: Dict[str, Any]) -> Dict[str, float]:
+    """Raw (de-logged) analytic units per family — the calibration basis."""
+    return {f: max(math.expm1(_finite(feat.get(f"log_units_{f}"))), 0.0)
+            for f in FAMILIES}
+
+
+# ---------------------------------------------------------------------------
+# Offline extraction from obs/record.py JSONL rows
+# ---------------------------------------------------------------------------
+def iter_records(path: Optional[str] = None) -> Iterator[Dict[str, Any]]:
+    """Parsed telemetry rows from a JSONL file (TMOG_TELEMETRY default);
+    unreadable files yield nothing, malformed lines are skipped."""
+    from ..obs.record import telemetry_path
+
+    p = telemetry_path(path)
+    try:
+        fh = open(p, "r")
+    except OSError:
+        return
+    with fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(row, dict):
+                yield row
+
+
+def _row_context(row: Dict[str, Any]) -> Dict[str, float]:
+    ctx = row.get("context")
+    if not isinstance(ctx, dict):
+        ctx = {}
+    return {
+        "device_count": _finite(ctx.get("device_count"), 1.0) or 1.0,
+        "is_tpu": 1.0 if ctx.get("platform") == "tpu" else 0.0,
+    }
+
+
+def shard_samples(rows) -> List[Dict[str, Any]]:
+    """Training samples from recorded sweep launches: one per per-shard
+    entry that carries a ``feat`` dict and a positive wall time.
+
+    Sample shape: ``{"feat": {...}, "wall_s", "compile_s", "steady_s"}``
+    where ``steady_s`` is wall minus first-launch compile (floored at
+    0.1 ms) — the quantity LPT balance actually cares about.
+    """
+    out: List[Dict[str, Any]] = []
+    for row in rows:
+        if not isinstance(row, dict):
+            continue
+        ctx = _row_context(row)
+        snap = row.get("snapshot")
+        if not isinstance(snap, dict):
+            continue
+        sweep = snap.get("sweep")
+        if not isinstance(sweep, dict):
+            continue
+        for launch in sweep.get("launches") or []:
+            if not isinstance(launch, dict):
+                continue
+            for s in launch.get("per_shard") or []:
+                if not isinstance(s, dict):
+                    continue
+                feat = s.get("feat")
+                wall = _finite(s.get("wall_s"))
+                if not isinstance(feat, dict) or wall <= 0:
+                    continue
+                compile_s = max(_finite(s.get("compile_s")), 0.0)
+                merged = dict(feat)
+                for k, v in ctx.items():
+                    merged.setdefault(k, v)
+                out.append({
+                    "feat": merged,
+                    "wall_s": wall,
+                    "compile_s": compile_s,
+                    "steady_s": max(wall - compile_s, 1e-4),
+                })
+    return out
+
+
+def stream_samples(rows) -> List[Dict[str, Any]]:
+    """(chunk_rows, buffers) -> observed streaming throughput samples from
+    recorded ``stream`` snapshots (the autotune proposal's evidence)."""
+    out: List[Dict[str, Any]] = []
+    for row in rows:
+        if not isinstance(row, dict):
+            continue
+        snap = row.get("snapshot")
+        if not isinstance(snap, dict):
+            continue
+        st = snap.get("stream")
+        if not isinstance(st, dict):
+            continue
+        n_rows = _finite(st.get("rows"))
+        wall = _finite(st.get("wall_s"))
+        ck = _finite(st.get("chunk_rows"))
+        if n_rows <= 0 or wall <= 0 or ck <= 0:
+            continue
+        out.append({
+            "chunk_rows": int(ck),
+            "buffers": int(_finite(st.get("buffers"), 2.0) or 2.0),
+            "rows": n_rows,
+            "wall_s": wall,
+            "rows_per_sec": n_rows / wall,
+            "handoff_bytes": max(_finite(st.get("handoff_bytes")), 0.0),
+        })
+    return out
+
+
+def synthetic_samples(n: int, seed: int = 0) -> List[Dict[str, Any]]:
+    """Plausible shard samples for smoke-training when a telemetry file has
+    too few real rows (CI's fallback; also the unit-test fixture).  Walls
+    follow a hidden per-family seconds-per-unit ground truth plus mild
+    lognormal noise, so a correct fit recovers the family scales."""
+    rng = np.random.default_rng(seed)
+    true_scale = {"linear": 2e-8, "mlp": 3e-8, "forest": 1e-8, "gbt": 6e-8}
+    out: List[Dict[str, Any]] = []
+    for _ in range(int(n)):
+        fam_cands = {f: int(rng.integers(0, 9)) for f in FAMILIES}
+        if sum(fam_cands.values()) == 0:
+            fam_cands["forest"] = 1
+        per_cand = {"linear": 4e5, "mlp": 2e6, "forest": 6e8, "gbt": 1e8}
+        fam_units = {f: fam_cands[f] * per_cand[f] *
+                     float(rng.uniform(0.5, 2.0)) for f in FAMILIES}
+        depth = int(rng.integers(3, 13))
+        wall = sum(true_scale[f] * fam_units[f] for f in FAMILIES)
+        wall *= float(rng.lognormal(0.0, 0.05))
+        feat = {
+            "log_units": math.log1p(sum(fam_units.values())),
+            "n_candidates": float(sum(fam_cands.values())),
+            "log_rows": math.log1p(891), "log_features": math.log1p(20),
+            "n_folds": 3.0,
+            "log_gbt_chain_levels": math.log1p(
+                500 if fam_cands["gbt"] else 0),
+            "depth_max": float(depth), "log_bins_max": math.log1p(256),
+            "data_shards": 1.0, "log_rows_local": math.log1p(891),
+            "device_count": 8.0, "is_tpu": 0.0,
+        }
+        for f in FAMILIES:
+            feat[f"log_units_{f}"] = math.log1p(fam_units[f])
+            feat[f"cand_{f}"] = float(fam_cands[f])
+        compile_s = 0.5 + 2e-10 * sum(fam_units.values())
+        out.append({"feat": feat, "wall_s": wall + compile_s,
+                    "compile_s": compile_s, "steady_s": max(wall, 1e-4)})
+    return out

@@ -1,0 +1,282 @@
+"""Numpy-only learned cost regressor with the analytic prior built in.
+
+Two heads, one artifact:
+
+- **Ridge head** — log-space ridge regression on the handcrafted fragment
+  features (:data:`features.FEATURE_NAMES`): standardized inputs, closed
+  form solve, one weight vector each for steady wall seconds and compile
+  seconds.  Log space because shard costs span ~4 decades (a FISTA shard
+  vs a depth-12 forest shard) and relative error is what LPT balance and
+  predict-before-compile care about.
+- **Calibration head** — per-family seconds-per-``spec_units`` scales
+  ``s_f`` solved from ``steady ≈ Σ_f s_f · units_f`` with ridge
+  regularization **toward the analytic prior** (every ``s_f`` shrinks to
+  the global seconds-per-unit ``t0``, i.e. toward "the hand constants are
+  already right in proportion").  This is the head the partitioner
+  consumes: ``unit_scale(kind)`` reweights each ``SweepUnit.per_cand``
+  across families while telemetry-free families keep the prior exactly.
+
+The JSON artifact (``schema tmog.costmodel`` v1) round-trips exactly:
+Python's ``json`` serializes float64 via shortest-repr, so
+``load(save(m))`` reproduces bit-identical parameters and predictions
+(tested).  No third-party deps beyond numpy.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import tempfile
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .features import FAMILIES, FEATURE_NAMES, family_units, unit_family
+
+__all__ = ["ARTIFACT_SCHEMA", "ARTIFACT_VERSION", "CostModel"]
+
+ARTIFACT_SCHEMA = "tmog.costmodel"
+ARTIFACT_VERSION = 1
+
+#: floor for log targets and predicted seconds (0.1 ms)
+_EPS_S = 1e-4
+
+
+def _ridge_fit(Z: np.ndarray, y: np.ndarray, lam: float):
+    """Closed-form ridge with intercept on standardized inputs."""
+    y_mean = float(y.mean())
+    yc = y - y_mean
+    A = Z.T @ Z + lam * np.eye(Z.shape[1])
+    w = np.linalg.solve(A, Z.T @ yc)
+    return w, y_mean
+
+
+class CostModel:
+    """fit / predict / save / load — see module docstring."""
+
+    def __init__(self) -> None:
+        self.feature_names: Sequence[str] = tuple(FEATURE_NAMES)
+        self.mu: Optional[np.ndarray] = None
+        self.sigma: Optional[np.ndarray] = None
+        self.w_wall: Optional[np.ndarray] = None
+        self.b_wall: float = 0.0
+        self.w_compile: Optional[np.ndarray] = None
+        self.b_compile: float = 0.0
+        self.t0: float = 1e-9
+        self.family_scale: Dict[str, float] = {}
+        self.stream: Dict[str, Any] = {}
+        self.n_samples: int = 0
+
+    @property
+    def fitted(self) -> bool:
+        return self.w_wall is not None
+
+    # -- features -----------------------------------------------------------
+    def _vec(self, feat: Dict[str, Any]) -> np.ndarray:
+        """Vectorize by THIS model's stored feature order (artifacts from
+        older builds stay aligned by name when FEATURE_NAMES grows)."""
+        def fin(v):
+            try:
+                f = float(v)
+            except (TypeError, ValueError):
+                return 0.0
+            return f if math.isfinite(f) else 0.0
+
+        feat = feat if isinstance(feat, dict) else {}
+        return np.array([fin(feat.get(n)) for n in self.feature_names],
+                        dtype=np.float64)
+
+    # -- training -----------------------------------------------------------
+    def fit(self, samples: List[Dict[str, Any]],
+            stream_samples: Optional[List[Dict[str, Any]]] = None,
+            ridge: float = 1.0, calib_shrink: float = 1e-3) -> "CostModel":
+        """Train both heads from ``features.shard_samples``-shaped dicts.
+
+        ``ridge`` is the absolute L2 penalty of the log-space head;
+        ``calib_shrink`` sets the calibration head's anchor strength toward
+        the analytic prior, as a fraction of the strongest family's data
+        term (unit-free).  Raises ValueError on an empty sample list.
+        """
+        if not samples:
+            raise ValueError("cannot fit a cost model on zero samples")
+        X = np.stack([self._vec(s.get("feat")) for s in samples])
+        steady = np.array([max(float(s.get("steady_s") or
+                                     s.get("wall_s") or 0.0), _EPS_S)
+                           for s in samples])
+        self.n_samples = len(samples)
+        self.mu = X.mean(axis=0)
+        self.sigma = X.std(axis=0)
+        self.sigma[self.sigma == 0.0] = 1.0
+        Z = (X - self.mu) / self.sigma
+        self.w_wall, self.b_wall = _ridge_fit(Z, np.log(steady), ridge)
+
+        comp_rows = [i for i, s in enumerate(samples)
+                     if float(s.get("compile_s") or 0.0) > 0.0]
+        if comp_rows:
+            yc = np.log(np.array([max(float(samples[i]["compile_s"]), _EPS_S)
+                                  for i in comp_rows]))
+            self.w_compile, self.b_compile = _ridge_fit(Z[comp_rows], yc,
+                                                        ridge)
+        else:
+            self.w_compile, self.b_compile = None, 0.0
+
+        # calibration head: steady ≈ Σ_f s_f · units_f, solved in RATIO
+        # space r_f = s_f / t0 (prior r = 1: "the analytic constants are
+        # right in proportion") by prior-anchored nonnegative coordinate
+        # descent.  Why not one joint least-squares solve: family unit
+        # magnitudes span ~3 decades, so the normal equations' cross terms
+        # drown the small families' diagonals and the joint solution for a
+        # weakly-observed family is garbage (negative, or pinned at a
+        # clamp).  With a shared ABSOLUTE anchor weight, a family whose
+        # data term is weak stays at the prior and a well-observed family's
+        # data wins — exactly the calibration semantics the partitioner
+        # wants.
+        U = np.stack([[family_units(s.get("feat") or {})[f]
+                       for f in FAMILIES] for s in samples])
+        tot = U.sum()
+        self.t0 = float(steady.sum() / tot) if tot > 0 else 1e-9
+        V = U * self.t0                       # y ≈ V @ r, prior r = 1
+        diag = (V * V).sum(axis=0)
+        r = np.ones(len(FAMILIES))
+        if diag.max() > 0:
+            anchor = calib_shrink * float(diag.max()) + 1e-30
+            for _ in range(200):
+                for j in range(len(FAMILIES)):
+                    if diag[j] == 0.0:
+                        continue
+                    resid = steady - V @ r + V[:, j] * r[j]
+                    r[j] = max((V[:, j] @ resid + anchor)
+                               / (diag[j] + anchor), 0.0)
+        self.family_scale = {f: float(r[j] * self.t0)
+                             for j, f in enumerate(FAMILIES)}
+
+        self.stream = self._fit_stream(stream_samples or [])
+        return self
+
+    @staticmethod
+    def _fit_stream(samples: List[Dict[str, Any]]) -> Dict[str, Any]:
+        """Best observed (chunk_rows, buffers) by streaming throughput."""
+        agg: Dict[tuple, Dict[str, float]] = {}
+        max_handoff = 0.0
+        for s in samples:
+            try:
+                key = (int(s["chunk_rows"]), int(s.get("buffers") or 2))
+                rows, wall = float(s["rows"]), float(s["wall_s"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            if rows <= 0 or wall <= 0 or key[0] <= 0 or key[1] <= 0:
+                continue
+            a = agg.setdefault(key, {"rows": 0.0, "wall": 0.0})
+            a["rows"] += rows
+            a["wall"] += wall
+            max_handoff = max(max_handoff,
+                              float(s.get("handoff_bytes") or 0.0))
+        if not agg:
+            return {}
+        best = max(agg.items(), key=lambda kv: kv[1]["rows"] / kv[1]["wall"])
+        (chunk, buffers), a = best
+        out: Dict[str, Any] = {
+            "chunk_rows": int(chunk), "buffers": int(buffers),
+            "rows_per_sec": round(a["rows"] / a["wall"], 2),
+            "samples": len(samples),
+        }
+        if max_handoff > 0:
+            # budget with 2x headroom over the biggest observed handoff so
+            # every known-good handoff keeps fitting
+            out["handoff_budget_bytes"] = int(2 * max_handoff)
+        return out
+
+    # -- inference ----------------------------------------------------------
+    def predict(self, feat: Dict[str, Any]) -> Dict[str, float]:
+        """Per-shard predictions from a feature dict: ``wall_s`` (ridge
+        head), ``compile_s`` (0.0 when no compile rows were seen) and
+        ``calib_wall_s`` (the calibration head the partitioner uses)."""
+        if not self.fitted:
+            raise RuntimeError("CostModel.predict before fit/load")
+        z = (self._vec(feat) - self.mu) / self.sigma
+        wall = float(np.exp(z @ self.w_wall + self.b_wall))
+        comp = (float(np.exp(z @ self.w_compile + self.b_compile))
+                if self.w_compile is not None else 0.0)
+        calib = sum(self.family_scale.get(f, self.t0) * u
+                    for f, u in family_units(feat).items())
+        return {"wall_s": max(wall, _EPS_S), "compile_s": comp,
+                "calib_wall_s": max(float(calib), 0.0)}
+
+    def unit_scale(self, kind: str) -> float:
+        """Seconds per analytic ``spec_units`` unit for a fragment kind —
+        what the partitioner multiplies ``SweepUnit.per_cand`` by."""
+        if not self.fitted:
+            raise RuntimeError("CostModel.unit_scale before fit/load")
+        return self.family_scale.get(unit_family(kind), self.t0)
+
+    def stream_proposal(self) -> Dict[str, Any]:
+        """Autotune proposal for the streaming executor (possibly {})."""
+        return dict(self.stream)
+
+    # -- persistence --------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        if not self.fitted:
+            raise RuntimeError("CostModel.save before fit")
+        return {
+            "schema": ARTIFACT_SCHEMA,
+            "version": ARTIFACT_VERSION,
+            "feature_names": list(self.feature_names),
+            "mu": self.mu.tolist(),
+            "sigma": self.sigma.tolist(),
+            "w_wall": self.w_wall.tolist(),
+            "b_wall": self.b_wall,
+            "w_compile": (self.w_compile.tolist()
+                          if self.w_compile is not None else None),
+            "b_compile": self.b_compile,
+            "t0": self.t0,
+            "family_scale": dict(self.family_scale),
+            "stream": dict(self.stream),
+            "n_samples": self.n_samples,
+        }
+
+    def save(self, path: str) -> str:
+        """Atomic write (tmp + rename) so a concurrently-loading consumer
+        never sees a torn artifact."""
+        doc = self.to_dict()
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        fd, tmp = tempfile.mkstemp(prefix=".costmodel.", dir=d)
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f, indent=1)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return path
+
+    @classmethod
+    def from_dict(cls, doc: Dict[str, Any]) -> "CostModel":
+        if doc.get("schema") != ARTIFACT_SCHEMA:
+            raise ValueError(f"not a {ARTIFACT_SCHEMA} artifact: "
+                             f"{doc.get('schema')!r}")
+        if int(doc.get("version", 0)) > ARTIFACT_VERSION:
+            raise ValueError(f"artifact version {doc.get('version')} is "
+                             f"newer than supported {ARTIFACT_VERSION}")
+        m = cls()
+        m.feature_names = tuple(doc["feature_names"])
+        m.mu = np.asarray(doc["mu"], np.float64)
+        m.sigma = np.asarray(doc["sigma"], np.float64)
+        m.w_wall = np.asarray(doc["w_wall"], np.float64)
+        m.b_wall = float(doc["b_wall"])
+        wc = doc.get("w_compile")
+        m.w_compile = np.asarray(wc, np.float64) if wc is not None else None
+        m.b_compile = float(doc.get("b_compile") or 0.0)
+        m.t0 = float(doc.get("t0") or 1e-9)
+        m.family_scale = {str(k): float(v)
+                          for k, v in (doc.get("family_scale") or {}).items()}
+        m.stream = dict(doc.get("stream") or {})
+        m.n_samples = int(doc.get("n_samples") or 0)
+        return m
+
+    @classmethod
+    def load(cls, path: str) -> "CostModel":
+        with open(path, "r") as f:
+            return cls.from_dict(json.load(f))

@@ -195,15 +195,17 @@ class SweepUnit:
     """One divisible partition unit: a linear/MLP fragment or a single
     forest/gbt group.  ``key`` identifies it for :func:`build_subspec`;
     ``cis`` are its GLOBAL candidate positions; ``per_cand`` the predicted
-    cost of one candidate (folds included)."""
+    cost of one candidate (folds included); ``kind`` the fragment kind —
+    the learned cost model's family axis (costmodel.features.unit_family)."""
 
-    __slots__ = ("key", "cis", "per_cand")
+    __slots__ = ("key", "cis", "per_cand", "kind")
 
     def __init__(self, key: Tuple[int, Optional[int]], cis: Tuple[int, ...],
-                 per_cand: float):
+                 per_cand: float, kind: str = ""):
         self.key = key
         self.cis = tuple(cis)
         self.per_cand = float(per_cand)
+        self.kind = kind
 
     @property
     def cost(self) -> float:
@@ -268,17 +270,20 @@ def spec_units(spec, n: int, d: int, F: int) -> List[SweepUnit]:
         kind = frag[0]
         if kind in ("fista", "newton", "svc", "mlp"):
             units.append(SweepUnit((fi, None), frag[1],
-                                   _linear_unit_cost(kind, frag, n, d, F)))
+                                   _linear_unit_cost(kind, frag, n, d, F),
+                                   kind=kind))
         elif kind == "forest":
             for gi, g in enumerate(frag[2]):
                 units.append(SweepUnit(
                     (fi, gi), g[0],
-                    _forest_group_cost(g, n, d, F) / max(len(g[0]), 1)))
+                    _forest_group_cost(g, n, d, F) / max(len(g[0]), 1),
+                    kind=kind))
         elif kind == "gbt":
             for gi, g in enumerate(frag[3]):
                 units.append(SweepUnit(
                     (fi, gi), g[0],
-                    _gbt_group_cost(g, n, d, F) / max(len(g[0]), 1)))
+                    _gbt_group_cost(g, n, d, F) / max(len(g[0]), 1),
+                    kind=kind))
         else:  # pragma: no cover - grammar is closed
             raise ValueError(f"unknown sweep fragment {kind!r}")
     return units

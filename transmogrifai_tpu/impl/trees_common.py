@@ -31,10 +31,9 @@ def round_collapse_default() -> int:
     K > 1 grows K trees per boosting step against shared gradients at
     learning rate eta / K, cutting the sequential scan to rounds / K steps
     (ops/trees._gbt_impl / _gbt_batch_impl)."""
-    try:
-        return max(int(os.environ.get("TMOG_GBT_ROUND_COLLAPSE", "1") or 1), 1)
-    except ValueError:
-        return 1
+    from ..utils.env import env_int
+
+    return max(env_int("TMOG_GBT_ROUND_COLLAPSE", 1), 1)
 
 
 def effective_trees_per_round(k: int, n_rounds: int) -> int:

@@ -223,14 +223,13 @@ class OpValidator:
         launch degrades to the replicated path and records why in
         ``ops.sweep.run_stats()['fallbacks']``.
         """
-        import os
-
         from ...ops import sweep as sweep_ops
         from ...parallel.mesh import (active_mesh, data_shards,
                                       min_rows_per_shard, model_devices,
                                       model_shards, rowshard_viable)
+        from ...utils.env import env_str
 
-        if os.environ.get("TMOG_FUSED_SWEEP", "1") == "0":
+        if env_str("TMOG_FUSED_SWEEP", "1") == "0":
             return False
         n_shards = max(model_shards(), 1)
         n_data = max(data_shards(), 1)
@@ -253,7 +252,9 @@ class OpValidator:
             # [F, C_s, n] block, so k shards fit a k-times-bigger grid per
             # launch.  Row-sharded, each device further holds only
             # rows/data_shards of that block.
-            budget = float(os.environ.get("TMOG_FUSED_SCORES_BYTES", 3e8))
+            from ...utils.env import env_float
+
+            budget = env_float("TMOG_FUSED_SCORES_BYTES", 3e8)
             budget *= n_shards
             rows_local = -(-len(y) // n_data) if rowsharded else len(y)
             per_cand = train_w.shape[0] * rows_local * 4.0

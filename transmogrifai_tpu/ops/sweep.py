@@ -678,6 +678,21 @@ def _max_gbt_chain(specs) -> Optional[Dict[str, int]]:
             "levels": max(c["levels"] for c in chains)}
 
 
+def _shard_feat(spec, n, d, F, data_shards=1, rows_local=None):
+    """Static fragment-shape features of one shard's sub-spec, stamped into
+    the per-shard launch telemetry so recorded JSONL rows are
+    self-describing cost-model training rows (costmodel/features.py reads
+    them back offline).  Telemetry must never kill the launch: any failure
+    returns None and the entry simply has no ``feat``."""
+    try:
+        from ..costmodel.features import shard_feature_dict
+
+        return shard_feature_dict(spec, n, d, F, data_shards=data_shards,
+                                  rows_local=rows_local)
+    except Exception:
+        return None
+
+
 def _shard_arrays(shard, dev, X, xbs, y, X_host, y_host, xb_bins):
     """Per-device copies of the shard's static arrays.
 
@@ -724,6 +739,7 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
     """
     F = int(train_w.shape[0])
     n = int(X_host.shape[0]) if X_host is not None else int(X.shape[0])
+    d = int(X_host.shape[1]) if X_host is not None else int(X.shape[1])
     k = shards[0].spec[0][1] if isinstance(shards[0].spec[0], tuple) else 1
     t_all = time.perf_counter()
 
@@ -765,10 +781,14 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
             # block in THIS thread only: other shards keep dispatching/running
             with trace.span("sweep.gather", device=str(dev)):
                 out = np.asarray(out)
-        return out, {"device": str(dev), "candidates": C_s,
-                     "predicted_cost": float(shard.cost),
-                     "compile_s": round(compile_s, 4), "split": bool(split),
-                     "wall_s": round(time.perf_counter() - t0, 4)}, records
+        stat = {"device": str(dev), "candidates": C_s,
+                "predicted_cost": float(shard.cost),
+                "compile_s": round(compile_s, 4), "split": bool(split),
+                "wall_s": round(time.perf_counter() - t0, 4)}
+        feat = _shard_feat(shard.spec, n, d, F)
+        if feat is not None:
+            stat["feat"] = feat
+        return out, stat, records
 
     with trace.span("sweep.launch", shards=len(shards),
                     candidates=int(n_candidates)):
@@ -892,6 +912,7 @@ def run_sweep_rowsharded(shards, X, xbs: Tuple, y, train_w, val_w,
         raise ValueError(f"{len(shards)} model shards > mesh model axis "
                          f"{grid.shape[1]}")
     F = int(train_w.shape[0])
+    n_feat = int(X_host.shape[1]) if X_host is not None else int(X.shape[1])
     tw_host = np.asarray(train_w, np.float32)
     vw_host = np.asarray(val_w, np.float32)
     t_all = time.perf_counter()
@@ -931,6 +952,11 @@ def run_sweep_rowsharded(shards, X, xbs: Tuple, y, train_w, val_w,
                 "compile_s": round(compile_s, 4),
                 "rows_local": n_pad // n_data,
                 "wall_s": round(time.perf_counter() - t0, 4)}
+        feat = _shard_feat(shard.spec, n_orig, n_feat, F,
+                           data_shards=int(n_data),
+                           rows_local=n_pad // n_data)
+        if feat is not None:
+            stat["feat"] = feat
         return out, stat, ("sweep.run_rs", compiled, args, label, colls,
                            n_orig, n_pad)
 

@@ -300,7 +300,9 @@ def env_mesh() -> Optional[Mesh]:
     Returns None when the variable is unset/empty or the device pool cannot
     satisfy the request (so CI matrix entries degrade gracefully on smaller
     hosts instead of erroring)."""
-    spec = os.environ.get("TMOG_MESH", "").strip().lower()
+    from ..utils.env import env_str
+
+    spec = env_str("TMOG_MESH").lower()
     if not spec:
         return None
     try:
@@ -318,11 +320,10 @@ def env_mesh() -> Optional[Mesh]:
 
 def min_rows_per_shard() -> int:
     """Fewest rows per data shard worth the collective round-trips."""
-    try:
-        return max(int(os.environ.get("TMOG_MIN_ROWS_PER_SHARD",
-                                      DEFAULT_MIN_ROWS_PER_SHARD)), 1)
-    except ValueError:
-        return DEFAULT_MIN_ROWS_PER_SHARD
+    from ..utils.env import env_int
+
+    return max(env_int("TMOG_MIN_ROWS_PER_SHARD",
+                       DEFAULT_MIN_ROWS_PER_SHARD), 1)
 
 
 def rowshard_viable(n_rows: int, n_data: int) -> bool:

@@ -37,11 +37,13 @@ shards' work under async dispatch.
 from __future__ import annotations
 
 import heapq
+import math
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import registry as obs_registry
 from ..obs import trace
 
 
@@ -67,6 +69,62 @@ def predicted_balance(shards: List[ShardSpec]) -> Tuple[float, float]:
     return max(costs), float(np.mean(costs))
 
 
+#: explicit per-unit cost override (tests / embedding callers); wins over
+#: the env-activated learned model.  ``None`` = analytic ``spec_units``.
+_COST_PROVIDER: Optional[Callable] = None
+
+
+def set_cost_provider(fn: Optional[Callable]) -> Optional[Callable]:
+    """Install ``fn(SweepUnit) -> per-candidate cost`` (None restores the
+    analytic default); returns the previous provider."""
+    global _COST_PROVIDER
+    prev, _COST_PROVIDER = _COST_PROVIDER, fn
+    return prev
+
+
+def _resolve_cost_provider() -> Tuple[Optional[Callable], Optional[str]]:
+    """(provider, source-label).  (None, None) — the bit-identical analytic
+    path — unless a provider was set explicitly or ``TMOG_COSTMODEL=1``
+    loads an artifact; model failures record a ``costmodel`` fallback and
+    degrade to (None, None)."""
+    if _COST_PROVIDER is not None:
+        return _COST_PROVIDER, "explicit"
+    try:
+        from .. import costmodel
+
+        if not costmodel.enabled():
+            return None, None
+        m = costmodel.active_model()
+        if m is None:
+            return None, None
+        return (lambda u: u.per_cand * m.unit_scale(u.kind)), "learned"
+    except Exception as e:  # never let cost lookup break partitioning
+        obs_registry.record_fallback("costmodel", "provider_resolve_failed",
+                                     error=repr(e))
+        return None, None
+
+
+def _apply_cost_provider(units, provider: Callable, source: str) -> None:
+    """Replace every unit's ``per_cand`` with the provider's estimate;
+    non-finite/non-positive estimates (or a raising provider) leave ALL
+    analytic costs in place and record why."""
+    new_costs = []
+    for u in units:
+        try:
+            c = float(provider(u))
+        except Exception as e:
+            obs_registry.record_fallback("costmodel", "provider_raised",
+                                         source=source, error=repr(e))
+            return
+        if not (math.isfinite(c) and c > 0.0):
+            obs_registry.record_fallback("costmodel", "provider_bad_cost",
+                                         source=source, cost=repr(c))
+            return
+        new_costs.append(c)
+    for u, c in zip(units, new_costs):
+        u.per_cand = c
+
+
 def partition_spec(spec, blob: np.ndarray, n_shards: int, n_rows: int,
                    n_features: int, n_folds: int) -> List[ShardSpec]:
     """Split ``spec`` into <= ``n_shards`` cost-balanced sub-specs.
@@ -75,12 +133,21 @@ def partition_spec(spec, blob: np.ndarray, n_shards: int, n_rows: int,
     order is ascending global order (``ShardSpec.cis`` maps back).  Shards
     that would receive no candidates are dropped, so the result may be
     shorter than ``n_shards`` for tiny grids.
+
+    Costs come from the analytic ``spec_units`` constants unless a cost
+    provider resolves (``set_cost_provider`` or the ``TMOG_COSTMODEL=1``
+    learned model) — with no provider the analytic floats are never
+    touched, so the default partition is bit-identical to the pre-costmodel
+    behavior.
     """
     from ..impl.sweep_fragments import build_subspec, spec_units
 
+    provider, source = _resolve_cost_provider()
     with trace.span("sweep.partition", shards=int(n_shards),
-                    rows=int(n_rows)) as sp:
+                    rows=int(n_rows), costmodel=source or "") as sp:
         units = spec_units(spec, n_rows, n_features, n_folds)
+        if provider is not None:
+            _apply_cost_provider(units, provider, source)
         if n_shards <= 1:
             cis = tuple(sorted(ci for u in units for ci in u.cis))
             return [ShardSpec(spec, np.asarray(blob, np.float32), cis,

@@ -1,0 +1,65 @@
+"""One empty-string-tolerant parser set for every ``TMOG_*`` env knob.
+
+CI matrix entries leave unused slots as ``""`` (tier1.yml sets e.g.
+``TMOG_MESH: ${{ matrix.tmog_mesh }}``), so "unset" and "set to the empty
+string" MUST mean the same thing everywhere a knob is read.  Before this
+module each consumer re-implemented that rule (``workflow/stream._env_int``,
+``parallel/mesh.env_mesh``, ``workflow/dag._fuse_max_rows``, ...) with
+subtly different garbage handling; these helpers are the single definition.
+
+Contract shared by every helper:
+
+- the value is ``.strip()``-ed first; empty (or unset) yields ``default``,
+- unparseable values yield ``default`` instead of raising — a typo'd knob
+  degrades to the documented default rather than killing the run,
+- numeric helpers accept float syntax for int knobs (``"1e5"`` → 100000),
+  matching the historical ``int(float(v))`` idiom of the stream knobs.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["env_str", "env_int", "env_float", "env_flag", "env_set"]
+
+
+def env_str(name: str, default: str = "") -> str:
+    """Stripped string value; empty/unset → ``default``."""
+    v = os.environ.get(name, "").strip()
+    return v if v else default
+
+
+def env_int(name: str, default: int) -> int:
+    """Int knob; accepts float syntax; empty/garbage → ``default``."""
+    v = os.environ.get(name, "").strip()
+    if not v:
+        return default
+    try:
+        return int(float(v))
+    except ValueError:
+        return default
+
+
+def env_float(name: str, default: float) -> float:
+    """Float knob; empty/garbage → ``default``."""
+    v = os.environ.get(name, "").strip()
+    if not v:
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        return default
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """Boolean knob: ``0/false/off/no`` (any case) is False, anything else
+    non-empty is True, empty/unset is ``default``."""
+    v = os.environ.get(name, "").strip().lower()
+    if not v:
+        return default
+    return v not in ("0", "false", "off", "no")
+
+
+def env_set(name: str) -> bool:
+    """Whether the user actually set the knob (non-empty after strip) —
+    the autotune gate: a user-set value always wins over a proposal."""
+    return bool(os.environ.get(name, "").strip())

@@ -181,10 +181,9 @@ def _fused_layer(ds: Dataset, fusables: Sequence[Transformer]) -> Dict[str, Any]
 #: double-buffered uploads, device-resident intermediates, terminal-only
 #: pulls.  TMOG_STREAM=0 restores the pre-stream host fallback.
 def _fuse_max_rows() -> int:
-    import os
+    from ..utils.env import env_int
 
-    v = os.environ.get("TMOG_FUSE_MAX_ROWS", "").strip()
-    return int(float(v)) if v else 200_000
+    return env_int("TMOG_FUSE_MAX_ROWS", 200_000)
 
 
 def _apply_layer_transforms(ds: Dataset, transformers: Sequence[Transformer],

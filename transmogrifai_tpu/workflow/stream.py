@@ -54,26 +54,63 @@ from .. import types as T
 from ..columns import Dataset, NumericColumn, ObjectColumn, VectorColumn
 from ..obs import registry as obs_registry
 from ..obs import trace
+from ..utils import env
 
 
 # ---------------------------------------------------------------------------
-# Env knobs
+# Env knobs (utils/env empty-string-tolerant helpers) + costmodel autotune.
+#
+# Resolution order per knob: the USER'S env value always wins; when the env
+# slot is unset/empty and the learned cost model (TMOG_COSTMODEL=1) carries
+# a streaming proposal trained from recorded telemetry, the proposal
+# applies (and is recorded under stream_stats()["autotune"]); otherwise the
+# hard default — so with the model off, knob selection is bit-identical to
+# the pre-costmodel behavior.
 # ---------------------------------------------------------------------------
-def _env_int(name: str, default: int) -> int:
-    """Int env knob; empty string (e.g. an unset CI matrix slot) = default."""
-    v = os.environ.get(name, "").strip()
-    return int(float(v)) if v else default
+def _autotune_proposal() -> Dict[str, Any]:
+    """The active model's streaming proposal ({} when the model is off,
+    unloadable, or has no stream evidence).  Never raises."""
+    try:
+        from .. import costmodel
+
+        m = costmodel.active_model()
+        if m is None:
+            return {}
+        prop = m.stream_proposal()
+        if prop:
+            _stream_scope.set("autotune", dict(prop))
+        return prop
+    except Exception:
+        return {}
+
+
+def _knob(name: str, default: int, proposal_key: str,
+          floor: Optional[int] = 1) -> int:
+    def clamp(v: int) -> int:
+        return v if floor is None else max(floor, v)
+
+    if env.env_set(name):
+        return clamp(env.env_int(name, default))
+    prop = _autotune_proposal().get(proposal_key)
+    if prop:
+        try:
+            return clamp(int(prop))
+        except (TypeError, ValueError):
+            pass
+    return default
 
 
 def chunk_rows() -> int:
-    """Rows per streamed chunk (TMOG_TRANSFORM_CHUNK_ROWS, default 256Ki)."""
-    return max(1, _env_int("TMOG_TRANSFORM_CHUNK_ROWS", 262_144))
+    """Rows per streamed chunk (TMOG_TRANSFORM_CHUNK_ROWS, default 256Ki;
+    autotuned from telemetry when unset and TMOG_COSTMODEL=1)."""
+    return _knob("TMOG_TRANSFORM_CHUNK_ROWS", 262_144, "chunk_rows")
 
 
 def stream_buffers() -> int:
     """In-flight chunk window (TMOG_STREAM_BUFFERS, default 2 = double
-    buffering: chunk k+1 uploads while chunk k computes)."""
-    return max(1, _env_int("TMOG_STREAM_BUFFERS", 2))
+    buffering: chunk k+1 uploads while chunk k computes; autotuned from
+    telemetry when unset and TMOG_COSTMODEL=1)."""
+    return _knob("TMOG_STREAM_BUFFERS", 2, "buffers")
 
 
 def enabled() -> bool:
@@ -86,7 +123,8 @@ def handoff_budget_bytes() -> int:
     """Device-byte budget for keeping selector-bound output chunks resident
     (TMOG_STREAM_HANDOFF_BYTES, default 2 GiB).  Above it the handoff is
     skipped and the selector re-uploads from host as before."""
-    return _env_int("TMOG_STREAM_HANDOFF_BYTES", 2_147_483_648)
+    return _knob("TMOG_STREAM_HANDOFF_BYTES", 2_147_483_648,
+                 "handoff_budget_bytes", floor=None)
 
 
 # ---------------------------------------------------------------------------
@@ -95,13 +133,13 @@ def handoff_budget_bytes() -> int:
 # view over it, and is also what obs.snapshot()["stream"] reports.
 # ---------------------------------------------------------------------------
 _stream_scope = obs_registry.scope("stream", defaults=dict(
-    streams=0, chunks=0, rows=0, pad_rows=0, chunk_rows=0,
+    streams=0, chunks=0, rows=0, pad_rows=0, chunk_rows=0, buffers=0,
     stages_fused=0, stages_host=0, layers=0,
     terminals=0, device_only=0,
     bytes_in=0.0, bytes_out=0.0, compiles=0,
     device_handoffs=0, handoff_bytes=0.0,
     upload_s=0.0, pull_wait_s=0.0, wall_s=0.0,
-    fallbacks=[],
+    autotune={}, fallbacks=[],
 ))
 
 
@@ -564,6 +602,7 @@ def execute(plan: StreamPlan, ds: Dataset) -> Dict[str, Any]:
     _stream_scope.inc("streams")
     _stream_scope.inc("chunks", n_chunks)
     _stream_scope.set("chunk_rows", C)
+    _stream_scope.set("buffers", B)
     _stream_scope.inc("rows", n)
     _stream_scope.inc("terminals", len(terminals))
     _stream_scope.inc("device_only", len(plan.stages) - len(terminals))
