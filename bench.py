@@ -283,6 +283,119 @@ def transform_bench():
     obs.write_record("bench", extra={"report": report})
 
 
+def serve_bench():
+    """``bench.py --serve [replicas]``: replicated serving + AOT cache wall.
+
+    Measures the fleet-serving acceptance pair on one host: (1) micro-batch
+    throughput and p99 at 1 replica vs N replicas (same client load, same
+    model), and (2) cold vs instant-warm deploy wall — the second deploy
+    loads every per-bucket executable from the persistent AOT cache
+    (TMOG_COMPILE_CACHE) instead of compiling.  CPU-proxy friendly.
+    """
+    import tempfile
+    import threading
+
+    import transmogrifai_tpu.types as T
+    from transmogrifai_tpu import OpWorkflow
+    from transmogrifai_tpu.impl.classification.logistic import (
+        OpLogisticRegression)
+    from transmogrifai_tpu.impl.feature.vectorizers import (
+        OneHotVectorizer, RealVectorizer, VectorsCombiner)
+    from transmogrifai_tpu.serve import (MicroBatcher, ModelRegistry,
+                                         ServeMetrics)
+    from transmogrifai_tpu.serve import compile_cache
+    from transmogrifai_tpu.testkit import TestFeatureBuilder
+    from transmogrifai_tpu.workflow.model import load_model
+
+    platform, fallback = init_backend()
+    import jax
+
+    n_replicas = next((int(a) for a in sys.argv[2:] if a.isdigit()),
+                      len(jax.devices()))
+    n = 256
+    ds, (x, cat, y) = TestFeatureBuilder.of(
+        ("x", T.Real, list(np.linspace(-2, 2, n))),
+        ("cat", T.PickList, ["a", "b", "c", "d"] * (n // 4)),
+        ("y", T.RealNN, [float(i % 2) for i in range(n)]), response="y")
+    feats = VectorsCombiner().set_input(
+        RealVectorizer().set_input(x).get_output(),
+        OneHotVectorizer(top_k=5, min_support=1).set_input(cat).get_output(),
+    ).get_output()
+    pred = OpLogisticRegression(reg_param=0.1).set_input(y, feats).get_output()
+    model = OpWorkflow().set_input_dataset(ds).set_result_features(pred).train()
+
+    tmp = tempfile.mkdtemp(prefix="tmog_serve_bench_")
+    saved = os.path.join(tmp, "model")
+    model.save(saved)
+    os.environ["TMOG_COMPILE_CACHE"] = os.path.join(tmp, "aotx")
+    clients, per_client = 64, 40
+
+    def drive(replicas):
+        compile_cache.reset_cache_stats()
+        metrics = ServeMetrics()
+        registry = ModelRegistry(max_batch=64, metrics=metrics,
+                                 replicas=replicas)
+        t0 = time.perf_counter()
+        registry.deploy(load_model(saved))
+        warm_s = time.perf_counter() - t0
+        cache = compile_cache.cache_stats()
+        batcher = MicroBatcher(registry, max_batch=64, max_wait_ms=2.0,
+                               queue_size=8192, metrics=metrics).start()
+        errors = []
+
+        def client():
+            try:
+                for _ in range(per_client):
+                    batcher.score({"x": 0.7, "cat": "b"}, timeout_s=120)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=client) for _ in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300)
+        dt = time.perf_counter() - t0
+        batcher.stop()
+        assert not errors, errors[:3]
+        snap = metrics.snapshot()
+        return {
+            "replicas": registry.n_replicas,
+            "warmup_s": round(warm_s, 3),
+            "qps": round(clients * per_client / dt, 1),
+            "p99_ms": snap["request_latency"]["p99_ms"],
+            "replica_slots_hit": sum(
+                1 for s in snap["replicas"].values() if s["batches"]),
+            "cache": {k: (round(cache[k], 3) if isinstance(cache[k], float)
+                          else cache[k])
+                      for k in ("hits", "misses", "compiles", "compile_s",
+                                "load_s", "saves")},
+        }
+
+    fleet_cold = drive(n_replicas)  # empty cache: every (bucket, chip) compiles
+    fleet = drive(n_replicas)       # warm: every executable deserializes
+    single = drive(1)               # QPS baseline (cache state irrelevant)
+    report = {
+        "metric": "serve_replica_qps_speedup",
+        "value": round(fleet["qps"] / single["qps"], 2),
+        "unit": f"x qps at {fleet['replicas']} replicas vs 1",
+        "warm_restart_speedup": round(
+            fleet_cold["warmup_s"] / fleet["warmup_s"], 2),
+        "single": single,
+        "fleet": fleet,
+        "fleet_cold": fleet_cold,
+        "clients": clients,
+        "requests": clients * per_client,
+        "platform": platform,
+        **({"backend_fallback": fallback} if fallback else {}),
+    }
+    print(json.dumps(report))
+    from transmogrifai_tpu import obs
+
+    obs.write_record("bench", extra={"report": report})
+
+
 def make_selector(seed: int = 42):
     from transmogrifai_tpu.impl.selector.factories import (
         BinaryClassificationModelSelector)
@@ -476,5 +589,7 @@ def main():
 if __name__ == "__main__":
     if "--transform" in sys.argv:
         transform_bench()
+    elif "--serve" in sys.argv:
+        serve_bench()
     else:
         main()

@@ -1,0 +1,240 @@
+"""Fleet-scale serving: per-chip replicas, rolling hot-swap under load, and
+the persistent AOT compile cache (instant-warm re-deploy + corruption
+fallback).  Runs on 8 virtual CPU devices (conftest sets
+``--xla_force_host_platform_device_count=8``)."""
+import threading
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu import OpWorkflow
+from transmogrifai_tpu.impl.classification.logistic import OpLogisticRegression
+from transmogrifai_tpu.impl.feature.vectorizers import (OneHotVectorizer,
+                                                        RealVectorizer,
+                                                        VectorsCombiner)
+from transmogrifai_tpu.local import batch_score_function
+from transmogrifai_tpu.serve import MicroBatcher, ModelRegistry, ServeMetrics
+from transmogrifai_tpu.serve import compile_cache
+from transmogrifai_tpu.serve.aot import BucketScorer
+from transmogrifai_tpu.testkit import TestFeatureBuilder
+
+
+def _train(n=80, shift=0.0):
+    ds, (x, cat, y) = TestFeatureBuilder.of(
+        ("x", T.Real, list(np.linspace(-2 + shift, 2 + shift, n))),
+        ("cat", T.PickList, ["a", "b"] * (n // 2)),
+        ("y", T.RealNN, [float(i % 2) for i in range(n)]), response="y")
+    feats = VectorsCombiner().set_input(
+        RealVectorizer().set_input(x).get_output(),
+        OneHotVectorizer(top_k=3, min_support=1).set_input(cat).get_output(),
+    ).get_output()
+    pred = OpLogisticRegression(reg_param=0.1).set_input(
+        y, feats).get_output()
+    return OpWorkflow().set_input_dataset(ds).set_result_features(pred).train()
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _train()
+
+
+RECORDS = ([{"x": float(v), "cat": c}
+            for v, c in zip(np.linspace(-3, 3, 13), "ab" * 7)]
+           + [{"x": None, "cat": None}, {}])
+
+
+# ---------------------------------------------------------------------------
+# replica slot math
+# ---------------------------------------------------------------------------
+def test_serve_devices_env_and_cycling(monkeypatch):
+    import jax
+
+    from transmogrifai_tpu.parallel.mesh import serve_devices
+
+    n_dev = len(jax.devices())
+    monkeypatch.delenv("TMOG_SERVE_REPLICAS", raising=False)
+    assert len(serve_devices()) == n_dev
+    monkeypatch.setenv("TMOG_SERVE_REPLICAS", "3")
+    assert len(serve_devices()) == 3
+    # explicit n beats the env knob; oversubscription cycles the chips
+    over = serve_devices(n_dev + 4)
+    assert len(over) == n_dev + 4
+    assert over[n_dev] == over[0]
+    assert len(serve_devices(0)) == 1  # floor
+
+
+def test_registry_exposes_replicas(model):
+    registry = ModelRegistry(max_batch=8, replicas=3)
+    registry.deploy(model, version="v1")
+    assert registry.n_replicas == 3
+    info = registry.info()
+    assert info["replicas"] == 3
+    assert len(info["replica_info"]) == 3
+    assert {r["slot"] for r in info["replica_info"]} == {0, 1, 2}
+    assert all(r["id"].startswith("v1/") for r in info["replica_info"])
+
+
+# ---------------------------------------------------------------------------
+# multi-replica routing + rolling hot-swap under concurrent traffic
+# ---------------------------------------------------------------------------
+def test_traffic_spreads_across_replicas(model):
+    metrics = ServeMetrics()
+    registry = ModelRegistry(max_batch=4, metrics=metrics, replicas=4)
+    registry.deploy(model, version="v1")
+    batcher = MicroBatcher(registry, max_batch=4, max_wait_ms=1.0,
+                           queue_size=4096, metrics=metrics).start()
+    errors = []
+
+    def client():
+        try:
+            for _ in range(12):
+                out = batcher.submit({"x": 0.4, "cat": "a"}).result(60)
+                assert out.version == "v1"
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=client) for _ in range(32)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    batcher.stop()
+    assert not errors
+    snap = metrics.snapshot()
+    per_slot = snap["replicas"]
+    assert sum(s["responses"] for s in per_slot.values()) == 32 * 12
+    # least-outstanding routing under 32 concurrent clients must fan out
+    busy = [s for s in per_slot.values() if s["batches"] > 0]
+    assert len(busy) >= 2, f"traffic pinned to one slot: {per_slot}"
+
+
+def test_rolling_swap_keeps_serving(model):
+    v2 = _train(shift=0.25)
+    metrics = ServeMetrics()
+    registry = ModelRegistry(max_batch=8, metrics=metrics, replicas=4)
+    registry.deploy(model, version="v1")
+    batcher = MicroBatcher(registry, max_batch=8, max_wait_ms=1.0,
+                           queue_size=4096, metrics=metrics).start()
+    stop = threading.Event()
+    seen = set()
+    errors = []
+
+    def client():
+        while not stop.is_set():
+            try:
+                seen.add(batcher.submit({"x": -0.3, "cat": "b"})
+                         .result(60).version)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=client) for _ in range(8)]
+    for t in threads:
+        t.start()
+    registry.deploy(v2, version="v2")  # rolling slot-by-slot swap
+    # post-swap submissions must never see the old version
+    after = {batcher.submit({"x": 0.1, "cat": "a"}).result(60).version
+             for _ in range(16)}
+    stop.set()
+    for t in threads:
+        t.join(120)
+    batcher.stop()
+    assert not errors
+    assert after == {"v2"}
+    assert "v1" in seen and "v2" in seen  # traffic flowed on both sides
+    assert metrics.snapshot()["swaps"] == 2
+    assert all(r.owner.version == "v2" for r in registry.slots())
+
+
+# ---------------------------------------------------------------------------
+# persistent AOT compile cache
+# ---------------------------------------------------------------------------
+def _deploy_and_score(saved_path, cache_stats_out, replicas=2):
+    from transmogrifai_tpu.workflow.model import load_model
+
+    registry = ModelRegistry(max_batch=8, replicas=replicas)
+    registry.deploy(load_model(saved_path), version="v1")
+    outs = registry.replica(0).score(list(RECORDS))
+    cache_stats_out.append(compile_cache.cache_stats())
+    return outs
+
+
+def test_second_deploy_hits_cache_with_zero_compiles(model, tmp_path,
+                                                     monkeypatch):
+    monkeypatch.setenv("TMOG_COMPILE_CACHE", str(tmp_path / "aotx"))
+    saved = str(tmp_path / "m")
+    model.save(saved)
+    stats = []
+    compile_cache.reset_cache_stats()
+    first = _deploy_and_score(saved, stats)
+    assert stats[0]["compiles"] > 0 and stats[0]["saves"] > 0
+
+    compile_cache.reset_cache_stats()
+    second = _deploy_and_score(saved, stats)
+    assert stats[1]["compiles"] == 0, "re-deploy must not touch XLA"
+    assert stats[1]["hits"] > 0 and stats[1]["misses"] == 0
+    # deserialized executables are the SAME programs: bit-identical scores
+    assert first == second
+
+
+def test_corrupt_cache_entry_falls_back_to_compile(model, tmp_path,
+                                                   monkeypatch):
+    from transmogrifai_tpu import obs
+
+    cache_dir = tmp_path / "aotx"
+    monkeypatch.setenv("TMOG_COMPILE_CACHE", str(cache_dir))
+    saved = str(tmp_path / "m")
+    model.save(saved)
+    stats = []
+    compile_cache.reset_cache_stats()
+    first = _deploy_and_score(saved, stats)
+    entries = list(cache_dir.glob("*.aotx"))
+    assert entries
+    for p in entries:
+        p.write_bytes(b"not a pickle")
+
+    compile_cache.reset_cache_stats()
+    second = _deploy_and_score(saved, stats)
+    assert stats[1]["compiles"] > 0, "corrupt entries must recompile"
+    assert stats[1]["hits"] == 0
+    reasons = [f["reason"] for f in stats[1]["fallbacks"]]
+    assert "corrupt_cache_entry" in reasons  # audit trail, not an error
+    assert "corrupt_cache_entry" in [
+        f["reason"]
+        for f in obs.snapshot()["compile_cache"].get("fallbacks", [])]
+    assert first == second  # recompiled executables score identically
+
+
+def test_cache_disabled_still_compiles(model, monkeypatch):
+    monkeypatch.delenv("TMOG_COMPILE_CACHE", raising=False)
+    compile_cache.reset_cache_stats()
+    registry = ModelRegistry(max_batch=8, replicas=2)
+    registry.deploy(model, version="v1")
+    out = registry.replica(0).score([{"x": 0.2, "cat": "a"}])
+    assert len(out) == 1
+    stats = compile_cache.cache_stats()
+    assert stats["hits"] == 0 and stats["saves"] == 0
+
+
+# ---------------------------------------------------------------------------
+# AOT scorer parity: generic path match + cross-device bit-identity
+# ---------------------------------------------------------------------------
+def test_bucket_scorer_parity_and_cross_device(model):
+    import jax
+
+    devs = jax.devices()
+    buckets = [1, 2, 4, 8]
+    generic = batch_score_function(model)(list(RECORDS))
+    s0 = BucketScorer(model, buckets, devs[0])
+    s0.warm()
+    aot0 = s0(list(RECORDS))
+    assert len(aot0) == len(generic)
+    for a, g in zip(aot0, generic):
+        assert a.keys() == g.keys()
+        for k in a:
+            assert a[k] == pytest.approx(g[k], abs=1e-6)
+    # same executable fingerprint modulo device: scores must be bit-identical
+    s1 = BucketScorer(model, buckets, devs[1 % len(devs)])
+    s1.warm()
+    assert s1(list(RECORDS)) == aot0

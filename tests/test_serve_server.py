@@ -155,7 +155,9 @@ def test_http_overload_sheds_with_429(trained):
     """Concurrent submissions beyond the bounded queue come back as explicit
     429s (documented rejection), and the shed counter in /metrics matches."""
     model, _ = trained
-    registry = ModelRegistry(max_batch=2)
+    # one replica slot: with the fleet default (one worker per device) the
+    # queue drains in parallel and 24 clients may never overflow it
+    registry = ModelRegistry(max_batch=2, replicas=1)
     entry = registry.deploy(model, version="v1")
     real_batch = entry.batch
 

@@ -3,10 +3,15 @@
 Trains a small testkit model in-process (or loads --model-location), starts
 a ModelServer on an ephemeral port, and hammers it with N client threads for
 a fixed duration.  Prints one JSON line: throughput, client-side
-p50/p95/p99 latency, and the server's own /metrics snapshot (batch
-occupancy, shed/fallback counters) — comparable across rounds.
+p50/p95/p99 latency, replica count, per-replica QPS/p99, compile-cache
+hit/miss counters, and the server's own /metrics snapshot (batch occupancy,
+shed/fallback counters) — comparable across rounds.  The same payload is
+appended as a schema-versioned JSONL run record via ``obs/record.py``
+(TMOG_TELEMETRY or ./telemetry.jsonl), so serve runs feed the costmodel
+telemetry like bench/profile runs do.
 
     python tools/probe_serve.py --concurrency 64 --duration 10
+    python tools/probe_serve.py --replicas 8 --compile-cache /tmp/aotx
     python tools/probe_serve.py --model-location /tmp/m --record '{"x": 1.0}'
 """
 from __future__ import annotations
@@ -55,6 +60,21 @@ def _percentile(sorted_ms, p):
     return sorted_ms[i]
 
 
+def _replica_summary(serve_snapshot, elapsed):
+    """Per-replica QPS + latency digest from the /metrics replicas block."""
+    out = {}
+    for slot, st in (serve_snapshot.get("replicas") or {}).items():
+        out[slot] = {
+            "device": st.get("device", ""),
+            "batches": st.get("batches", 0),
+            "responses": st.get("responses", 0),
+            "qps": (round(st.get("responses", 0) / elapsed, 1)
+                    if elapsed else 0.0),
+            "p99_ms": (st.get("request_latency") or {}).get("p99_ms", 0.0),
+        }
+    return out
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--model-location", default=None,
@@ -66,9 +86,22 @@ def main(argv=None) -> int:
     p.add_argument("--max-batch", type=int, default=64)
     p.add_argument("--max-wait-ms", type=float, default=2.0)
     p.add_argument("--queue-size", type=int, default=1024)
+    p.add_argument("--replicas", type=int, default=None,
+                   help="per-chip model replicas (default: "
+                        "TMOG_SERVE_REPLICAS or one per device)")
+    p.add_argument("--compile-cache", default=None,
+                   help="persistent AOT executable cache dir (sets "
+                        "TMOG_COMPILE_CACHE for this run)")
+    p.add_argument("--no-record", action="store_true",
+                   help="skip the telemetry JSONL run record")
     args = p.parse_args(argv)
 
+    if args.compile_cache:
+        os.environ["TMOG_COMPILE_CACHE"] = args.compile_cache
+
+    from transmogrifai_tpu import obs
     from transmogrifai_tpu.serve import ModelRegistry, ModelServer
+    from transmogrifai_tpu.serve import compile_cache
 
     if args.model_location:
         from transmogrifai_tpu.workflow.model import load_model
@@ -78,13 +111,15 @@ def main(argv=None) -> int:
         model = _train_demo_model()
     record = json.loads(args.record) if args.record else {"x": 0.7, "cat": "b"}
 
-    registry = ModelRegistry(max_batch=args.max_batch)
+    registry = ModelRegistry(max_batch=args.max_batch, replicas=args.replicas)
     server = ModelServer(registry, port=0, max_batch=args.max_batch,
                          max_wait_ms=args.max_wait_ms,
                          queue_size=args.queue_size)
+    compile_cache.reset_cache_stats()
     t_warm = time.perf_counter()
     registry.deploy(model)
     warm_s = time.perf_counter() - t_warm
+    warm_cache = compile_cache.cache_stats()
     server.start()
     url = f"{server.url}/score"
     payload = json.dumps(record).encode()
@@ -140,6 +175,7 @@ def main(argv=None) -> int:
         "concurrency": args.concurrency,
         "duration_s": round(elapsed, 3),
         "warmup_s": round(warm_s, 3),
+        "replicas": registry.n_replicas,
         "responses": count[0],
         "throughput_rps": round(count[0] / elapsed, 1) if elapsed else 0.0,
         "client_shed": shed[0],
@@ -148,9 +184,16 @@ def main(argv=None) -> int:
         "p95_ms": round(_percentile(latencies_ms, 95), 3),
         "p99_ms": round(_percentile(latencies_ms, 99), 3),
         "batch_occupancy_mean": server_metrics["serve"]["batch_occupancy_mean"],
+        "replica_stats": _replica_summary(server_metrics["serve"], elapsed),
+        "compile_cache": {k: warm_cache.get(k) for k in
+                          ("hits", "misses", "compiles", "compile_s",
+                           "load_s", "saves", "save_errors")},
         "server_metrics": server_metrics["serve"],
     }
     print(json.dumps(out))
+    if not args.no_record:
+        # schema-versioned run record (context + full obs snapshot included)
+        obs.write_record("probe_serve", extra=out)
     return 0
 
 

@@ -33,6 +33,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="max time a request waits for batchmates")
     p.add_argument("--queue-size", type=int, default=1024,
                    help="admission queue bound (beyond it: HTTP 429)")
+    p.add_argument("--replicas", type=int, default=None,
+                   help="per-chip model replicas (default: "
+                        "TMOG_SERVE_REPLICAS or one per device)")
     p.add_argument("--duration", type=float, default=None,
                    help="seconds to serve (default: until Ctrl-C)")
     args = p.parse_args(argv)
@@ -47,15 +50,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     from ..serve import ModelRegistry, ModelServer
     from ..workflow.model import load_model
 
-    registry = ModelRegistry(max_batch=args.max_batch)
+    registry = ModelRegistry(max_batch=args.max_batch,
+                             replicas=args.replicas)
     server = ModelServer(registry, host=args.host, port=args.port,
                          max_batch=args.max_batch,
                          max_wait_ms=args.max_wait_ms,
                          queue_size=args.queue_size)
     print(f"Loading model from {args.model} ...", file=sys.stderr)
     entry = registry.deploy(load_model(args.model), version=args.version)
-    print(f"Deployed {entry.version} (warmed buckets: {entry.buckets})",
-          file=sys.stderr)
+    print(f"Deployed {entry.version} (warmed buckets: {entry.buckets}, "
+          f"replicas: {len(entry.replicas)})", file=sys.stderr)
     server.start()
     print(f"Serving at {server.url}/score (metrics: {server.url}/metrics)",
           file=sys.stderr)

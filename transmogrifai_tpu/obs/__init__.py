@@ -47,7 +47,8 @@ def snapshot() -> Dict[str, Any]:
     for mod in ("transmogrifai_tpu.ops.sweep",
                 "transmogrifai_tpu.workflow.stream",
                 "transmogrifai_tpu.utils.flops",
-                "transmogrifai_tpu.serve.metrics"):
+                "transmogrifai_tpu.serve.metrics",
+                "transmogrifai_tpu.serve.compile_cache"):
         try:
             __import__(mod)
         except Exception:  # a broken optional subsystem must not block obs

@@ -589,6 +589,29 @@ obs_registry.register_provider("sweep", lambda: run_stats())
 _aot_cache: Dict[Tuple, Any] = {}
 _aot_lock = threading.Lock()
 
+#: one-shot wiring of jax's persistent compilation cache before the first
+#: sweep compile — a restarted process re-lowers but XLA reloads the
+#: compiled artifact from ``TMOG_COMPILE_CACHE`` (TPU/GPU; the CPU backend
+#: refuses its own entries, which is why serving persists serialized
+#: executables via ``serve/compile_cache`` instead)
+_cache_wired = False
+
+
+def _wire_compile_cache() -> None:
+    global _cache_wired
+    if _cache_wired:
+        return
+    with _aot_lock:
+        if _cache_wired:
+            return
+        _cache_wired = True
+    try:
+        from ..utils.backend import enable_compile_cache
+
+        enable_compile_cache()
+    except Exception as e:  # noqa: BLE001 — cache is an optimization only
+        record_fallback("compile_cache_unavailable", error=repr(e))
+
 
 def reset_run_stats() -> None:
     _sweep_scope.reset()
@@ -637,6 +660,7 @@ def _aot(name: str, fn, spec, device, dyn_args) -> Tuple[Any, float, Tuple]:
         hit = _aot_cache.get(key)
     if hit is not None:
         return hit[0], 0.0, hit[1]
+    _wire_compile_cache()
     t0 = time.perf_counter()
     with trace.span("sweep.compile", fn=name, device=str(device)):
         with mesh_mod.trace_collectives() as colls:
@@ -831,6 +855,7 @@ def _aot_rs(spec, submesh, n_orig: int, dyn_args) -> Tuple[Any, float, Tuple]:
         hit = _aot_cache.get(key)
     if hit is not None:
         return hit[0], 0.0, hit[1]
+    _wire_compile_cache()
     t0 = time.perf_counter()
     with trace.span("sweep.compile", fn="sweep.run_rs",
                     devices=len(np.asarray(submesh.devices).flat)):

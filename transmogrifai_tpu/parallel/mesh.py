@@ -99,6 +99,20 @@ def auto_mesh() -> Optional[Mesh]:
     return make_mesh(n_data=1, n_model=len(devs))
 
 
+def serve_devices(n: Optional[int] = None) -> List[jax.Device]:
+    """Devices for the serving replica slots: one per local chip by default,
+    overridable via ``TMOG_SERVE_REPLICAS`` (or the explicit ``n``).  Asking
+    for more replicas than chips cycles the device list — useful for
+    oversubscribing CPU test hosts, harmless on a real mesh."""
+    from ..utils.env import env_int
+
+    devs = jax.devices()
+    if n is None:
+        n = env_int("TMOG_SERVE_REPLICAS", len(devs))
+    n = max(1, int(n))
+    return [devs[i % len(devs)] for i in range(n)]
+
+
 def data_mesh() -> Optional[Mesh]:
     """All local devices on the ``data`` axis — for row-sharded statistics
     passes (SanityChecker / RFF moments + Gram, SURVEY §2.7 axis 1).

@@ -224,8 +224,11 @@ class OpWorkflowRunner:
         model = self._load_model(params, listener)
         cfg = dict(params.custom_params.get("serve", {}))
         metrics = ServeMetrics()
+        replicas = cfg.get("replicas")
         registry = ModelRegistry(max_batch=int(cfg.get("max_batch", 64)),
-                                 metrics=metrics)
+                                 metrics=metrics,
+                                 replicas=None if replicas is None
+                                 else int(replicas))
         server = ModelServer(
             registry,
             host=cfg.get("host", "127.0.0.1"),
@@ -311,6 +314,9 @@ class OpApp:
                            help="max time a request waits for batchmates")
         serve.add_argument("--queue-size", type=int, default=1024,
                            help="admission queue bound (beyond it: HTTP 429)")
+        serve.add_argument("--replicas", type=int, default=None,
+                           help="per-chip model replicas (default: "
+                                "TMOG_SERVE_REPLICAS or one per device)")
         serve.add_argument("--serve-duration", type=float, default=None,
                            help="seconds to serve (default: until Ctrl-C)")
         return p
@@ -329,7 +335,7 @@ class OpApp:
             params.custom_params.setdefault("serve", {}).update({
                 "host": args.host, "port": args.port,
                 "max_batch": args.max_batch, "max_wait_ms": args.max_wait_ms,
-                "queue_size": args.queue_size,
+                "queue_size": args.queue_size, "replicas": args.replicas,
                 "duration_s": args.serve_duration,
             })
         return params

@@ -1,31 +1,40 @@
-"""serve — online scoring: micro-batched, shape-bucketed model serving.
+"""serve — online scoring: replicated, micro-batched, shape-bucketed.
 
 The TPU-shaped layer above ``local/`` (which proves the row-path contract):
 concurrent requests are micro-batched into padded power-of-two shape buckets
-so jit'd XLA computations are reused across requests, models hot-swap
-through a versioned registry (load -> warm -> swap -> drain), and overload
-sheds explicitly (bounded queue + HTTP 429) instead of degrading latency for
-everyone.
+and routed to the least-loaded of N per-chip model replicas
+(``TMOG_SERVE_REPLICAS``, default one per device), so one host saturates the
+whole mesh.  Models hot-swap through a versioned registry (load -> warm ->
+swap -> drain, rolling per replica so capacity never drops to zero), every
+(bucket, device) score program is AOT-compiled at warmup and persisted via
+``TMOG_COMPILE_CACHE`` (restart / re-deploy of a known model warms from
+deserialized executables in milliseconds), and overload sheds explicitly
+(bounded queue + HTTP 429) instead of degrading latency for everyone.
 
 Layering::
 
-    server.py    HTTP front end (stdlib ThreadingHTTPServer), load shedding
-    batcher.py   bounded admission queue -> padded bucket batches
-    registry.py  versioned models, atomic hot-swap, warmup
-    metrics.py   latency histograms / counters, exported via /metrics and
-                 the runner's AppMetrics (utils/listener.py)
+    server.py         HTTP front end (stdlib ThreadingHTTPServer), shedding
+    batcher.py        bounded admission queue -> padded bucket batches ->
+                      least-outstanding-work replica routing
+    registry.py       versioned models, N replica slots, rolling hot-swap
+    aot.py            per-(bucket, device) AOT score programs over the
+                      streaming planner (device-resident score feed)
+    compile_cache.py  persistent serialized-executable cache
+    metrics.py        latency histograms / counters (merged + per-replica),
+                      exported via /metrics and the runner's AppMetrics
 
 Entry points: the ``Serve`` run type on ``OpWorkflowRunner``, the
 ``transmogrifai-tpu-serve`` console script, and this module's classes for
 in-process embedding (tests, notebooks).
 """
 from .batcher import MicroBatcher, Scored, ShedError
-from .metrics import LatencyHistogram, ServeMetrics
-from .registry import (ModelRegistry, ServingModel, bucket_for, shape_buckets)
+from .metrics import LatencyHistogram, ServeMetrics, prometheus_replica_text
+from .registry import (ModelRegistry, Replica, ServingModel, bucket_for,
+                       shape_buckets)
 from .server import ModelServer
 
 __all__ = [
     "LatencyHistogram", "MicroBatcher", "ModelRegistry", "ModelServer",
-    "Scored", "ServeMetrics", "ServingModel", "ShedError", "bucket_for",
-    "shape_buckets",
+    "Replica", "Scored", "ServeMetrics", "ServingModel", "ShedError",
+    "bucket_for", "prometheus_replica_text", "shape_buckets",
 ]

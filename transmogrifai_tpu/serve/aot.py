@@ -1,0 +1,268 @@
+"""Per-bucket AOT score programs — the device-resident serving feed.
+
+``BatchScoreFunction`` walks the fitted DAG layer by layer, bouncing every
+intermediate column through host numpy between layers.  For serving that
+bounce is pure overhead: the shape buckets are fixed at deploy time, so the
+whole fusable transform sub-DAG can be lowered ONCE per (bucket, device)
+and compiled ahead of time — exactly how ``ops/sweep`` AOT-compiles its
+per-shard programs.  This module reuses the streaming planner
+(``workflow/stream.build_plan``) to do it:
+
+- the score path compiles to the SAME single fused per-chunk program the
+  training stream runs, so intermediates stay device-resident and only the
+  terminal feature columns (the ones the host-side model head consumes) are
+  pulled, once per batch;
+- each executable is pinned to its replica's device (lowered from
+  device-committed arguments), so N replicas saturate N chips with no
+  cross-device traffic;
+- warmup routes every compile through ``serve.compile_cache`` — a restart
+  or re-deploy of a previously-seen model deserializes the executables
+  instead of recompiling (the instant-warm hot-swap path).
+
+Unfusable stages (the prediction heads have no ``jax_transform``) run
+host-side after the pull in DAG order, under ``jax.default_device`` so
+their device work also lands on the replica's chip.  Models whose DAG
+yields fewer than two fusable stages raise :class:`AotUnsupported` and the
+registry falls back to the generic ``BatchScoreFunction`` per replica —
+recorded, never an error.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import types as T
+from ..columns import NumericColumn, VectorColumn
+from ..local.scoring import BatchScoreFunction, _emit
+from ..obs import trace
+from ..utils import devcache
+from ..workflow import stream
+from . import compile_cache
+from .registry import bucket_for
+
+__all__ = ["AotUnsupported", "BucketScorer"]
+
+
+class AotUnsupported(RuntimeError):
+    """Model's DAG has no fusable sub-DAG worth an AOT program."""
+
+
+#: in-process executables keyed (plan key, bucket, device): repeated deploys
+#: of the SAME model object (rolling swaps, tests) skip even the disk cache.
+#: Values keep the plan alive so the id()-based plan key can't be recycled.
+_MEMO: "OrderedDict[tuple, tuple]" = OrderedDict()
+_MEMO_MAX = 128
+_MEMO_LOCK = threading.Lock()
+
+#: canonical StableHLO text per (plan key, bucket) — device identity is not
+#: part of the text, so the first replica to lower a bucket fingerprints it
+#: for every device; on disk-cache hits the other replicas never trace.
+#: Values carry the plan (id-pinning) like _MEMO.
+_HLO_TEXT: "OrderedDict[tuple, tuple]" = OrderedDict()
+_HLO_LOCK = threading.Lock()
+
+#: one stream plan per (model, result names): a model's N replicas plan the
+#: identical DAG — building it once keeps N-replica warmup from paying N
+#: GIL-bound planning passes.  Values pin the model against id reuse.
+_PLAN_MEMO: "OrderedDict[tuple, tuple]" = OrderedDict()
+_PLAN_LOCK = threading.Lock()
+
+
+def _plan_for(model: Any, ingest: BatchScoreFunction,
+              result_names: Sequence[str]):
+    key = (id(model), tuple(result_names))
+    with _PLAN_LOCK:
+        hit = _PLAN_MEMO.get(key)
+        if hit is not None:
+            _PLAN_MEMO.move_to_end(key)
+            return hit[0]
+    tmpl = ingest.records_to_dataset([{}])
+    plan = stream.build_plan(tmpl, model.dag, live=set(result_names))
+    with _PLAN_LOCK:
+        hit = _PLAN_MEMO.setdefault(key, (plan, model))
+        while len(_PLAN_MEMO) > _MEMO_MAX:
+            _PLAN_MEMO.popitem(last=False)
+    return hit[0]
+
+
+def _pad_rows(a: np.ndarray, cap: int) -> np.ndarray:
+    """Zero-pad axis 0 to ``cap`` rows (no copy when already there)."""
+    if a.shape[0] >= cap:
+        return a
+    return np.pad(a, [(0, cap - a.shape[0])] + [(0, 0)] * (a.ndim - 1))
+
+
+class BucketScorer:
+    """records -> score dicts via per-bucket AOT executables on one device.
+
+    Drop-in for ``BatchScoreFunction`` (same output contract element for
+    element); ``warm()`` compiles/loads every bucket ahead of traffic.
+    """
+
+    def __init__(self, model: Any, buckets: Sequence[int], device: Any):
+        self.device = device
+        self.buckets = sorted(int(b) for b in buckets)
+        self._ingest = BatchScoreFunction(model)  # records -> Dataset + names
+        self._result_names = [f.name for f in model.result_features]
+        plan = _plan_for(model, self._ingest, self._result_names)
+        if plan is None:
+            raise AotUnsupported(
+                "fewer than two stream-fusable stages in the scoring DAG")
+        self._plan = plan
+        self._jitted = stream.program_for(plan)
+        self._exec: Dict[int, Any] = {}
+        # template host args per bucket, kept alive so devcache can pin their
+        # device copies per replica (lowering args re-resolve without
+        # re-uploading on every rolling re-warm)
+        self._templates: Dict[int, Dict[str, Any]] = {}
+
+    # ---- compile / warm ----------------------------------------------------
+    def _template_args(self, bucket: int) -> Dict[str, Any]:
+        args = self._templates.get(bucket)
+        if args is None:
+            ds = self._ingest.records_to_dataset([{} for _ in range(bucket)])
+            args, _ = stream.chunk_args(self._plan, ds, 0, bucket, bucket)
+            self._templates[bucket] = args
+        return args
+
+    def _lowering_args(self, bucket: int) -> Dict[str, Any]:
+        """Device-committed template leaves (devcache-pinned per device)."""
+        def place(leaf):
+            return devcache.device_array(leaf, tag="serve.aot",
+                                         device=self.device)
+
+        return {k: ([place(a) for a in v] if isinstance(v, list) else place(v))
+                for k, v in self._template_args(bucket).items()}
+
+    def compile_bucket(self, bucket: int) -> str:
+        """Ensure the executable for one bucket exists; returns its source
+        ("memo" | "hit" | "compile")."""
+        if bucket in self._exec:
+            return "memo"
+        memo_key = (self._plan.key, bucket, str(self.device))
+        with _MEMO_LOCK:
+            hit = _MEMO.get(memo_key)
+            if hit is not None:
+                _MEMO.move_to_end(memo_key)
+        if hit is not None:
+            self._exec[bucket] = hit[0]
+            return "memo"
+
+        def lower():
+            return self._jitted.lower(self._lowering_args(bucket))
+
+        text_key = (self._plan.key, bucket)
+        with _HLO_LOCK:
+            ent = _HLO_TEXT.get(text_key)
+        if ent is None:
+            lowered = lower()
+            with _HLO_LOCK:
+                ent = _HLO_TEXT.setdefault(
+                    text_key, (lowered.as_text(), self._plan))
+                while len(_HLO_TEXT) > _MEMO_MAX:
+                    _HLO_TEXT.popitem(last=False)
+            lazy = lowered
+        else:
+            lazy = lower  # only traced if the disk cache misses
+        compiled, source = compile_cache.load_or_compile(
+            f"serve.score.b{bucket}", lazy, self.device, hlo_text=ent[0])
+        with _MEMO_LOCK:
+            hit = _MEMO.setdefault(memo_key, (compiled, self._plan))
+            while len(_MEMO) > _MEMO_MAX:
+                _MEMO.popitem(last=False)
+        self._exec[bucket] = hit[0]
+        return source
+
+    def warm(self, score: bool = True) -> None:
+        """Compile/load every bucket, then ONE end-to-end null score — the
+        registry's load->warm discipline, now cache-first.
+
+        One score suffices to prime the whole replica: the unfusable host
+        layers (prediction heads) jit per (shape, device) on first use, but
+        ``_score_bucket`` canonicalizes the host-side shape to the largest
+        bucket, so a single largest-bucket score compiles the only host
+        shape this device will ever see.  The smaller buckets' device
+        executables above are already final (deserialized or compiled) —
+        their first use costs dispatch, not XLA."""
+        for b in self.buckets:
+            with trace.span("serve.aot.warm", bucket=b,
+                            device=str(self.device)):
+                self.compile_bucket(b)
+        if score:
+            with trace.span("serve.aot.warm_score", bucket=self.buckets[-1],
+                            device=str(self.device)):
+                self([{} for _ in range(self.buckets[-1])])
+
+    # ---- scoring -----------------------------------------------------------
+    def _score_bucket(self, records: List[Dict[str, Any]], bucket: int
+                      ) -> List[Dict[str, Any]]:
+        import jax
+
+        n = len(records)
+        # the host-side dataset is canonicalized to the LARGEST bucket: the
+        # unfusable host layers jit per (shape, device), so giving them one
+        # constant shape means ONE compile per device — primed by warm()'s
+        # single null score — instead of one per bucket hit at request time
+        cap = self.buckets[-1]
+        if n < cap:
+            records = records + [{} for _ in range(cap - n)]
+        ds = self._ingest.records_to_dataset(records)
+        host_args, _ = stream.chunk_args(self._plan, ds, 0, n, bucket)
+        compiled = self._exec.get(bucket)
+        if compiled is None:
+            self.compile_bucket(bucket)
+            compiled = self._exec[bucket]
+        # fresh committed buffers each call: the program donates its inputs
+        outs = compiled(jax.device_put(host_args, self.device))
+        new_cols: Dict[str, Any] = {}
+        for e in self._plan.stages:
+            if not e.terminal:
+                continue
+            o = outs[e.out_name]
+            if e.out_kind == "numeric":
+                new_cols[e.out_name] = NumericColumn(
+                    e.ftype, _pad_rows(np.asarray(o[0]), cap),
+                    _pad_rows(np.asarray(o[1]), cap))
+            else:
+                host_vals = _pad_rows(np.asarray(o), cap)
+                new_cols[e.out_name] = VectorColumn(
+                    T.OPVector, host_vals, e.metadata)
+                # keep the device buffer discoverable: a downstream consumer
+                # resolving this matrix via devcache finds the resident copy
+                # (only when the host view IS the device buffer's shape)
+                if bucket == cap:
+                    devcache.seed(host_vals, o, np.float32,
+                                  device=self.device)
+        ds = ds.with_columns(new_cols)
+        with jax.default_device(self.device):
+            for layer in self._plan.host_layers:
+                host_new: Dict[str, Any] = {}
+                for t in layer:
+                    out_feats = t.get_outputs()
+                    col = t.transform_dataset(ds)
+                    if t.n_outputs == 1:
+                        host_new[out_feats[0].name] = col
+                    else:
+                        for f, c in zip(out_feats, col):
+                            host_new[f.name] = c
+                ds = ds.with_columns(host_new)
+        out_cols = [(nm, ds[nm]) for nm in self._result_names
+                    if nm in ds.columns]
+        return [{nm: _emit(col.to_scalar(i)) for nm, col in out_cols}
+                for i in range(n)]
+
+    def __call__(self, records: Sequence[Dict[str, Any]]
+                 ) -> List[Dict[str, Any]]:
+        records = list(records)
+        if not records:
+            return []
+        cap = self.buckets[-1]
+        out: List[Dict[str, Any]] = []
+        for lo in range(0, len(records), cap):
+            part = records[lo:lo + cap]
+            out.extend(self._score_bucket(
+                part, bucket_for(len(part), self.buckets)))
+        return out

@@ -1,19 +1,29 @@
-"""Dynamic micro-batcher: requests -> padded shape-bucket batches.
+"""Dynamic micro-batcher: requests -> padded shape-bucket batches -> replicas.
 
-Requests enter a BOUNDED admission queue (overflow is shed immediately with
-``ShedError`` — never a hang, never a silent drop).  A single dispatcher
-thread collects up to ``max_batch`` requests or until ``max_wait_ms``
-elapses after the first one, pads the batch with null records to the nearest
-power-of-two bucket, and scores it through the active model's vectorized
-bucket path (records -> columnar Dataset -> batch transform DAG).  Padding
-canonicalizes shapes so every jit'd XLA computation is reused across
-requests — the registry warmup has already compiled each bucket, so no
-request pays first-compile latency.
+Admission is BOUNDED end to end: at most ``queue_size`` requests may be
+outstanding (admitted but unresolved) anywhere in the batcher — admission
+queue, slot queues, or scoring — and overflow is shed immediately with
+``ShedError`` (never a hang, never a silent drop).  A single collector
+thread gathers up to ``max_batch`` requests or until ``max_wait_ms`` elapses
+after the first one, pads the batch with null records to the nearest
+power-of-two bucket, and ROUTES it to the replica slot with the least
+outstanding work (queued batches + in-flight scoring) — one host saturating
+N chips.  Each slot has its own worker thread, so scoring for any single
+replica is serialized (model code never sees concurrent calls on one
+device) while the N replicas score in parallel.
 
-Scoring happens ONLY on the dispatcher thread, so model code never sees
-concurrent calls.  If the vectorized path errors, the batch degrades
-gracefully to the per-record numpy row path (per-record, so one poisonous
-record fails alone rather than failing its batchmates).
+Padding canonicalizes shapes so every per-bucket AOT executable compiled at
+warmup is reused across requests — no request pays first-compile latency.
+If a replica's vectorized path errors, the batch degrades gracefully to the
+per-record numpy row path (per-record, so one poisonous record fails alone
+rather than failing its batchmates).
+
+Rolling hot-swap handshake: a worker takes a reference to its slot's
+current replica, enters the replica's in-flight guard, then RE-CHECKS the
+slot still holds that replica — if a swap won the race, it backs out and
+refetches.  Once the in-flight guard is confirmed, the registry's per-slot
+drain cannot complete until this batch resolves, so a returned ``deploy``
+guarantees no stale-version response for post-swap submissions.
 """
 from __future__ import annotations
 
@@ -48,7 +58,7 @@ class _Pending(NamedTuple):
 
 
 class MicroBatcher:
-    """Bounded-queue micro-batcher over a ``ModelRegistry``."""
+    """Bounded-queue micro-batcher over a ``ModelRegistry``'s replica slots."""
 
     def __init__(self, registry: ModelRegistry, max_batch: int = 64,
                  max_wait_ms: float = 2.0, queue_size: int = 1024,
@@ -64,53 +74,91 @@ class MicroBatcher:
         self.metrics = metrics or registry.metrics or ServeMetrics()
         if registry.metrics is None:
             registry.metrics = self.metrics
-        self._queue: "queue.Queue[_Pending]" = queue.Queue(maxsize=int(queue_size))
+        # end-to-end admission bound: the queue itself is unbounded, the
+        # OUTSTANDING count (admitted, future unresolved) is capped — with N
+        # replica workers a bound on just the admission queue would let
+        # unbounded work pile onto the slot queues
+        self._capacity = int(queue_size)
+        self._admit_lock = threading.Lock()
+        self._outstanding = 0
+        self._queue: "queue.Queue[_Pending]" = queue.Queue()
         self.metrics.add_gauge("queue_depth", self._queue.qsize)
+        self.metrics.add_gauge("outstanding", lambda: self._outstanding)
+        self._slot_queues: List["queue.Queue"] = [
+            queue.Queue() for _ in range(registry.n_replicas)]
         self._running = False
-        self._thread: Optional[threading.Thread] = None
+        self._collector: Optional[threading.Thread] = None
+        self._workers: List[threading.Thread] = []
 
     # ---- lifecycle ---------------------------------------------------------
     def start(self) -> "MicroBatcher":
         if self._running:
             return self
         self._running = True
-        self._thread = threading.Thread(target=self._loop,
-                                        name="serve-dispatcher", daemon=True)
-        self._thread.start()
+        self._collector = threading.Thread(target=self._loop,
+                                           name="serve-collector", daemon=True)
+        self._collector.start()
+        self._workers = [
+            threading.Thread(target=self._worker, args=(i,),
+                             name=f"serve-replica-{i}", daemon=True)
+            for i in range(len(self._slot_queues))]
+        for w in self._workers:
+            w.start()
         return self
 
     def stop(self, timeout_s: float = 10.0) -> None:
         self._running = False
-        if self._thread is not None:
-            self._thread.join(timeout_s)
-            self._thread = None
+        if self._collector is not None:
+            self._collector.join(timeout_s)
+            self._collector = None
+        for q in self._slot_queues:
+            q.put(None)  # wake each worker so it observes _running=False
+        for w in self._workers:
+            w.join(timeout_s)
+        self._workers = []
         # fail whatever is still queued rather than leaving callers hanging
+        leftovers: List[_Pending] = []
         while True:
             try:
-                pending = self._queue.get_nowait()
+                leftovers.append(self._queue.get_nowait())
             except queue.Empty:
                 break
+        for q in self._slot_queues:
+            while True:
+                try:
+                    item = q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is not None:
+                    leftovers.extend(item)
+        for pending in leftovers:
             pending.future.set_exception(RuntimeError("server shutting down"))
 
     # ---- admission ---------------------------------------------------------
     def submit(self, record: Dict[str, Any]) -> "Future[Scored]":
         """Enqueue one record; sheds with ``ShedError`` when the queue is full."""
         self.metrics.inc("requests")
+        with self._admit_lock:
+            if self._outstanding >= self._capacity:
+                self.metrics.inc("shed")
+                raise ShedError(f"admission queue full ({self._capacity} "
+                                f"outstanding); retry later")
+            self._outstanding += 1
         future: "Future[Scored]" = Future()
-        try:
-            self._queue.put_nowait(_Pending(record, future, time.monotonic()))
-        except queue.Full:
-            self.metrics.inc("shed")
-            raise ShedError(
-                f"admission queue full ({self._queue.maxsize} pending); retry later")
+        future.add_done_callback(self._release_admission)
+        self._queue.put(_Pending(record, future, time.monotonic()))
         return future
+
+    def _release_admission(self, _future) -> None:
+        with self._admit_lock:
+            self._outstanding -= 1
 
     def score(self, record: Dict[str, Any],
               timeout_s: Optional[float] = 30.0) -> Dict[str, Any]:
         """Submit + wait: the blocking single-record convenience API."""
         return self.submit(record).result(timeout_s).output
 
-    # ---- dispatch ----------------------------------------------------------
+    # ---- collect + route ---------------------------------------------------
     def _loop(self) -> None:
         while self._running:
             try:
@@ -127,36 +175,80 @@ class MicroBatcher:
                     batch.append(self._queue.get(timeout=remaining))
                 except queue.Empty:
                     break
-            self._dispatch(batch)
+            self._slot_queues[self._pick_slot()].put(batch)
 
-    def _dispatch(self, batch: List[_Pending]) -> None:
-        try:
-            entry = self.registry.active()
-        except LookupError as e:
+    def _pick_slot(self) -> int:
+        """Least-outstanding-work routing: queued batches + in-flight work."""
+        slots = self.registry.slots()
+        best, best_load = 0, None
+        for i, q in enumerate(self._slot_queues):
+            load = q.qsize()
+            rep = slots[i] if i < len(slots) else None
+            if rep is not None:
+                load += rep.inflight
+            if best_load is None or load < best_load:
+                best, best_load = i, load
+        return best
+
+    # ---- per-replica dispatch ----------------------------------------------
+    def _worker(self, slot: int) -> None:
+        q = self._slot_queues[slot]
+        while True:
+            batch = q.get()
+            if batch is None:  # stop() sentinel
+                break
+            self._dispatch(slot, batch)
+
+    def _acquire_replica(self, slot: int):
+        """Enter the slot's current replica's in-flight guard, swap-safely."""
+        while True:
+            rep = self.registry.replica(slot)
+            if rep is None:
+                return None, None
+            ctx = rep.in_flight()
+            ctx.__enter__()
+            if self.registry.replica(slot) is rep:
+                return rep, ctx
+            # a rolling swap replaced this slot between fetch and guard
+            ctx.__exit__(None, None, None)
+
+    def _dispatch(self, slot: int, batch: List[_Pending]) -> None:
+        rep, ctx = self._acquire_replica(slot)
+        if rep is None:
+            try:
+                self.registry.active()  # raises with the useful message
+                err: Exception = RuntimeError(f"replica slot {slot} is empty")
+            except LookupError as e:
+                err = e
             for p in batch:
-                p.future.set_exception(e)
+                p.future.set_exception(err)
             self.metrics.inc("errors", len(batch))
             return
+        entry = rep.owner
         n = len(batch)
         bucket = bucket_for(n, entry.buckets)
         records = [p.record for p in batch] + [{} for _ in range(bucket - n)]
         t0 = time.monotonic()
-        with trace.span("serve.batch", records=n, bucket=bucket,
-                        version=entry.version):
-            with entry.in_flight():
+        try:
+            with trace.span("serve.batch", records=n, bucket=bucket,
+                            version=entry.version, replica=rep.id):
                 try:
-                    outputs = entry.batch(records)[:n]
+                    outputs = rep.score(records)[:n]
                 except Exception:
                     outputs = self._fallback(entry, batch)
+        finally:
+            ctx.__exit__(None, None, None)
         batch_ms = (time.monotonic() - t0) * 1000.0
-        self.metrics.observe_batch(batch_ms, n, bucket)
+        self.metrics.observe_batch(batch_ms, n, bucket, replica=rep.slot,
+                                   device=str(rep.device))
         done = time.monotonic()
         for p, out in zip(batch, outputs):
             if isinstance(out, Exception):
                 self.metrics.inc("errors")
                 p.future.set_exception(out)
             else:
-                self.metrics.observe_request((done - p.enqueued_at) * 1000.0)
+                self.metrics.observe_request((done - p.enqueued_at) * 1000.0,
+                                             replica=rep.slot)
                 # queue wait + batch + resolution, timeline-aligned with the
                 # serve.batch span (same monotonic origin)
                 trace.complete("serve.request", p.enqueued_at, done,

@@ -26,7 +26,7 @@ from typing import Any, Callable, Dict
 from ..obs import registry as obs_registry
 from ..obs.registry import LogHistogram as LatencyHistogram
 
-__all__ = ["LatencyHistogram", "ServeMetrics"]
+__all__ = ["LatencyHistogram", "ServeMetrics", "prometheus_replica_text"]
 
 #: live ServeMetrics instances, merged by the "serve" snapshot provider.
 #: Weak so a torn-down batcher's metrics don't outlive it in snapshots.
@@ -59,27 +59,54 @@ class ServeMetrics:
         self.swaps = 0
         self.request_latency = LatencyHistogram()
         self.batch_latency = LatencyHistogram()
+        #: per-replica-slot breakdowns (merged totals above stay the
+        #: backward-compatible view; these add the labelled one)
+        self.replica_stats: Dict[int, Dict[str, Any]] = {}
         #: gauges polled at snapshot time (e.g. live queue depth)
         self._gauges: Dict[str, Callable[[], Any]] = {}
         _instances.add(self)
+
+    def _replica(self, slot: int, device: str = "") -> Dict[str, Any]:
+        """Per-slot accumulator (callers hold ``self._lock``)."""
+        st = self.replica_stats.get(slot)
+        if st is None:
+            st = {"device": device, "batches": 0, "records": 0,
+                  "responses": 0, "padded_rows": 0,
+                  "request_latency": LatencyHistogram(),
+                  "batch_latency": LatencyHistogram()}
+            self.replica_stats[slot] = st
+        elif device and not st["device"]:
+            st["device"] = device
+        return st
 
     # ---- mutators ----------------------------------------------------------
     def inc(self, name: str, by: int = 1) -> None:
         with self._lock:
             setattr(self, name, getattr(self, name) + by)
 
-    def observe_request(self, ms: float) -> None:
+    def observe_request(self, ms: float, replica: int = None) -> None:
         with self._lock:
             self.responses += 1
             self.request_latency.record(ms)
+            if replica is not None:
+                st = self._replica(replica)
+                st["responses"] += 1
+                st["request_latency"].record(ms)
 
-    def observe_batch(self, ms: float, n_records: int, bucket: int) -> None:
+    def observe_batch(self, ms: float, n_records: int, bucket: int,
+                      replica: int = None, device: str = "") -> None:
         with self._lock:
             self.batches += 1
             self.occupancy_sum += n_records
             self.padded_rows += bucket - n_records
             self.bucket_counts[bucket] = self.bucket_counts.get(bucket, 0) + 1
             self.batch_latency.record(ms)
+            if replica is not None:
+                st = self._replica(replica, device)
+                st["batches"] += 1
+                st["records"] += n_records
+                st["padded_rows"] += bucket - n_records
+                st["batch_latency"].record(ms)
 
     def add_gauge(self, name: str, fn: Callable[[], Any]) -> None:
         with self._lock:
@@ -98,6 +125,16 @@ class ServeMetrics:
                 acc["bucket_counts"][b] = acc["bucket_counts"].get(b, 0) + c
             acc["request_latency"].merge(self.request_latency)
             acc["batch_latency"].merge(self.batch_latency)
+            for slot, st in self.replica_stats.items():
+                dst = acc["replicas"].setdefault(slot, {
+                    "device": st["device"], "batches": 0, "records": 0,
+                    "responses": 0, "padded_rows": 0,
+                    "request_latency": LatencyHistogram(),
+                    "batch_latency": LatencyHistogram()})
+                for k in ("batches", "records", "responses", "padded_rows"):
+                    dst[k] += st[k]
+                dst["request_latency"].merge(st["request_latency"])
+                dst["batch_latency"].merge(st["batch_latency"])
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
@@ -117,6 +154,16 @@ class ServeMetrics:
                                   sorted(self.bucket_counts.items())},
                 "request_latency": self.request_latency.to_json(),
                 "batch_latency": self.batch_latency.to_json(),
+                "replicas": {
+                    str(slot): {
+                        "device": st["device"],
+                        "batches": st["batches"],
+                        "records": st["records"],
+                        "responses": st["responses"],
+                        "padded_rows": st["padded_rows"],
+                        "request_latency": st["request_latency"].to_json(),
+                        "batch_latency": st["batch_latency"].to_json(),
+                    } for slot, st in sorted(self.replica_stats.items())},
             }
             gauges = dict(self._gauges)
         for name, fn in gauges.items():
@@ -138,6 +185,7 @@ def merged_snapshot() -> Dict[str, Any]:
     acc["bucket_counts"] = {}
     acc["request_latency"] = LatencyHistogram()
     acc["batch_latency"] = LatencyHistogram()
+    acc["replicas"] = {}
     n = 0
     for m in list(_instances):
         m._merge_into(acc)
@@ -149,8 +197,39 @@ def merged_snapshot() -> Dict[str, Any]:
                             sorted(acc["bucket_counts"].items())}
     acc["request_latency"] = acc["request_latency"].to_json()
     acc["batch_latency"] = acc["batch_latency"].to_json()
+    acc["replicas"] = {
+        str(slot): {**{k: v for k, v in st.items()
+                       if k not in ("request_latency", "batch_latency")},
+                    "request_latency": st["request_latency"].to_json(),
+                    "batch_latency": st["batch_latency"].to_json()}
+        for slot, st in sorted(acc["replicas"].items())}
     acc["instances"] = n
     return acc
+
+
+def prometheus_replica_text(snapshot: Dict[str, Any]) -> str:
+    """Labelled per-replica lines for the Prometheus export.
+
+    The generic ``obs.prometheus_text`` flattener is label-free (dicts
+    name-join), which would explode per-replica series into distinct metric
+    NAMES; proper ``{replica=...,device=...}`` labels keep the series
+    queryable.  ``snapshot`` is a ``ServeMetrics.snapshot()`` (or merged)
+    dict; returns "" when no per-replica traffic has been recorded.
+    """
+    lines = []
+    for slot, st in sorted(snapshot.get("replicas", {}).items()):
+        labels = f'{{replica="{slot}",device="{st.get("device", "")}"}}'
+        for k in ("batches", "records", "responses", "padded_rows"):
+            if k in st:
+                lines.append(f"tmog_serve_replica_{k}{labels} {st[k]}")
+        for hist in ("request_latency", "batch_latency"):
+            hj = st.get(hist) or {}
+            for q in ("count", "mean_ms", "p50_ms", "p99_ms"):
+                v = hj.get(q)
+                if isinstance(v, (int, float)):
+                    lines.append(
+                        f"tmog_serve_replica_{hist}_{q}{labels} {v}")
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 obs_registry.register_provider("serve", merged_snapshot)

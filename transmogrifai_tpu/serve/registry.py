@@ -1,30 +1,39 @@
-"""Versioned model registry with atomic hot-swap.
+"""Versioned model registry: per-chip replicas with rolling atomic hot-swap.
 
-Deploy discipline: **load -> warm -> swap -> drain**.
+Deploy discipline: **load -> warm -> swap -> drain**, now per replica.
 
 1. *load*: the candidate ``OpWorkflowModel`` is wrapped into a
-   ``ServingModel`` (vectorized bucket scorer + numpy row fallback);
-2. *warm*: every shape bucket is scored once with null records so all jit'd
-   XLA computations compile BEFORE the model takes traffic — no request ever
-   pays first-compile latency (the TpuGraphs lesson: recompilation dominates
-   unless shapes are canonicalized up front);
-3. *swap*: one reference assignment under the registry lock — requests
-   dispatched after this point score on the new version;
-4. *drain*: the deploy call blocks until the outgoing version's in-flight
-   batches complete, so the old model's resources can be released and the
-   caller knows no stale-version response is still being produced for
-   post-swap submissions.
+   ``ServingModel`` holding N per-device :class:`Replica` s (N from
+   ``TMOG_SERVE_REPLICAS`` via ``parallel/mesh.serve_devices``, default one
+   per local chip) — each replica carries its own per-bucket AOT score
+   programs (``serve/aot.BucketScorer``) pinned to its device;
+2. *warm*: every replica compiles-or-loads every shape bucket BEFORE the
+   model takes traffic — no request ever pays first-compile latency (the
+   TpuGraphs lesson: recompilation dominates unless shapes are
+   canonicalized up front).  Compiles route through the persistent
+   ``serve/compile_cache``, so a previously-seen model warms from
+   deserialized executables in milliseconds;
+3. *swap*: replica slots are swapped ONE AT A TIME (rolling), each a single
+   reference assignment under the registry lock — the other N-1 slots keep
+   serving their current version throughout, so capacity never drops to
+   zero mid-deploy;
+4. *drain*: after each slot swap the deploy call blocks until the outgoing
+   replica's in-flight batches complete; when ``deploy`` returns, no
+   stale-version response can be produced for post-swap submissions.
 
-A failed warmup aborts the deploy and leaves the active model untouched.
+A failed warmup aborts the deploy and leaves every active replica
+untouched.
 """
 from __future__ import annotations
 
 import contextlib
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence
 
 from ..local.scoring import BatchScoreFunction, ScoreFunction
+from ..obs import registry as obs_registry
 from ..obs import trace
 from ..workflow.model import OpWorkflowModel
 from .metrics import ServeMetrics
@@ -51,27 +60,70 @@ def bucket_for(n: int, buckets: Sequence[int]) -> int:
     return buckets[-1]
 
 
-class ServingModel:
-    """One deployed model version: bucket scorer, row fallback, drain state."""
+class Replica:
+    """One per-device copy of a deployed version: AOT bucket programs (when
+    the DAG supports them), its own in-flight count, and drain state."""
 
-    def __init__(self, version: str, model: OpWorkflowModel,
-                 buckets: Sequence[int]):
-        self.version = version
-        self.model = model
-        self.batch = BatchScoreFunction(model)
-        self.row = ScoreFunction(model)
-        self.buckets = list(buckets)
-        self.deployed_at_ms: Optional[int] = None
+    def __init__(self, owner: "ServingModel", slot: int, device):
+        self.owner = owner
+        self.slot = slot
+        self.device = device
+        self.scorer = None
         self.warmed = False
         self._cond = threading.Condition()
         self._inflight = 0
+        if device is not None:
+            try:
+                from .aot import AotUnsupported, BucketScorer
 
-    def warmup(self) -> None:
-        """Score null records at every bucket size (compiles all shapes)."""
-        with trace.span("serve.warmup", version=self.version,
-                        buckets=len(self.buckets)):
-            for b in self.buckets:
-                self.batch([{} for _ in range(b)])
+                self.scorer = BucketScorer(owner.model, owner.buckets, device)
+            except AotUnsupported as e:
+                obs_registry.record_fallback(
+                    "serve", "aot_unsupported", version=owner.version,
+                    slot=slot, error=str(e))
+            except Exception as e:  # noqa: BLE001 — generic path still serves
+                obs_registry.record_fallback(
+                    "serve", "aot_scorer_failed", version=owner.version,
+                    slot=slot, error=repr(e))
+
+    @property
+    def id(self) -> str:
+        return f"{self.owner.version}/{self.slot}"
+
+    def score(self, records):
+        """Bucket-padded records -> outputs, on this replica's device.
+
+        The AOT path is used only while the owner's ``batch`` callable is
+        the pristine default — wrapping/replacing ``entry.batch``
+        (instrumentation, tests) routes every replica through it instead.
+        """
+        owner = self.owner
+        if self.scorer is not None and owner.batch is owner._default_batch:
+            return self.scorer(records)
+        if self.device is None:
+            return owner.batch(records)
+        import jax
+
+        with jax.default_device(self.device):
+            return owner.batch(records)
+
+    def warm(self) -> None:
+        """Compile/load + prime every bucket on this replica's device.
+
+        The AOT scorer needs exactly one null score per replica (its host
+        shape is canonicalized to the largest bucket); the generic path
+        must score every bucket to populate jit's per-shape caches."""
+        if self.scorer is not None:
+            self.scorer.warm()
+        elif self.device is None:
+            for b in self.owner.buckets:
+                self.owner.batch([{} for _ in range(b)])
+        else:
+            import jax
+
+            with jax.default_device(self.device):
+                for b in self.owner.buckets:
+                    self.owner.batch([{} for _ in range(b)])
         self.warmed = True
 
     @contextlib.contextmanager
@@ -91,49 +143,144 @@ class ServingModel:
             return self._inflight
 
     def drain(self, timeout_s: Optional[float] = 30.0) -> bool:
-        """Block until no batch is scoring on this version; True if drained."""
+        """Block until no batch is scoring on this replica; True if drained."""
         deadline = None if timeout_s is None else time.monotonic() + timeout_s
         with self._cond:
             while self._inflight > 0:
-                remaining = None if deadline is None else deadline - time.monotonic()
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
                     return False
                 self._cond.wait(remaining)
         return True
 
 
+class ServingModel:
+    """One deployed model version: N device replicas + the generic host
+    scorer (``batch``) that doubles as the per-replica fallback/override."""
+
+    def __init__(self, version: str, model: OpWorkflowModel,
+                 buckets: Sequence[int], devices: Optional[Sequence] = None):
+        self.version = version
+        self.model = model
+        self.batch = BatchScoreFunction(model)
+        self._default_batch = self.batch
+        self.row = ScoreFunction(model)
+        self.buckets = list(buckets)
+        if devices is None:
+            from ..parallel.mesh import serve_devices
+
+            devices = serve_devices()
+        self.devices = list(devices)
+        self.replicas = [Replica(self, i, d)
+                         for i, d in enumerate(self.devices)]
+        self.deployed_at_ms: Optional[int] = None
+        self.warmed = False
+
+    def warmup(self) -> None:
+        """Warm every replica (concurrently — like ``ops/sweep``'s per-shard
+        AOT pool, the wall is one replica's warm, not the sum)."""
+        with trace.span("serve.warmup", version=self.version,
+                        buckets=len(self.buckets),
+                        replicas=len(self.replicas)):
+            if len(self.replicas) == 1:
+                self.replicas[0].warm()
+            else:
+                with ThreadPoolExecutor(
+                        max_workers=len(self.replicas),
+                        thread_name_prefix="serve-warm") as pool:
+                    # list() re-raises the first failure -> deploy aborts
+                    list(pool.map(lambda r: r.warm(), self.replicas))
+        self.warmed = True
+
+    @property
+    def inflight(self) -> int:
+        return sum(r.inflight for r in self.replicas)
+
+    @contextlib.contextmanager
+    def in_flight(self):
+        """Version-level in-flight guard (single-replica legacy callers)."""
+        with self.replicas[0].in_flight():
+            yield self
+
+    def drain(self, timeout_s: Optional[float] = 30.0) -> bool:
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        for r in self.replicas:
+            remaining = None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+            if not r.drain(None if deadline is None else remaining):
+                return False
+        return True
+
+
 class ModelRegistry:
-    """Holds the active ``ServingModel`` plus deploy history."""
+    """Versioned models behind N fixed replica slots (rolling hot-swap)."""
 
     def __init__(self, max_batch: int = DEFAULT_MAX_BATCH,
-                 metrics: Optional[ServeMetrics] = None):
+                 metrics: Optional[ServeMetrics] = None,
+                 replicas: Optional[int] = None,
+                 devices: Optional[Sequence] = None):
         self.buckets = shape_buckets(max_batch)
         self.metrics = metrics
         self._lock = threading.Lock()
         self._active: Optional[ServingModel] = None
         self._history: List[str] = []
+        if devices is None:
+            from ..parallel.mesh import serve_devices
+
+            devices = serve_devices(replicas)
+        self.devices = list(devices)
+        self._slots: List[Optional[Replica]] = [None] * len(self.devices)
+
+    @property
+    def n_replicas(self) -> int:
+        return len(self._slots)
+
+    def replica(self, slot: int) -> Optional[Replica]:
+        """Current occupant of one slot (None before the first deploy)."""
+        with self._lock:
+            return self._slots[slot]
+
+    def slots(self) -> List[Optional[Replica]]:
+        with self._lock:
+            return list(self._slots)
 
     def deploy(self, model: OpWorkflowModel, version: Optional[str] = None,
                warm: bool = True, drain_timeout_s: Optional[float] = 30.0
                ) -> ServingModel:
-        """load -> warm -> swap -> drain; returns the now-active version."""
+        """load -> warm -> rolling per-slot swap+drain; returns the active
+        version.  Capacity never drops: every slot keeps its current replica
+        until the moment its replacement (already warmed) is installed."""
         with self._lock:
             version = version or f"v{len(self._history) + 1}"
             if version in self._history:
                 raise ValueError(f"Version {version!r} already deployed")
-        entry = ServingModel(version, model, self.buckets)
+        entry = ServingModel(version, model, self.buckets,
+                             devices=self.devices)
         if warm:
-            entry.warmup()  # raises -> deploy aborted, active model untouched
-        with trace.span("serve.swap", version=version):
+            entry.warmup()  # raises -> deploy aborted, active slots untouched
+        with trace.span("serve.swap", version=version,
+                        replicas=len(entry.replicas)):
             with self._lock:
+                first = self._active is None
+                if first:
+                    # nothing serving yet: installing the slots before the
+                    # version flips keeps active() and replica() consistent
+                    self._slots = list(entry.replicas)
                 old, self._active = self._active, entry
                 entry.deployed_at_ms = int(time.time() * 1000)
                 self._history.append(version)
             if self.metrics is not None:
                 self.metrics.inc("swaps")
+            if not first:
+                for i, rep in enumerate(entry.replicas):
+                    with self._lock:
+                        old_rep, self._slots[i] = self._slots[i], rep
+                    if old_rep is not None:
+                        with trace.span("serve.drain", replica=old_rep.id):
+                            old_rep.drain(drain_timeout_s)
         if old is not None:
-            with trace.span("serve.drain", version=old.version):
-                old.drain(drain_timeout_s)
+            old.drain(drain_timeout_s)  # belt-and-braces for legacy guards
         return entry
 
     def active(self) -> ServingModel:
@@ -152,11 +299,19 @@ class ModelRegistry:
 
     def info(self) -> Dict[str, object]:
         with self._lock:
-            return {
-                "active": None if self._active is None else self._active.version,
-                "warmed": bool(self._active and self._active.warmed),
-                "deployed_at_ms": (None if self._active is None
-                                   else self._active.deployed_at_ms),
-                "versions": list(self._history),
-                "buckets": list(self.buckets),
-            }
+            slots = list(self._slots)
+            active = self._active
+        return {
+            "active": None if active is None else active.version,
+            "warmed": bool(active and active.warmed),
+            "deployed_at_ms": (None if active is None
+                               else active.deployed_at_ms),
+            "versions": list(self._history),
+            "buckets": list(self.buckets),
+            "replicas": len(slots),
+            "replica_info": [
+                None if r is None else {
+                    "id": r.id, "slot": r.slot, "device": str(r.device),
+                    "aot": r.scorer is not None, "inflight": r.inflight}
+                for r in slots],
+        }

@@ -29,7 +29,7 @@ from urllib.parse import parse_qs, urlsplit
 
 from .. import obs
 from .batcher import MicroBatcher, ShedError
-from .metrics import ServeMetrics
+from .metrics import ServeMetrics, prometheus_replica_text
 from .registry import ModelRegistry
 
 
@@ -67,7 +67,12 @@ class ModelServer:
             return self
         self.batcher.start()
         handler = _make_handler(self)
-        self._httpd = ThreadingHTTPServer((self._host, self._port), handler)
+        # stdlib default listen backlog is 5: a fleet-sized burst of
+        # concurrent connects gets kernel RSTs before accept() catches up.
+        # Shedding is the batcher's job — the listener must keep accepting.
+        server_cls = type("_ModelHTTPServer", (ThreadingHTTPServer,),
+                          {"request_queue_size": 128})
+        self._httpd = server_cls((self._host, self._port), handler)
         self._httpd.daemon_threads = True
         self._port = self._httpd.server_address[1]
         self._stopped.clear()
@@ -140,8 +145,12 @@ def _make_handler(server: "ModelServer"):
                 fmt = parse_qs(url.query).get("format", [""])[0]
                 if fmt == "prometheus":
                     # the unified registry (sweep/stream/flops/serve), text
-                    # exposition — same numbers as the JSON payload
-                    self._reply_text(200, obs.prometheus_text(obs.snapshot()))
+                    # exposition — same numbers as the JSON payload — plus
+                    # properly-labelled per-replica series (the generic
+                    # flattener is label-free)
+                    text = obs.prometheus_text(obs.snapshot())
+                    text += prometheus_replica_text(server.metrics.snapshot())
+                    self._reply_text(200, text)
                     return
                 self._reply(200, {"serve": server.metrics.snapshot(),
                                   "registry": server.registry.info()})

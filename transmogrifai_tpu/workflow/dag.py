@@ -69,6 +69,8 @@ class FittedDAG:
 _FUSED_JIT: "collections.OrderedDict[Tuple[int, ...], Tuple[object, list]]" = \
     __import__("collections").OrderedDict()
 _FUSED_JIT_MAX = 32
+# serving replicas score through this cache concurrently
+_FUSED_JIT_LOCK = __import__("threading").Lock()
 
 
 def _fusable(t, ds: Dataset) -> bool:
@@ -143,7 +145,10 @@ def _fused_layer(ds: Dataset, fusables: Sequence[Transformer]) -> Dict[str, Any]
                         lambda c=col: jnp.asarray(c.values, jnp.float32)))
         stage_pos.append(tuple(idxs))
     key = (tuple(id(t) for t in fusables), tuple(stage_pos))
-    cached = _FUSED_JIT.get(key)
+    with _FUSED_JIT_LOCK:
+        cached = _FUSED_JIT.get(key)
+        if cached is not None:
+            _FUSED_JIT.move_to_end(key)
     if cached is None:
         ts = list(fusables)
         sp = tuple(stage_pos)
@@ -152,12 +157,11 @@ def _fused_layer(ds: Dataset, fusables: Sequence[Transformer]) -> Dict[str, Any]
             return [t.jax_transform(*(args[i] for i in idxs))
                     for t, idxs in zip(ts, sp)]
 
-        cached = (jax.jit(fused), ts)  # ts ref pins ids against gc reuse
-        _FUSED_JIT[key] = cached
-        while len(_FUSED_JIT) > _FUSED_JIT_MAX:
-            _FUSED_JIT.popitem(last=False)
-    else:
-        _FUSED_JIT.move_to_end(key)
+        built = (jax.jit(fused), ts)  # ts ref pins ids against gc reuse
+        with _FUSED_JIT_LOCK:
+            cached = _FUSED_JIT.setdefault(key, built)
+            while len(_FUSED_JIT) > _FUSED_JIT_MAX:
+                _FUSED_JIT.popitem(last=False)
     outs = cached[0](flat)
     new_cols = {}
     for t, out in zip(fusables, outs):

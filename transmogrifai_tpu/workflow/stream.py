@@ -41,6 +41,7 @@ the transfer-wait share of wall time (overlap efficiency).
 from __future__ import annotations
 
 import os
+import threading
 import time
 import warnings
 import weakref
@@ -338,47 +339,59 @@ def build_plan(ds: Dataset, layers: Sequence[Sequence[Any]],
 # ---------------------------------------------------------------------------
 _PROGRAMS: "OrderedDict[Tuple, Tuple[Any, List[_StreamStage]]]" = OrderedDict()
 _PROGRAMS_MAX = 16
+# serve replicas warm concurrently against the shared program cache
+_PROGRAMS_LOCK = threading.Lock()
 
 
 def _program_for(plan: StreamPlan):
     import jax
 
-    cached = _PROGRAMS.get(plan.key)
-    if cached is None:
-        stages = list(plan.stages)
+    with _PROGRAMS_LOCK:
+        cached = _PROGRAMS.get(plan.key)
+        if cached is not None:
+            _PROGRAMS.move_to_end(plan.key)
+            return cached[0]
+    stages = list(plan.stages)
 
-        def program(args):
-            env: Dict[str, Any] = {}
-            outs: Dict[str, Any] = {}
-            for si, e in enumerate(stages):
-                if e.prep:
-                    call = list(args[f"p{si}"])
-                else:
-                    call = []
-                    for kind, nm in e.arg_specs:
-                        if kind == "iv":
-                            call.append(env[nm])
-                        elif kind == "inv":
-                            call.append(env[nm][0])
-                        elif kind == "inm":
-                            call.append(env[nm][1])
-                        else:
-                            call.append(args[f"{kind}:{nm}"])
-                res = e.stage.jax_transform(*call)
-                env[e.out_name] = res
-                if e.terminal:
-                    outs[e.out_name] = res
-            return outs
+    def program(args):
+        env: Dict[str, Any] = {}
+        outs: Dict[str, Any] = {}
+        for si, e in enumerate(stages):
+            if e.prep:
+                call = list(args[f"p{si}"])
+            else:
+                call = []
+                for kind, nm in e.arg_specs:
+                    if kind == "iv":
+                        call.append(env[nm])
+                    elif kind == "inv":
+                        call.append(env[nm][0])
+                    elif kind == "inm":
+                        call.append(env[nm][1])
+                    else:
+                        call.append(args[f"{kind}:{nm}"])
+            res = e.stage.jax_transform(*call)
+            env[e.out_name] = res
+            if e.terminal:
+                outs[e.out_name] = res
+        return outs
 
-        # donated inputs: each chunk's upload buffers are dead after the
-        # launch, so XLA may write outputs over them
-        cached = (jax.jit(program, donate_argnums=(0,)), stages)
-        _PROGRAMS[plan.key] = cached
+    # donated inputs: each chunk's upload buffers are dead after the
+    # launch, so XLA may write outputs over them
+    built = (jax.jit(program, donate_argnums=(0,)), stages)
+    with _PROGRAMS_LOCK:
+        cached = _PROGRAMS.setdefault(plan.key, built)
         while len(_PROGRAMS) > _PROGRAMS_MAX:
             _PROGRAMS.popitem(last=False)
-    else:
-        _PROGRAMS.move_to_end(plan.key)
     return cached[0]
+
+
+def program_for(plan: StreamPlan):
+    """The jitted per-chunk program for one plan (serve AOT entry point).
+
+    Returned callable takes the dict built by :func:`chunk_args` and is
+    safe to ``.lower()`` against device-committed arguments."""
+    return _program_for(plan)
 
 
 def _cache_size(jitted) -> Optional[int]:
@@ -445,6 +458,14 @@ def _host_chunk_args(plan: StreamPlan, ds: Dataset, lo: int, hi: int,
             nbytes += a.nbytes
         args[f"p{si}"] = preps
     return args, nbytes
+
+
+def chunk_args(plan: StreamPlan, ds: Dataset, lo: int, hi: int,
+               C: int) -> Tuple[Dict[str, Any], float]:
+    """Padded host argument dict for one chunk (serve AOT entry point):
+    rows [lo, hi) of ``ds`` zero-padded to the constant chunk shape ``C``.
+    Returns ``(args, upload_bytes)``."""
+    return _host_chunk_args(plan, ds, lo, hi, C)
 
 
 # ---------------------------------------------------------------------------
