@@ -404,6 +404,61 @@ def make_selector(seed: int = 42):
         num_folds=3, seed=seed)
 
 
+def continual_bench():
+    """``bench.py --continual [rows]``: warm-start retrain vs cold sweep wall.
+
+    The continual-learning acceptance pair: a drift-triggered retrain prunes
+    the selector grid to the incumbent winner's neighborhood
+    (``ModelSelector.warm_start``), so its wall must be a fraction of the
+    cold full-grid sweep that elected the champion.  Times both on the same
+    synthetic two-era data the closed-loop harness uses and reports the
+    speedup plus pruned-vs-full candidate counts.  CPU-proxy friendly.
+    """
+    from tools.continual_loop import _build, _workflow
+    from transmogrifai_tpu.continual import incumbent_summary
+
+    platform, fallback = init_backend()
+    rows = next((int(a) for a in sys.argv[2:] if a.isdigit()), 256)
+
+    ds_a, feats_a = _build(rows, 0.0)
+    wf_cold = _workflow(ds_a, feats_a, 3)
+    sel = next(s for s in wf_cold.stages
+               if getattr(s, "is_model_selector", False))
+    full = sum(len(g) for _, g in sel.models)
+    t0 = time.perf_counter()
+    champion = wf_cold.train()
+    cold_s = time.perf_counter() - t0
+
+    summary = incumbent_summary(champion)
+    ds_b, feats_b = _build(rows, 3.0)
+    wf_warm = _workflow(ds_b, feats_b, 3)
+    sel_warm = next(s for s in wf_warm.stages
+                    if getattr(s, "is_model_selector", False))
+    sel_warm.warm_start(summary, explore=1)
+    pruned, _ = sel_warm.validator.warm_start_counts
+    t0 = time.perf_counter()
+    wf_warm.train()
+    warm_s = time.perf_counter() - t0
+
+    report = {
+        "metric": "continual_warm_retrain_speedup",
+        "value": round(cold_s / warm_s, 2) if warm_s else None,
+        "unit": f"x wall, {pruned}-grid warm retrain vs {full}-grid cold",
+        "rows": rows,
+        "cold_sweep_wall_s": round(cold_s, 3),
+        "warm_retrain_wall_s": round(warm_s, 3),
+        "full_candidates": full,
+        "pruned_candidates": pruned,
+        "incumbent": summary.best_model_type if summary else None,
+        "platform": platform,
+        **({"backend_fallback": fallback} if fallback else {}),
+    }
+    print(json.dumps(report))
+    from transmogrifai_tpu import obs
+
+    obs.write_record("bench", extra={"report": report})
+
+
 def family_flops_breakdown(sel, X, y, train_w, val_mask):
     """Per-family single-launch XLA flops of the default sweep (LR/RF/XGB).
 
@@ -591,5 +646,7 @@ if __name__ == "__main__":
         transform_bench()
     elif "--serve" in sys.argv:
         serve_bench()
+    elif "--continual" in sys.argv:
+        continual_bench()
     else:
         main()

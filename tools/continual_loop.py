@@ -1,0 +1,184 @@
+"""Closed-loop continual-learning demo with synthetic covariate drift.
+
+Trains a champion with a full (cold) ModelSelector sweep on "era A" data,
+deploys it to a ModelRegistry, then drives era-B traffic — the numeric
+feature shifted by ``--shift`` — through the micro-batcher so the serve-path
+drift sketch fills up.  The RetrainController sees the JS divergence breach,
+triggers a warm-started retrain on the recent window (the selector grid
+pruned to the incumbent's neighborhood), gates the challenger against the
+champion on the window's trailing holdout, and promotes it via the rolling
+zero-gap hot-swap.  With ``--force-regression`` the freshly promoted
+challenger is then sabotaged (its score paths raise), post-swap traffic
+regresses, and the loop rolls back to the champion.
+
+Prints one JSON line — cold vs warm sweep wall, pruned vs full candidate
+counts, every loop decision, and capacity samples proving the swap never
+dropped to zero replicas — and appends it as a schema-versioned JSONL run
+record (kind="continual_loop").
+
+    python tools/continual_loop.py --rows 192 --shift 3.0
+    python tools/continual_loop.py --force-regression
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _era_values(n: int, shift: float):
+    """(x, cat, y) lists for one era: label flips where x crosses the era's
+    own center, so a model fit on era A is genuinely wrong about era B."""
+    import numpy as np
+
+    xs = list(np.linspace(-2.0, 2.0, n) + shift)
+    cats = (["a", "b", "c", "d"] * ((n + 3) // 4))[:n]
+    ys = [1.0 if x > shift else 0.0 for x in xs]
+    return xs, cats, ys
+
+
+def _build(n: int, shift: float):
+    """(dataset, (x, cat, y) features) for one era."""
+    import transmogrifai_tpu.types as T
+    from transmogrifai_tpu.testkit import TestFeatureBuilder
+
+    xs, cats, ys = _era_values(n, shift)
+    return TestFeatureBuilder.of(("x", T.Real, xs), ("cat", T.PickList, cats),
+                                 ("y", T.RealNN, ys), response="y")
+
+
+def _workflow(ds, features, num_folds: int):
+    """Fresh selector workflow over (x, cat) -> y on ``ds``."""
+    from transmogrifai_tpu import OpWorkflow
+    from transmogrifai_tpu.impl.feature.vectorizers import (
+        OneHotVectorizer, RealVectorizer, VectorsCombiner)
+    from transmogrifai_tpu.impl.selector.factories import (
+        BinaryClassificationModelSelector)
+
+    x, cat, y = features
+    feats = VectorsCombiner().set_input(
+        RealVectorizer().set_input(x).get_output(),
+        OneHotVectorizer(top_k=5, min_support=1).set_input(cat).get_output(),
+    ).get_output()
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=num_folds, splitter=None)
+    pred = sel.set_input(y, feats).get_output()
+    return OpWorkflow().set_input_dataset(ds).set_result_features(pred)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--rows", type=int, default=192, help="rows per era")
+    p.add_argument("--shift", type=float, default=3.0,
+                   help="era-B covariate shift on x")
+    p.add_argument("--num-folds", type=int, default=2)
+    p.add_argument("--force-regression", action="store_true",
+                   help="sabotage the promoted challenger to demonstrate "
+                        "the post-swap rollback path")
+    p.add_argument("--no-record", action="store_true",
+                   help="skip the telemetry JSONL run record")
+    args = p.parse_args(argv)
+
+    from transmogrifai_tpu import obs
+    from transmogrifai_tpu.continual import (ContinualLoop, ControllerConfig,
+                                             GateConfig, RetrainController,
+                                             ServeSketch, baselines_from_model)
+    from transmogrifai_tpu.evaluators import Evaluators
+    from transmogrifai_tpu.serve import ModelRegistry, ServeMetrics
+    from transmogrifai_tpu.serve.batcher import MicroBatcher
+
+    # ---- era A: cold full-grid sweep -> champion ---------------------------
+    ds_a, feats_a = _build(args.rows, 0.0)
+    wf_a = _workflow(ds_a, feats_a, args.num_folds)
+    sel = next(s for s in wf_a.stages
+               if getattr(s, "is_model_selector", False))
+    cold_candidates = sum(len(g) for _, g in sel.models)
+    t0 = time.perf_counter()
+    champion = wf_a.train()
+    cold_wall = time.perf_counter() - t0
+    metrics = ServeMetrics()
+    registry = ModelRegistry(max_batch=32, metrics=metrics)
+    registry.deploy(champion, version="champion")
+    metrics.attach_sketch(ServeSketch(baselines_from_model(champion)))
+
+    # ---- era B traffic through the batcher (fills the drift sketch) -------
+    capacity_samples = []
+
+    def sample_capacity():
+        capacity_samples.append(
+            sum(1 for i in range(registry.n_replicas)
+                if registry.replica(i) is not None))
+
+    batcher = MicroBatcher(registry, max_batch=32, metrics=metrics)
+    batcher.start()
+    xs, cats, _ = _era_values(args.rows, args.shift)
+    futures = [batcher.submit({"x": float(x), "cat": c})
+               for x, c in zip(xs, cats)]
+    for f in futures:
+        f.result(60.0)
+    sample_capacity()
+
+    # ---- the loop: drift -> warm retrain -> gate -> rolling swap -----------
+    ds_b, feats_b = _build(args.rows, args.shift)
+    controller = RetrainController(ControllerConfig(
+        threshold=0.25, hysteresis=1, cooldown_s=0.0, min_count=16))
+    loop = ContinualLoop(
+        registry, metrics,
+        workflow_factory=lambda ds: _workflow(ds, feats_b, args.num_folds),
+        window_provider=lambda: ds_b,
+        evaluator=Evaluators.BinaryClassification.auPR(),
+        controller=controller, gate=GateConfig(epsilon=0.05),
+        holdout_fraction=0.25)
+    outcome = loop.run_once(version="challenger")
+    sample_capacity()
+
+    rollback_version = None
+    if args.force_regression and outcome.get("outcome") == "promote":
+        entry = registry.active()
+        def _boom(*a, **k):
+            raise RuntimeError("injected post-swap regression")
+        entry.batch = _boom   # forces every replica off the AOT path...
+        entry.row = _boom     # ...and poisons the per-record fallback too
+        for x, c in zip(xs, cats):
+            try:
+                batcher.submit({"x": float(x), "cat": c}).result(60.0)
+            except Exception:
+                pass
+        rollback_version = loop.check_rollback()
+        sample_capacity()
+    batcher.stop()
+
+    retrain = outcome.get("retrain") or {}
+    out = {
+        "probe": "continual_loop",
+        "rows": args.rows, "shift": args.shift,
+        "cold_sweep_wall_s": round(cold_wall, 4),
+        "cold_candidates": cold_candidates,
+        "warm_retrain_wall_s": retrain.get("wall_s"),
+        "pruned_candidates": retrain.get("pruned_candidates"),
+        "full_candidates": retrain.get("full_candidates"),
+        "outcome": outcome.get("outcome"),
+        "gate": outcome.get("gate"),
+        "decision": outcome.get("decision"),
+        "promoted_version": outcome.get("version"),
+        "rollback_version": rollback_version,
+        "capacity_samples": capacity_samples,
+        "capacity_never_zero": bool(capacity_samples)
+        and min(capacity_samples) > 0,
+        "drift": metrics.snapshot().get("drift", {}),
+        "continual": obs.REGISTRY.scope("continual").snapshot(),
+    }
+    print(json.dumps(out))
+    if not args.no_record:
+        obs.write_record("continual_loop", extra=out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

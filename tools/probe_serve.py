@@ -94,6 +94,13 @@ def main(argv=None) -> int:
                         "TMOG_COMPILE_CACHE for this run)")
     p.add_argument("--no-record", action="store_true",
                    help="skip the telemetry JSONL run record")
+    p.add_argument("--drift-shift", type=float, default=0.0,
+                   help="add this offset to every numeric field of the "
+                        "scored record partway through the run (synthetic "
+                        "covariate drift for the continual-learning gauge)")
+    p.add_argument("--drift-after", type=float, default=None,
+                   help="seconds into the run before the shift kicks in "
+                        "(default: half the duration)")
     args = p.parse_args(argv)
 
     if args.compile_cache:
@@ -120,9 +127,19 @@ def main(argv=None) -> int:
     registry.deploy(model)
     warm_s = time.perf_counter() - t_warm
     warm_cache = compile_cache.cache_stats()
+    # serve-path drift sketch: scored records fold into per-feature
+    # histograms compared against the model's training baselines, surfaced
+    # as /metrics "drift" (the continual-learning trigger signal)
+    from transmogrifai_tpu.continual import ServeSketch, baselines_from_model
+
+    server.metrics.attach_sketch(ServeSketch(baselines_from_model(model)))
     server.start()
     url = f"{server.url}/score"
     payload = json.dumps(record).encode()
+    shifted = {k: (v + args.drift_shift
+                   if isinstance(v, (int, float)) and not isinstance(v, bool)
+                   else v) for k, v in record.items()}
+    shifted_payload = json.dumps(shifted).encode()
 
     latencies_ms: list = []
     shed = [0]
@@ -130,13 +147,18 @@ def main(argv=None) -> int:
     count = [0]
     lock = threading.Lock()
     stop_at = time.monotonic() + args.duration
+    drift_at = stop_at - args.duration + (
+        args.drift_after if args.drift_after is not None
+        else args.duration / 2.0)
 
     def client():
         local_lat, local_shed, local_err, local_n = [], 0, 0, 0
         while time.monotonic() < stop_at:
+            body = shifted_payload if args.drift_shift and \
+                time.monotonic() >= drift_at else payload
             t0 = time.perf_counter()
             try:
-                req = urllib.request.Request(url, data=payload,
+                req = urllib.request.Request(url, data=body,
                                              headers={"Content-Type": "application/json"})
                 with urllib.request.urlopen(req, timeout=30) as resp:
                     resp.read()
@@ -188,6 +210,9 @@ def main(argv=None) -> int:
         "compile_cache": {k: warm_cache.get(k) for k in
                           ("hits", "misses", "compiles", "compile_s",
                            "load_s", "saves", "save_errors")},
+        "drift_shift": args.drift_shift,
+        "drift": server_metrics["serve"].get("drift", {}),
+        "continual": server_metrics.get("continual", {}),
         "server_metrics": server_metrics["serve"],
     }
     print(json.dumps(out))

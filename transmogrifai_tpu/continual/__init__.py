@@ -1,0 +1,32 @@
+"""Continual learning: drift-triggered warm-start retrain with gated hot-swap.
+
+The serve path cheaply sketches incoming feature values and emitted
+predictions (``drift.ServeSketch``, mergeable across replicas like every
+other serve metric); ``controller.RetrainController`` compares those
+sketches against the training-time ``FeatureDistribution`` baselines and —
+with hysteresis and a cooldown — decides when drift warrants a retrain.
+``loop.ContinualLoop`` then retrains a fresh workflow on the recent window
+with the model-selector grid warm-started from the incumbent's winning
+spec, gates the challenger against the champion on a recent-window holdout
+(``promote.decide``), promotes via the registry's zero-gap rolling
+hot-swap, and rolls back automatically if post-swap serve metrics regress.
+
+Every decision is recorded in the ``"continual"`` obs scope and in the
+per-run JSONL records.
+"""
+from .controller import ControllerConfig, Decision, RetrainController, scope
+from .drift import (DEFAULT_BINS, PREDICTION_KEY, ServeSketch,
+                    baselines_from_model, drift_scores, merged_distributions,
+                    prediction_distribution)
+from .loop import ContinualLoop, incumbent_summary
+from .promote import (GateConfig, GateResult, decide, evaluate_pair, promote,
+                      rollback_if_regressed)
+
+__all__ = [
+    "ControllerConfig", "Decision", "RetrainController", "scope",
+    "DEFAULT_BINS", "PREDICTION_KEY", "ServeSketch", "baselines_from_model",
+    "drift_scores", "merged_distributions", "prediction_distribution",
+    "ContinualLoop", "incumbent_summary",
+    "GateConfig", "GateResult", "decide", "evaluate_pair", "promote",
+    "rollback_if_regressed",
+]

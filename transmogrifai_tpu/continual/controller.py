@@ -1,0 +1,186 @@
+"""Retrain controller: drift scores -> trigger/skip decisions.
+
+A policy loop, not a scheduler: callers (the ``continual`` run type, the
+``tools/continual_loop.py`` harness, or an external cron) ask ``evaluate()``
+whenever they like; the controller owns the alerting discipline —
+
+- **per-feature thresholds** on the shared JS-divergence score (global
+  ``TMOG_DRIFT_THRESHOLD`` with per-feature overrides) plus a fill-rate
+  delta gate,
+- **minimum evidence**: a feature must have ``TMOG_DRIFT_MIN_COUNT``
+  serve-side observations before its score can breach (a 5-record burst is
+  noise, not drift),
+- **hysteresis**: ``TMOG_DRIFT_HYSTERESIS`` consecutive breaching
+  evaluations before triggering (one bad scrape window must not retrain),
+- **cooldown**: ``TMOG_RETRAIN_COOLDOWN_S`` after a trigger during which
+  further breaches are recorded but not acted on,
+- **predicted cost**: with ``TMOG_COSTMODEL=1`` the learned cost model
+  prices the warm-started retrain before the controller commits, and the
+  prediction rides on the decision record.
+
+Every decision lands in the ``"continual"`` obs scope and (via the loop)
+in JSONL run records — the audit trail IS the product.
+"""
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional
+
+from ..obs import registry as obs_registry
+from ..obs import trace
+from ..utils import env
+
+__all__ = ["ControllerConfig", "Decision", "RetrainController", "scope"]
+
+#: the subsystem's obs scope — every decision type is a counter here
+scope = obs_registry.scope("continual", defaults={
+    "evaluations": 0, "triggers": 0, "skips": 0, "retrains": 0,
+    "promotions": 0, "rejections": 0, "rollbacks": 0,
+    "decisions": [], "last_drift": {}})
+
+
+@dataclass
+class ControllerConfig:
+    """Alerting policy knobs (all env-tunable via ``utils/env.py``)."""
+
+    threshold: float = 0.25         # TMOG_DRIFT_THRESHOLD — JS bits
+    fill_rate_diff: float = 0.50    # TMOG_DRIFT_FILL_DIFF — abs fill delta
+    hysteresis: int = 2             # TMOG_DRIFT_HYSTERESIS — consecutive breaches
+    cooldown_s: float = 300.0       # TMOG_RETRAIN_COOLDOWN_S
+    min_count: int = 64             # TMOG_DRIFT_MIN_COUNT — obs per feature
+    per_feature: Dict[str, float] = field(default_factory=dict)
+
+    @classmethod
+    def from_env(cls) -> "ControllerConfig":
+        return cls(
+            threshold=env.env_float("TMOG_DRIFT_THRESHOLD", 0.25),
+            fill_rate_diff=env.env_float("TMOG_DRIFT_FILL_DIFF", 0.50),
+            hysteresis=env.env_int("TMOG_DRIFT_HYSTERESIS", 2),
+            cooldown_s=env.env_float("TMOG_RETRAIN_COOLDOWN_S", 300.0),
+            min_count=env.env_int("TMOG_DRIFT_MIN_COUNT", 64),
+        )
+
+    def threshold_for(self, feature: str) -> float:
+        return float(self.per_feature.get(feature, self.threshold))
+
+
+@dataclass
+class Decision:
+    """One ``evaluate()`` outcome — JSON-able as-is for obs/records."""
+
+    action: str                      # "trigger" | "skip"
+    reason: str                      # "drift" | "no_drift" | "hysteresis" | "cooldown"
+    breached: Dict[str, float]       # feature -> breaching JS score
+    scores: Dict[str, Dict[str, float]]
+    consecutive: int
+    predicted_cost: Optional[Dict[str, float]] = None
+
+    @property
+    def triggered(self) -> bool:
+        return self.action == "trigger"
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"action": self.action, "reason": self.reason,
+                "breached": dict(self.breached),
+                "consecutive": self.consecutive,
+                "predicted_cost": self.predicted_cost}
+
+
+class RetrainController:
+    """Stateful policy over drift scores; one instance per serving loop."""
+
+    def __init__(self, config: Optional[ControllerConfig] = None,
+                 clock=time.monotonic):
+        self.config = config or ControllerConfig.from_env()
+        self._clock = clock
+        self._consecutive = 0
+        self._last_trigger: Optional[float] = None
+
+    # ---- policy ------------------------------------------------------------
+    def _breaches(self, scores: Mapping[str, Mapping[str, float]]
+                  ) -> Dict[str, float]:
+        cfg = self.config
+        out: Dict[str, float] = {}
+        for name, row in scores.items():
+            if float(row.get("count", 0.0)) < cfg.min_count:
+                continue
+            js = row.get("js")
+            if js is not None and math.isfinite(js) \
+                    and js >= cfg.threshold_for(name):
+                out[name] = float(js)
+            elif float(row.get("fill_rate_diff", 0.0)) >= cfg.fill_rate_diff:
+                out[name] = float(row["fill_rate_diff"])
+        return out
+
+    def in_cooldown(self) -> bool:
+        return self._last_trigger is not None and \
+            (self._clock() - self._last_trigger) < self.config.cooldown_s
+
+    def evaluate(self, scores: Optional[Mapping[str, Mapping[str, float]]] = None,
+                 cost_hints: Optional[Dict[str, Any]] = None) -> Decision:
+        """One policy step.  ``scores`` defaults to the merged serve-side
+        drift gauge (``obs.snapshot()["serve"]["drift"]``); pass them
+        explicitly when driving from a harness."""
+        if scores is None:
+            from ..serve.metrics import merged_snapshot
+
+            scores = merged_snapshot().get("drift") or {}
+        with trace.span("continual.evaluate", features=len(scores)):
+            breached = self._breaches(scores)
+            scope.inc("evaluations")
+            scope.set("last_drift", {k: round(v.get("js", 0.0), 6)
+                                     for k, v in scores.items()})
+            if not breached:
+                self._consecutive = 0
+                decision = Decision("skip", "no_drift", {}, dict(scores), 0)
+            else:
+                self._consecutive += 1
+                if self.in_cooldown():
+                    decision = Decision("skip", "cooldown", breached,
+                                        dict(scores), self._consecutive)
+                elif self._consecutive < self.config.hysteresis:
+                    decision = Decision("skip", "hysteresis", breached,
+                                        dict(scores), self._consecutive)
+                else:
+                    decision = Decision("trigger", "drift", breached,
+                                        dict(scores), self._consecutive,
+                                        self._predict_cost(cost_hints))
+                    self._last_trigger = self._clock()
+                    self._consecutive = 0
+            scope.inc("triggers" if decision.triggered else "skips")
+            scope.append("decisions", decision.to_json())
+        return decision
+
+    # ---- cost prediction ---------------------------------------------------
+    @staticmethod
+    def _predict_cost(hints: Optional[Dict[str, Any]]) -> Optional[Dict[str, float]]:
+        """Price the warm-started retrain with the learned cost model
+        (``TMOG_COSTMODEL=1``).  ``hints`` carries what the controller knows
+        about the pending sweep (rows/features/folds/candidate counts);
+        missing fields degrade to 0 inside the model — an approximate
+        price is still a price."""
+        from .. import costmodel
+
+        if not costmodel.enabled():
+            return None
+        model = costmodel.active_model()
+        if model is None:
+            return None
+        h = dict(hints or {})
+        feat = {
+            "log_rows": math.log1p(max(float(h.get("n_rows", 0)), 0.0)),
+            "log_features": math.log1p(max(float(h.get("n_features", 0)), 0.0)),
+            "n_folds": float(h.get("n_folds", 3)),
+            "n_candidates": float(h.get("n_candidates", 0)),
+        }
+        for fam in ("linear", "mlp", "forest", "gbt"):
+            feat[f"cand_{fam}"] = float(h.get(f"cand_{fam}", 0))
+        try:
+            pred = model.predict(feat)
+        except Exception:  # noqa: BLE001 — a broken artifact must not block
+            obs_registry.record_fallback("continual", "costmodel_predict_failed")
+            return None
+        return {k: float(v) for k, v in pred.items()
+                if isinstance(v, (int, float))}

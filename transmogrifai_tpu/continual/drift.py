@@ -1,0 +1,312 @@
+"""Serve-path drift detection: streaming sketches vs training baselines.
+
+``ServeSketch`` is the serve-side half of the RawFeatureFilter comparison:
+the training run produced per-feature ``FeatureDistribution`` baselines
+(training bin edges, token hash buckets); the serve path folds every scored
+record into a streaming sketch built ON THOSE SAME EDGES, so the
+Jensen-Shannon divergence between the two is the exact arithmetic the
+training-time filter would have computed on the serve traffic (shared
+implementation: ``impl/filters/distribution.py``).
+
+Design constraints, in order:
+
+- **Never hurt the serve path.** ``observe`` is a handful of
+  ``np.searchsorted``/``crc32`` ops per batch under a sketch-local lock;
+  any exception is swallowed by the caller (``ServeMetrics.observe_records``).
+- **Mergeable.** Sketches accumulate pure counts, so merging across
+  replicas/instances is the ``FeatureDistribution.reduce`` monoid — same
+  contract as ``LogHistogram.merge`` for latencies.
+- **Predictions too.** Covariate drift (features) and prediction drift
+  (score outputs) use the same machinery; predictions sketch under the
+  reserved name ``PREDICTION_KEY`` with fixed [0, 1] edges (probability
+  scale) unless a baseline with its own edges is supplied.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..impl.filters.distribution import (
+    FeatureDistribution, _hash_token, _tokens_of, compute_feature_stats)
+
+__all__ = ["PREDICTION_KEY", "ServeSketch", "baselines_from_model",
+           "prediction_distribution", "drift_scores", "merged_distributions"]
+
+#: reserved feature name for the prediction-output sketch
+PREDICTION_KEY = "__prediction__"
+
+#: default serving histogram resolution when a baseline doesn't fix it
+DEFAULT_BINS = 20
+
+FeatureKey = Tuple[str, Optional[str]]
+
+
+def _as_baseline_map(baselines) -> Dict[FeatureKey, FeatureDistribution]:
+    if isinstance(baselines, Mapping):
+        return dict(baselines)
+    return {d.feature_key: d for d in baselines}
+
+
+def _coerce_float(v: Any) -> Optional[float]:
+    """Value -> float or None (null); type drift at serve time -> null,
+    mirroring compute_feature_stats' scoring-side coercion."""
+    if v is None or isinstance(v, bool):
+        return float(v) if isinstance(v, bool) else None
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    return f if np.isfinite(f) else None
+
+
+class _Acc:
+    """One feature's streaming accumulator (caller holds the sketch lock)."""
+
+    __slots__ = ("count", "nulls", "dist", "tok_min", "tok_max")
+
+    def __init__(self, n_slots: int):
+        self.count = 0
+        self.nulls = 0
+        self.dist = np.zeros(n_slots, dtype=np.float64)
+        self.tok_min = float("inf")
+        self.tok_max = float("-inf")
+
+
+class ServeSketch:
+    """Streaming per-feature distribution sketch keyed to training baselines.
+
+    ``baselines`` maps ``(name, key)`` to the training
+    ``FeatureDistribution`` whose edges/buckets the serve-side histogram
+    must reuse.  Numeric baselines (``is_numeric``) bucket values into the
+    training edges plus the trailing invalid bucket; text baselines hash
+    tokens into the same crc32 buckets.
+    """
+
+    def __init__(self, baselines, bins: int = DEFAULT_BINS,
+                 prediction_edges: Optional[np.ndarray] = None):
+        self.baselines = _as_baseline_map(baselines)
+        self._lock = threading.Lock()
+        self._accs: Dict[FeatureKey, _Acc] = {}
+        self._numeric: Dict[FeatureKey, Optional[np.ndarray]] = {}
+        for fk, base in self.baselines.items():
+            if fk[0] == PREDICTION_KEY:
+                prediction_edges = np.asarray(base.summary_info, float) \
+                    if base.is_numeric else prediction_edges
+                continue
+            self._accs[fk] = _Acc(len(base.distribution))
+            self._numeric[fk] = np.asarray(base.summary_info, float) \
+                if base.is_numeric else None
+        #: prediction sketch: fixed edges (probability scale by default so
+        #: classification drift needs no baseline; pass edges for regression)
+        self._pred_edges = np.asarray(
+            prediction_edges if prediction_edges is not None
+            else np.linspace(0.0, 1.0, bins + 1), float)
+        self._pred = _Acc(len(self._pred_edges))  # bins + invalid bucket
+
+    # ---- ingest ------------------------------------------------------------
+    @staticmethod
+    def _value_of(record: Dict[str, Any], fk: FeatureKey) -> Any:
+        name, key = fk
+        v = record.get(name)
+        if key is None:
+            return v
+        return v.get(key) if isinstance(v, dict) else None
+
+    @staticmethod
+    def prediction_of(output: Any) -> Optional[float]:
+        """Scored output dict -> prediction scalar (first Prediction-shaped
+        value, else the first numeric value), or None."""
+        if isinstance(output, (int, float)) and not isinstance(output, bool):
+            return float(output)
+        if not isinstance(output, dict):
+            return None
+        for v in output.values():
+            if isinstance(v, dict) and "prediction" in v:
+                return _coerce_float(v["prediction"])
+        for v in output.values():
+            f = _coerce_float(v)
+            if f is not None:
+                return f
+        return None
+
+    def _fold_numeric(self, acc: _Acc, edges: np.ndarray,
+                      values: List[Optional[float]]) -> None:
+        acc.count += len(values)
+        present = np.array([v for v in values if v is not None], float)
+        acc.nulls += len(values) - present.size
+        if not present.size:
+            return
+        hist, _ = np.histogram(present, bins=edges)
+        acc.dist[:len(hist)] += hist
+        # trailing invalid bucket — same out-of-range rule as
+        # _numeric_distribution (drift outside the training range registers)
+        acc.dist[-1] += float(((present < edges[0]) | (present > edges[-1])).sum())
+
+    def _fold_text(self, acc: _Acc, values: Sequence[Any]) -> None:
+        bins = len(acc.dist)
+        acc.count += len(values)
+        for v in values:
+            toks = _tokens_of(v)
+            if toks is None:
+                acc.nulls += 1
+                continue
+            acc.tok_min = min(acc.tok_min, len(toks))
+            acc.tok_max = max(acc.tok_max, len(toks))
+            for t in toks:
+                acc.dist[_hash_token(t, bins)] += 1.0
+
+    def observe(self, records: Sequence[Dict[str, Any]],
+                outputs: Sequence[Any] = ()) -> None:
+        """Fold one dispatched batch (real, unpadded records) into the sketch.
+        ``outputs`` may contain per-record Exceptions — those are skipped for
+        the prediction sketch only."""
+        preds = [p for p in (self.prediction_of(o) for o in outputs
+                             if not isinstance(o, Exception)) if p is not None]
+        with self._lock:
+            for fk, acc in self._accs.items():
+                edges = self._numeric[fk]
+                if edges is not None:
+                    self._fold_numeric(
+                        acc, edges,
+                        [_coerce_float(self._value_of(r, fk)) for r in records])
+                else:
+                    self._fold_text(acc, [self._value_of(r, fk) for r in records])
+            if preds:
+                self._fold_numeric(self._pred, self._pred_edges, preds)
+
+    # ---- export ------------------------------------------------------------
+    def _dist_of(self, fk: FeatureKey, acc: _Acc) -> FeatureDistribution:
+        edges = self._numeric.get(fk) if fk[0] != PREDICTION_KEY \
+            else self._pred_edges
+        if edges is not None:
+            si = edges
+        elif np.isfinite(acc.tok_max):
+            si = np.array([acc.tok_min, acc.tok_max])
+        else:
+            si = np.array([0.0, 0.0])
+        return FeatureDistribution(fk[0], fk[1], acc.count, acc.nulls,
+                                   acc.dist.copy(), np.asarray(si), "serving")
+
+    def distributions(self) -> Dict[FeatureKey, FeatureDistribution]:
+        """Point-in-time serving distributions (includes the prediction
+        sketch once it has observations)."""
+        with self._lock:
+            out = {fk: self._dist_of(fk, acc) for fk, acc in self._accs.items()}
+            if self._pred.count:
+                out[(PREDICTION_KEY, None)] = self._dist_of(
+                    (PREDICTION_KEY, None), self._pred)
+        return out
+
+    def merge_from(self, other: "ServeSketch") -> None:
+        """Fold another sketch's counts into this one (replica/instance
+        merge — the FeatureDistribution.reduce monoid on raw accumulators)."""
+        with other._lock:
+            theirs = {fk: (acc.count, acc.nulls, acc.dist.copy(),
+                           acc.tok_min, acc.tok_max)
+                      for fk, acc in other._accs.items()}
+            pred = (other._pred.count, other._pred.nulls,
+                    other._pred.dist.copy())
+        with self._lock:
+            for fk, (c, nl, dist, tmin, tmax) in theirs.items():
+                acc = self._accs.get(fk)
+                if acc is None or len(acc.dist) != len(dist):
+                    continue
+                acc.count += c
+                acc.nulls += nl
+                acc.dist += dist
+                acc.tok_min = min(acc.tok_min, tmin)
+                acc.tok_max = max(acc.tok_max, tmax)
+            if len(pred[2]) == len(self._pred.dist):
+                self._pred.count += pred[0]
+                self._pred.nulls += pred[1]
+                self._pred.dist += pred[2]
+
+    def scores(self) -> Dict[str, Dict[str, float]]:
+        """Per-feature drift metrics vs the baselines (the /metrics gauge)."""
+        return drift_scores(self.baselines, self.distributions())
+
+    def reset(self) -> None:
+        with self._lock:
+            for fk, acc in self._accs.items():
+                self._accs[fk] = _Acc(len(acc.dist))
+            self._pred = _Acc(len(self._pred_edges))
+
+
+# ---------------------------------------------------------------------------
+# Pure functions over distributions
+# ---------------------------------------------------------------------------
+def merged_distributions(sketches: Sequence[ServeSketch]
+                         ) -> Dict[FeatureKey, FeatureDistribution]:
+    """Cross-sketch merge via the reduce monoid (replica -> fleet view)."""
+    out: Dict[FeatureKey, FeatureDistribution] = {}
+    for sk in sketches:
+        for fk, d in sk.distributions().items():
+            prev = out.get(fk)
+            out[fk] = d if prev is None or \
+                len(prev.distribution) != len(d.distribution) else prev.reduce(d)
+    return out
+
+
+def _key_str(fk: FeatureKey) -> str:
+    return fk[0] if fk[1] is None else f"{fk[0]}.{fk[1]}"
+
+
+def drift_scores(baselines, serving: Mapping[FeatureKey, FeatureDistribution]
+                 ) -> Dict[str, Dict[str, float]]:
+    """JS divergence + fill-rate deltas, serving vs training, per feature.
+
+    Features without a baseline (e.g. the default prediction sketch) still
+    report counts/fill so the gauge shows traffic; their ``js`` is absent.
+    """
+    base = _as_baseline_map(baselines)
+    out: Dict[str, Dict[str, float]] = {}
+    for fk, d in serving.items():
+        row: Dict[str, float] = {"count": float(d.count),
+                                 "fill_rate": d.fill_rate()}
+        b = base.get(fk)
+        if b is not None and len(b.distribution) == len(d.distribution):
+            row["js"] = b.js_divergence(d)
+            row["fill_rate_diff"] = b.relative_fill_rate(d)
+        out[_key_str(fk)] = row
+    return out
+
+
+def prediction_distribution(values: Sequence[float],
+                            edges: Optional[np.ndarray] = None,
+                            bins: int = DEFAULT_BINS,
+                            dist_type: str = "training") -> FeatureDistribution:
+    """Prediction scalars -> a FeatureDistribution under ``PREDICTION_KEY``
+    (build one from training-window scores to baseline prediction drift)."""
+    vals = np.array([v for v in (_coerce_float(x) for x in values)
+                     if v is not None], float)
+    if edges is None:
+        edges = np.linspace(0.0, 1.0, bins + 1)
+    edges = np.asarray(edges, float)
+    hist, _ = np.histogram(vals, bins=edges)
+    invalid = float(((vals < edges[0]) | (vals > edges[-1])).sum())
+    dist = np.concatenate([hist.astype(np.float64), [invalid]])
+    return FeatureDistribution(PREDICTION_KEY, None, int(len(values)),
+                               int(len(values) - vals.size), dist, edges,
+                               dist_type)
+
+
+def baselines_from_model(model, bins: int = DEFAULT_BINS
+                         ) -> Dict[FeatureKey, FeatureDistribution]:
+    """Training-time baselines for a fitted ``OpWorkflowModel``.
+
+    Prefers the RawFeatureFilter's recorded training distributions (exact
+    filter parity); otherwise recomputes from the retained transformed
+    training data — raw predictor columns survive transformation, so the
+    sketch monitors exactly the features the serve records carry.  Response
+    features are excluded (serve records have no label; their fill would
+    read as pure drift)."""
+    rff = getattr(model, "rff_results", None)
+    dists = list(getattr(rff, "training_distributions", None) or [])
+    if not dists and getattr(model, "train_data", None) is not None:
+        predictors = [f for f in model.raw_features if not f.is_response]
+        _, dists = compute_feature_stats(model.train_data, predictors,
+                                         bins, "training")
+    responses = {f.name for f in model.raw_features if f.is_response}
+    return {d.feature_key: d for d in dists if d.name not in responses}

@@ -1,0 +1,208 @@
+"""The closed loop: drift -> warm-start retrain -> gate -> swap -> watch.
+
+``ContinualLoop`` wires the subsystem's parts around a live
+``ModelRegistry``: the controller reads the serve-path drift gauge, a
+trigger retrains a FRESH workflow (from ``workflow_factory``) on the recent
+window with the sweep grid warm-started from the incumbent's winning spec,
+the challenger is gated against the champion on the window's trailing
+holdout, promotion rolls through the registry's zero-gap hot-swap, and a
+later ``check_rollback()`` compares post-swap serve metrics against the
+pre-swap snapshot.  Every step lands in the ``"continual"`` obs scope and
+one JSONL run record per loop iteration.
+
+The loop does not own a schedule — call ``run_once()`` from a timer, the
+``continual`` run type, or a test.  It also does not own data arrival:
+``window_provider()`` returns the recent raw window (newest rows LAST; the
+trailing ``holdout_fraction`` is the champion-challenger holdout and is
+excluded from retraining).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ..obs import record as obs_record
+from ..obs import trace
+from ..utils import env
+from . import promote as promote_mod
+from .controller import ControllerConfig, RetrainController, scope
+from .promote import GateConfig
+
+__all__ = ["ContinualLoop", "incumbent_summary"]
+
+
+def incumbent_summary(model):
+    """The champion's ``ModelSelectorSummary`` (winning family + grid), from
+    the fitted SelectedModel stage; None when the model has no selector."""
+    from ..impl.selector.model_selector import ModelSelectorSummary
+
+    for s in getattr(model, "stages", []):
+        summary = getattr(s, "summary", None)
+        if summary is not None and hasattr(summary, "best_grid"):
+            return summary
+        meta = getattr(s, "metadata", None) or {}
+        if "model_selector_summary" in meta:
+            try:
+                return ModelSelectorSummary.from_json(
+                    meta["model_selector_summary"])
+            except Exception:  # noqa: BLE001 — malformed metadata -> cold
+                continue
+    return None
+
+
+class ContinualLoop:
+    """One serving fleet's continual-learning driver."""
+
+    def __init__(self, registry, metrics, workflow_factory, window_provider,
+                 evaluator,
+                 controller: Optional[RetrainController] = None,
+                 gate: Optional[GateConfig] = None,
+                 holdout_fraction: float = 0.25,
+                 explore: Optional[int] = None,
+                 clock=time.monotonic):
+        self.registry = registry
+        self.metrics = metrics
+        self.workflow_factory: Callable[[Any], Any] = workflow_factory
+        self.window_provider: Callable[[], Any] = window_provider
+        self.evaluator = evaluator
+        self.controller = controller or RetrainController(
+            ControllerConfig.from_env(), clock=clock)
+        self.gate = gate or GateConfig.from_env()
+        self.holdout_fraction = float(holdout_fraction)
+        self.explore = env.env_int("TMOG_WARMSTART_EXPLORE", 1) \
+            if explore is None else int(explore)
+        self._versions = 0
+        #: (champion_model, champion_version, pre-swap metrics snapshot) of
+        #: the most recent promotion — the rollback watch's reference point
+        self._watch: Optional[tuple] = None
+
+    # ---- helpers -----------------------------------------------------------
+    def _cost_hints(self) -> Dict[str, Any]:
+        try:
+            champ = self.registry.active()
+        except LookupError:
+            return {}
+        summary = incumbent_summary(champ.model)
+        hints: Dict[str, Any] = {}
+        td = getattr(champ.model, "train_data", None)
+        if td is not None:
+            hints["n_rows"] = len(td)
+        if summary is not None:
+            hints["n_candidates"] = len(summary.validation_results or [])
+            hints["n_folds"] = (summary.validation_parameters or {}).get(
+                "numFolds", 3)
+        return hints
+
+    def _next_version(self, prefix: str = "ct") -> str:
+        self._versions += 1
+        return f"{prefix}{self._versions}-{int(time.time())}"
+
+    def _split_window(self, window):
+        n = len(window)
+        cut = max(1, int(round(n * (1.0 - self.holdout_fraction))))
+        cut = min(cut, n - 1) if n > 1 else n
+        idx = np.arange(n)
+        return window.take(idx[:cut]), window.take(idx[cut:])
+
+    # ---- the loop body -----------------------------------------------------
+    def retrain(self, train_ds):
+        """Warm-started challenger fit on the window; returns
+        (challenger_model, info dict with walls + candidate counts)."""
+        from ..ops import sweep as sweep_ops
+
+        try:
+            champion = self.registry.active().model
+        except LookupError:
+            champion = None
+        summary = incumbent_summary(champion) if champion is not None else None
+        wf = self.workflow_factory(train_ds)
+        pruned = full = None
+        if summary is not None:
+            for stage in getattr(wf, "stages", []):
+                if getattr(stage, "is_model_selector", False):
+                    stage.warm_start(summary, explore=self.explore)
+                    pruned, full = stage.validator.warm_start_counts
+        t0 = time.perf_counter()
+        with trace.span("continual.retrain",
+                        warm_start=bool(summary), rows=len(train_ds)):
+            challenger = wf.train()
+        wall = time.perf_counter() - t0
+        stats = sweep_ops.run_stats()
+        scope.inc("retrains")
+        info = {"wall_s": round(wall, 4), "warm_start": summary is not None,
+                "pruned_candidates": pruned if pruned is not None
+                else stats.get("pruned_candidates"),
+                "full_candidates": full if full is not None
+                else stats.get("full_candidates"),
+                "rows": len(train_ds)}
+        scope.append("decisions", {"action": "retrain", **info})
+        return challenger, info
+
+    def run_once(self, scores: Optional[Dict[str, Dict[str, float]]] = None,
+                 version: Optional[str] = None) -> Dict[str, Any]:
+        """One full policy iteration.  Returns the outcome record (also
+        appended to the telemetry JSONL as kind="continual")."""
+        out: Dict[str, Any] = {"outcome": "skip"}
+        with trace.span("continual.run_once"):
+            decision = self.controller.evaluate(scores,
+                                                cost_hints=self._cost_hints())
+            out["decision"] = decision.to_json()
+            if decision.triggered:
+                out.update(self._retrain_and_gate(version))
+        obs_record.write_record("continual", extra=out)
+        return out
+
+    def _retrain_and_gate(self, version: Optional[str]) -> Dict[str, Any]:
+        try:
+            champ_entry = self.registry.active()
+        except LookupError:
+            champ_entry = None
+        window = self.window_provider()
+        train_ds, holdout = self._split_window(window)
+        challenger, info = self.retrain(train_ds)
+        out: Dict[str, Any] = {"retrain": info}
+        if champ_entry is None:
+            entry = promote_mod.promote(self.registry, challenger,
+                                        version or self._next_version())
+            scope.inc("promotions")
+            scope.append("decisions", {"action": "promote",
+                                       "reason": "no_champion",
+                                       "version": entry.version})
+            out.update(outcome="promote", version=entry.version)
+            return out
+        champ_m, chall_m = promote_mod.evaluate_pair(
+            champ_entry.model, challenger, self.evaluator, holdout)
+        result = promote_mod.decide(champ_m, chall_m,
+                                    self.evaluator.is_larger_better,
+                                    self.evaluator.default_metric, self.gate)
+        out["gate"] = result.to_json()
+        if not result.promote:
+            out["outcome"] = "reject"
+            return out
+        before = self.metrics.snapshot() if self.metrics is not None else {}
+        entry = promote_mod.promote(self.registry, challenger,
+                                    version or self._next_version())
+        self._watch = (champ_entry.model, champ_entry.version, before)
+        out.update(outcome="promote", version=entry.version)
+        return out
+
+    # ---- post-swap watch ---------------------------------------------------
+    def check_rollback(self) -> Optional[str]:
+        """Compare serve metrics accumulated since the last promotion against
+        the pre-swap snapshot; roll back to the champion on regression.
+        Returns the rollback deployment's version, or None."""
+        if self._watch is None or self.metrics is None:
+            return None
+        champion, champ_version, before = self._watch
+        entry = promote_mod.rollback_if_regressed(
+            self.registry, before, self.metrics.snapshot(),
+            champion, champ_version, self.gate)
+        if entry is None:
+            return None
+        self._watch = None
+        obs_record.write_record("continual", extra={
+            "outcome": "rollback", "version": entry.version,
+            "from_champion": champ_version})
+        return entry.version

@@ -1,0 +1,153 @@
+"""Gated promotion: champion-challenger eval, rolling hot-swap, rollback.
+
+The retrained challenger never touches traffic until it has beaten (or at
+least matched, within epsilon) the serving champion on a recent-window
+holdout — evaluated with the SAME evaluator that selected the champion, so
+"not worse" means the metric the business already trusts.  Promotion goes
+through ``ModelRegistry.deploy``'s rolling per-slot swap (capacity never
+zero); if post-swap serve metrics regress (error-rate delta beyond
+``TMOG_ROLLBACK_ERROR_RATE`` over at least ``TMOG_ROLLBACK_MIN_RESPONSES``
+responses), the champion is redeployed — again rolling, again zero-gap —
+under a fresh ``<version>-rbN`` tag (the registry refuses duplicate version
+names by design; a rollback is a new deployment event, not a rewind).
+"""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+from ..obs import registry as obs_registry
+from ..obs import trace
+from ..utils import env
+from .controller import scope
+
+__all__ = ["GateConfig", "GateResult", "evaluate_pair", "decide",
+           "promote", "rollback_if_regressed"]
+
+#: monotone source for rollback version suffixes (process-unique)
+_rb_counter = itertools.count(1)
+
+
+@dataclass
+class GateConfig:
+    """Promotion / rollback policy knobs."""
+
+    epsilon: float = 0.01            # TMOG_PROMOTE_EPSILON — metric slack
+    rollback_error_rate: float = 0.10  # TMOG_ROLLBACK_ERROR_RATE — err/resp delta
+    rollback_min_responses: int = 8  # TMOG_ROLLBACK_MIN_RESPONSES
+
+    @classmethod
+    def from_env(cls) -> "GateConfig":
+        return cls(
+            epsilon=env.env_float("TMOG_PROMOTE_EPSILON", 0.01),
+            rollback_error_rate=env.env_float("TMOG_ROLLBACK_ERROR_RATE", 0.10),
+            rollback_min_responses=env.env_int("TMOG_ROLLBACK_MIN_RESPONSES", 8),
+        )
+
+
+@dataclass
+class GateResult:
+    promote: bool
+    reason: str
+    metric: str
+    champion: float
+    challenger: float
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"promote": self.promote, "reason": self.reason,
+                "metric": self.metric, "champion": self.champion,
+                "challenger": self.challenger}
+
+
+def evaluate_pair(champion, challenger, evaluator, holdout
+                  ) -> Tuple[float, float]:
+    """(champion_metric, challenger_metric) on the recent-window holdout,
+    both via the evaluator's default metric."""
+    with trace.span("continual.evaluate_pair",
+                    metric=evaluator.default_metric):
+        champ = float(evaluator.evaluate_all(
+            _scored(champion, holdout), **_cols(champion, evaluator)
+        )[evaluator.default_metric])
+        chall = float(evaluator.evaluate_all(
+            _scored(challenger, holdout), **_cols(challenger, evaluator)
+        )[evaluator.default_metric])
+    return champ, chall
+
+
+def _scored(model, holdout):
+    from ..workflow import dag as dag_util
+
+    raw = model._raw_for_scoring(holdout, None)
+    return dag_util.apply_transformations_dag(
+        raw, model.dag, keep=[f.name for f in model.result_features])
+
+
+def _cols(model, evaluator) -> Dict[str, Optional[str]]:
+    label = next((f for f in model.result_features + model.raw_features
+                  if f.is_response), None)
+    pred = next((f for f in model.result_features if not f.is_response), None)
+    return {"label_col": evaluator.label_col or (label.name if label else None),
+            "prediction_col": evaluator.prediction_col
+            or (pred.name if pred else None)}
+
+
+def decide(champion_metric: float, challenger_metric: float,
+           is_larger_better: bool, metric: str,
+           config: Optional[GateConfig] = None) -> GateResult:
+    """Not-worse-by-epsilon gate (direction-aware)."""
+    cfg = config or GateConfig.from_env()
+    if is_larger_better:
+        ok = challenger_metric >= champion_metric - cfg.epsilon
+    else:
+        ok = challenger_metric <= champion_metric + cfg.epsilon
+    result = GateResult(ok, "not_worse" if ok else "challenger_worse",
+                        metric, float(champion_metric),
+                        float(challenger_metric))
+    scope.inc("promotions" if ok else "rejections")
+    scope.append("decisions", {"action": "promote" if ok else "reject",
+                               **result.to_json()})
+    return result
+
+
+def promote(registry, challenger_model, version: Optional[str] = None):
+    """Rolling hot-swap of the gated challenger; returns the ServingModel
+    entry.  Capacity is never zero — per-slot load -> warm -> swap -> drain
+    is the registry's contract, verified by the closed-loop test."""
+    with trace.span("continual.promote", version=version or ""):
+        entry = registry.deploy(challenger_model, version=version)
+    return entry
+
+
+def rollback_if_regressed(registry, before: Dict[str, Any],
+                          after: Dict[str, Any], champion_model,
+                          champion_version: str,
+                          config: Optional[GateConfig] = None
+                          ) -> Optional[Any]:
+    """Compare serve-metric snapshots around a promotion; redeploy the
+    champion if the error rate regressed.
+
+    ``before``/``after`` are ``ServeMetrics.snapshot()`` dicts.  Returns the
+    new (rolled-back) ServingModel entry, or None if the promotion holds.
+    """
+    cfg = config or GateConfig.from_env()
+    d_resp = float(after.get("responses", 0)) - float(before.get("responses", 0))
+    d_err = float(after.get("errors", 0)) - float(before.get("errors", 0))
+    if d_resp + d_err < cfg.rollback_min_responses:
+        return None  # not enough post-swap evidence either way
+    err_rate = d_err / max(d_resp + d_err, 1.0)
+    if err_rate < cfg.rollback_error_rate:
+        return None
+    version = f"{champion_version}-rb{next(_rb_counter)}"
+    with trace.span("continual.rollback", version=version,
+                    error_rate=round(err_rate, 4)):
+        entry = registry.deploy(champion_model, version=version)
+    scope.inc("rollbacks")
+    scope.append("decisions", {
+        "action": "rollback", "from_version": champion_version,
+        "to_version": version, "error_rate": round(err_rate, 6),
+        "responses": d_resp, "errors": d_err})
+    obs_registry.record_fallback("continual", "post_swap_regression",
+                                 error_rate=round(err_rate, 6),
+                                 version=version)
+    return entry

@@ -133,3 +133,19 @@ class OpLogisticRegression(PredictorEstimator):
         else:
             raw, prob, pred = L.predict_binary_logistic(X, coef, intercept)
         return np.asarray(pred), np.asarray(raw), np.asarray(prob)
+
+    @classmethod
+    def predict_program(cls, params: Dict[str, Any]):
+        coef = jnp.asarray(params["coef"], jnp.float32)
+        intercept = jnp.asarray(params["intercept"], jnp.float32)
+        multinomial = bool(params.get("multinomial"))
+
+        def program(X):
+            X = jnp.asarray(X, jnp.float32)
+            if multinomial:
+                raw, prob, pred = L.predict_softmax(X, coef, intercept)
+            else:
+                raw, prob, pred = L.predict_binary_logistic(X, coef, intercept)
+            return pred, raw, prob
+
+        return program

@@ -25,7 +25,6 @@ reference arithmetic.
 """
 from __future__ import annotations
 
-import zlib
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
@@ -35,223 +34,13 @@ from ... import types as T
 from ...columns import Column, Dataset, NumericColumn, ObjectColumn
 from ...features.feature import Feature
 from ...readers.base import Reader
-
-
-# ---------------------------------------------------------------------------
-# Summary + FeatureDistribution
-# ---------------------------------------------------------------------------
-@dataclass
-class Summary:
-    """min/max/sum/count of a feature's values (Summary.scala:43); for text,
-    sum = total token count and count = number of texts."""
-
-    min: float = float("inf")
-    max: float = float("-inf")
-    sum: float = 0.0
-    count: float = 0.0
-
-    def to_json(self) -> Dict[str, float]:
-        return {"min": self.min, "max": self.max, "sum": self.sum, "count": self.count}
-
-
-def _log2(x: np.ndarray) -> np.ndarray:
-    with np.errstate(divide="ignore"):
-        return np.log2(x)
-
-
-@dataclass
-class FeatureDistribution:
-    """Binned counts + fill info for one feature (or one map key)
-    (FeatureDistribution.scala:58)."""
-
-    name: str
-    key: Optional[str]
-    count: int
-    nulls: int
-    distribution: np.ndarray
-    summary_info: np.ndarray  # bin edges for numerics, [min_tokens, max_tokens] for text
-    dist_type: str = "training"
-
-    @property
-    def feature_key(self) -> Tuple[str, Optional[str]]:
-        return (self.name, self.key)
-
-    def fill_rate(self) -> float:
-        """FeatureDistribution.fillRate:94."""
-        return 0.0 if self.count == 0 else (self.count - self.nulls) / self.count
-
-    def relative_fill_rate(self, other: "FeatureDistribution") -> float:
-        """Absolute fill-rate difference (:138)."""
-        return abs(self.fill_rate() - other.fill_rate())
-
-    def relative_fill_ratio(self, other: "FeatureDistribution") -> float:
-        """Symmetric ratio, larger on top (:125)."""
-        a, b = self.fill_rate(), other.fill_rate()
-        big, small = max(a, b), min(a, b)
-        return float("inf") if small == 0.0 else big / small
-
-    def js_divergence(self, other: "FeatureDistribution") -> float:
-        """Jensen-Shannon divergence in bits (:149): both-zero bins dropped,
-        each distribution normalized, KL terms with a==0 contribute 0."""
-        p, q = np.asarray(self.distribution, float), np.asarray(other.distribution, float)
-        keep = ~((p == 0.0) & (q == 0.0))
-        p, q = p[keep], q[keep]
-        if p.size == 0 or p.sum() == 0.0 or q.sum() == 0.0:
-            return 0.0
-        p, q = p / p.sum(), q / q.sum()
-        m = 0.5 * (p + q)
-        kl_pm = np.where(p == 0.0, 0.0, p * _log2(np.where(p == 0, 1.0, p / m))).sum()
-        kl_qm = np.where(q == 0.0, 0.0, q * _log2(np.where(q == 0, 1.0, q / m))).sum()
-        return float(0.5 * kl_pm + 0.5 * kl_qm)
-
-    def reduce(self, other: "FeatureDistribution") -> "FeatureDistribution":
-        """Monoid combine (:102)."""
-        assert self.feature_key == other.feature_key
-        si = self.summary_info if len(self.summary_info) >= len(other.summary_info) \
-            else other.summary_info
-        return FeatureDistribution(self.name, self.key, self.count + other.count,
-                                   self.nulls + other.nulls,
-                                   self.distribution + other.distribution, si, self.dist_type)
-
-    def to_json(self) -> Dict[str, Any]:
-        return {"name": self.name, "key": self.key, "count": self.count,
-                "nulls": self.nulls, "distribution": self.distribution.tolist(),
-                "summaryInfo": self.summary_info.tolist(), "type": self.dist_type}
-
-
-# ---------------------------------------------------------------------------
-# Per-feature distribution computation
-# ---------------------------------------------------------------------------
-def _hash_token(tok: str, bins: int) -> int:
-    """Deterministic token -> bin (the reference hashes tokens with MurmurHash3
-    into ``textBinsFormula(summary, bins)`` buckets; crc32 is our stable hash)."""
-    return zlib.crc32(tok.encode("utf-8", "ignore")) % bins
-
-
-def _tokens_of(v: Any) -> Optional[List[str]]:
-    """Value -> token list; None means null (PreparedFeatures' ProcessedSeq)."""
-    if v is None:
-        return None
-    if isinstance(v, str):
-        return v.split() if v else None
-    if isinstance(v, (list, tuple, set, frozenset)):
-        toks = [str(x) for x in v]
-        return toks if toks else None
-    if isinstance(v, dict):
-        toks = [str(x) for x in v.values()]
-        return toks if toks else None
-    return [str(v)]
-
-
-def _numeric_distribution(name: str, key: Optional[str], vals: np.ndarray,
-                          mask: np.ndarray, bins: int, dist_type: str,
-                          train_edges: Optional[np.ndarray]) -> FeatureDistribution:
-    n = len(vals)
-    present = vals[mask]
-    if train_edges is not None and len(train_edges) > 1:
-        edges = np.asarray(train_edges)
-    elif present.size:
-        lo, hi = float(present.min()), float(present.max())
-        if hi <= lo:
-            hi = lo + 1.0
-        edges = np.linspace(lo, hi, bins + 1)
-    else:
-        edges = np.linspace(0.0, 1.0, bins + 1)
-    hist, _ = np.histogram(present, bins=edges)
-    # out-of-range values land in a trailing "invalid" bucket (the reference
-    # bucketizes with trackInvalid=true, FeatureDistribution.scala:340) so
-    # scoring drift outside the training range still registers as divergence
-    invalid = int(((present < edges[0]) | (present > edges[-1])).sum())
-    full = np.concatenate([hist.astype(np.float64), [float(invalid)]])
-    return FeatureDistribution(name, key, n, int(n - mask.sum()), full, edges, dist_type)
-
-
-def _text_distribution(name: str, key: Optional[str], values: Sequence[Any],
-                       bins: int, dist_type: str) -> FeatureDistribution:
-    dist = np.zeros(bins, dtype=np.float64)
-    nulls = 0
-    n_tokens_min, n_tokens_max = float("inf"), float("-inf")
-    for v in values:
-        toks = _tokens_of(v)
-        if toks is None:
-            nulls += 1
-            continue
-        n_tokens_min = min(n_tokens_min, len(toks))
-        n_tokens_max = max(n_tokens_max, len(toks))
-        for t in toks:
-            dist[_hash_token(t, bins)] += 1.0
-    si = np.array([n_tokens_min, n_tokens_max]) if np.isfinite(n_tokens_max) \
-        else np.array([0.0, 0.0])
-    return FeatureDistribution(name, key, len(values), nulls, dist, si, dist_type)
-
-
-def _is_map_feature(f: Feature) -> bool:
-    return issubclass(f.ftype, T.OPMap) and not issubclass(f.ftype, T.Prediction)
-
-
-def compute_feature_stats(data: Dataset, raw_features: Sequence[Feature], bins: int,
-                          dist_type: str,
-                          train_summary: Optional[Dict[Tuple[str, Optional[str]],
-                                                       FeatureDistribution]] = None
-                          ) -> Tuple[List[FeatureDistribution], List[FeatureDistribution]]:
-    """(response_distributions, predictor_distributions)
-    (RawFeatureFilter.computeFeatureStats:137).  Scoring passes reuse the
-    training bin edges via ``train_summary``."""
-    responses: List[FeatureDistribution] = []
-    predictors: List[FeatureDistribution] = []
-    train_summary = train_summary or {}
-    for f in raw_features:
-        if f.name not in data.columns:
-            continue
-        col = data[f.name]
-        out = responses if f.is_response else predictors
-        if isinstance(col, NumericColumn):
-            prior = train_summary.get((f.name, None))
-            out.append(_numeric_distribution(
-                f.name, None, col.values, col.mask, bins, dist_type,
-                None if prior is None else prior.summary_info))
-        elif _is_map_feature(f) and isinstance(col, ObjectColumn):
-            # one distribution per observed key; numeric-valued maps histogram,
-            # everything else hashes (PreparedFeatures map expansion)
-            keys: List[str] = sorted({k for v in col.values if isinstance(v, dict)
-                                      for k in v})
-            if train_summary:
-                keys = sorted({k for (n, k) in train_summary if n == f.name
-                               and k is not None} | set(keys))
-            for k in keys:
-                vals = [v.get(k) if isinstance(v, dict) else None for v in col.values]
-                prior = train_summary.get((f.name, k))
-                if prior is not None:
-                    # scoring follows the TRAINING distribution's type so the
-                    # histograms stay comparable even when the key vanishes or
-                    # changes type at scoring time (that IS the drift signal);
-                    # numeric distributions carry one slot per bin edge
-                    # (bins + invalid bucket), text ones a [min,max] pair
-                    numeric = len(prior.distribution) == len(prior.summary_info)
-                else:
-                    numeric = all(isinstance(x, (int, float, bool)) or x is None
-                                  for x in vals) \
-                        and any(isinstance(x, (int, float)) and not isinstance(x, bool)
-                                for x in vals)
-                if numeric:
-                    def _coerce(x):
-                        try:
-                            return float(x) if x is not None else None
-                        except (TypeError, ValueError):
-                            return None  # type drift at scoring time -> null
-                    coerced = [_coerce(x) for x in vals]
-                    arr = np.array([x if x is not None else 0.0 for x in coerced])
-                    mask = np.array([x is not None for x in coerced])
-                    out.append(_numeric_distribution(
-                        f.name, k, arr, mask, bins, dist_type,
-                        None if prior is None else prior.summary_info))
-                else:
-                    out.append(_text_distribution(f.name, k, vals, bins, dist_type))
-        elif isinstance(col, ObjectColumn):
-            out.append(_text_distribution(f.name, None, col.values, bins, dist_type))
-        else:  # vector/prediction raw features don't participate
-            continue
-    return responses, predictors
+# The distribution sketch lives in ``distribution`` so the serve-time drift
+# detector (continual/drift.py) shares the exact arithmetic; re-exported here
+# because this module has always been its public home.
+from .distribution import (  # noqa: F401 — re-exports
+    FeatureDistribution, Summary, _hash_token, _is_map_feature, _log2,
+    _numeric_distribution, _text_distribution, _tokens_of,
+    compute_feature_stats)
 
 
 # ---------------------------------------------------------------------------

@@ -89,3 +89,15 @@ class OpLinearRegression(PredictorEstimator):
         pred = L.predict_linear(X, jnp.asarray(params["coef"], jnp.float32),
                                 jnp.asarray(params["intercept"], jnp.float32))
         return np.asarray(pred), None, None
+
+    @classmethod
+    def predict_program(cls, params: Dict[str, Any]):
+        coef = jnp.asarray(params["coef"], jnp.float32)
+        intercept = jnp.asarray(params["intercept"], jnp.float32)
+
+        def program(X):
+            pred = L.predict_linear(jnp.asarray(X, jnp.float32), coef,
+                                    intercept)
+            return pred, None, None
+
+        return program

@@ -43,6 +43,68 @@ def _scrub(obj: Any) -> Any:
     return obj
 
 
+def _is_number(v: Any) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _neighborhood_grids(grids: Sequence[Dict[str, Any]],
+                        winner: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Grids within one grid-axis step of the winner.
+
+    Axes are inferred from the candidate family's OWN configured grid (per-
+    param sorted unique values), so pruning needs no coupling to
+    ``defaults.py`` — custom grids prune the same way.  Numeric params keep
+    winner +/- 1 index on the sorted value axis; non-numeric params pin to
+    the winner's value; a winner value absent from the axis (hand-edited
+    summary) leaves that axis unpruned rather than guessing."""
+    allowed: Dict[str, Optional[set]] = {}
+    for p in {k for g in grids for k in g}:
+        wv = winner.get(p)
+        if wv is None:
+            allowed[p] = None  # winner doesn't constrain this axis
+            continue
+        axis = sorted({g[p] for g in grids if p in g and _is_number(g[p])})
+        if _is_number(wv) and wv in axis:
+            i = axis.index(wv)
+            allowed[p] = set(axis[max(0, i - 1):i + 2])
+        elif _is_number(wv):
+            allowed[p] = None
+        else:
+            allowed[p] = {wv}
+    return [g for g in grids
+            if all(allowed.get(p) is None or g[p] in allowed[p] for p in g)]
+
+
+def prune_candidates(models: Sequence[Tuple[PredictorEstimator,
+                                            Sequence[Dict[str, Any]]]],
+                     summary: "ModelSelectorSummary", explore: int = 1
+                     ) -> List[Tuple[PredictorEstimator, List[Dict[str, Any]]]]:
+    """Warm-start grid pruning: the incumbent winner's neighborhood plus a
+    small exploration set.
+
+    The winning family (matched by ``best_model_type``) keeps only grids
+    within one axis step of ``best_grid``; every other family keeps
+    ``explore`` evenly-spaced grids so a regime change can still flip the
+    family.  An unmatched summary returns the models unpruned — a cold
+    sweep is the safe degradation."""
+    matched = any(type(est).__name__ == summary.best_model_type
+                  for est, _ in models)
+    if not matched:
+        return [(est, list(g)) for est, g in models]
+    out: List[Tuple[PredictorEstimator, List[Dict[str, Any]]]] = []
+    for est, grids in models:
+        grids = list(grids) or [{}]
+        if type(est).__name__ == summary.best_model_type:
+            kept = _neighborhood_grids(grids, dict(summary.best_grid or {}))
+            out.append((est, kept or grids))
+        elif explore > 0:
+            idx = sorted({int(round(i)) for i in
+                          np.linspace(0, len(grids) - 1,
+                                      min(explore, len(grids)))})
+            out.append((est, [grids[i] for i in idx]))
+    return out
+
+
 @dataclass
 class ModelSelectorSummary:
     """Serializable selection report (ModelSelectorSummary.scala:61)."""
@@ -222,6 +284,20 @@ class ModelSelector(BinaryEstimator, AllowLabelAsInput):
         est = next(e for e, _ in self.models if e.uid == best.model_uid)
         self.best_estimator = (est, best.grid, merged)
         return self.best_estimator
+
+    # ---- warm start (continual retrain) ------------------------------------
+    def warm_start(self, summary: "ModelSelectorSummary",
+                   explore: int = 1) -> "ModelSelector":
+        """Prune this selector's sweep grid to the incumbent winner's
+        neighborhood (+ ``explore`` grids per other family) so a
+        drift-triggered retrain costs a fraction of the cold sweep.  The
+        pruned-vs-full counts are stamped into ``ops.sweep.run_stats()`` by
+        the validator after the sweep runs."""
+        full = sum(len(g) for _, g in self.models)
+        self.models = prune_candidates(self.models, summary, explore)
+        pruned = sum(len(g) for _, g in self.models)
+        self.validator.warm_start_counts = (pruned, full)
+        return self
 
     # ---- fit (ModelSelector.scala:145) -------------------------------------
     def fit_columns(self, cols: Sequence[Column], dataset: Dataset) -> "SelectedModel":

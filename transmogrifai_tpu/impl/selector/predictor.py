@@ -56,6 +56,16 @@ class PredictorEstimator(BinaryEstimator, AllowLabelAsInput):
         """Returns (prediction[n], raw[n,k]|None, probability[n,k]|None)."""
         raise NotImplementedError
 
+    @classmethod
+    def predict_program(cls, params: Dict[str, Any]):
+        """A pure-JAX closure ``X -> (prediction, raw|None, prob|None)`` with
+        the fitted params captured as constants — traceable, so the serving
+        host head can be AOT-lowered per (bucket, device) and routed through
+        ``serve.compile_cache``.  Predictors whose inference mixes host numpy
+        (the tree families' bin/traverse path) raise NotImplementedError and
+        serving keeps their generic per-call path."""
+        raise NotImplementedError
+
     # ---- grid support ------------------------------------------------------
     def copy_with_params(self, overrides: Dict[str, Any]) -> "PredictorEstimator":
         merged = {**self._params, **overrides}

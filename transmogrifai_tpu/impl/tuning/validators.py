@@ -154,6 +154,14 @@ class OpValidator:
 
         with use_mesh(self._resolve_mesh()):
             self._sweep(candidates, X, y, train_w, val_mask, summary)
+        # warm-start accounting: stamp AFTER the sweep (the fused path resets
+        # the sweep scope on entry) so pruned-vs-full candidate counts land in
+        # run_stats() next to the launches they shrank
+        wc = getattr(self, "warm_start_counts", None)
+        if wc:
+            from ...ops import sweep as sweep_ops
+
+            sweep_ops.record_warm_start(*wc)
         if not summary.results or all(r.error for r in summary.results):
             raise RuntimeError("All models in the selector grid failed to fit")
         vals = [r.metric_value for r in summary.results]

@@ -48,7 +48,8 @@ def snapshot() -> Dict[str, Any]:
                 "transmogrifai_tpu.workflow.stream",
                 "transmogrifai_tpu.utils.flops",
                 "transmogrifai_tpu.serve.metrics",
-                "transmogrifai_tpu.serve.compile_cache"):
+                "transmogrifai_tpu.serve.compile_cache",
+                "transmogrifai_tpu.continual.controller"):
         try:
             __import__(mod)
         except Exception:  # a broken optional subsystem must not block obs

@@ -576,7 +576,8 @@ def run_sweep(spec, X, xbs: Tuple, y, train_w, val_w, blob):
 #: ``run_stats()`` below is the backward-compatible view over it, and is
 #: also what ``obs.snapshot()["sweep"]`` reports.
 _sweep_scope = obs_registry.scope("sweep", defaults={
-    "launches": [], "fallbacks": [], "compiles": 0, "compile_s": 0.0})
+    "launches": [], "fallbacks": [], "compiles": 0, "compile_s": 0.0,
+    "pruned_candidates": 0, "full_candidates": 0})
 obs_registry.register_provider("sweep", lambda: run_stats())
 
 #: per-(name, spec, device, arg-signature) AOT executables.  jit's own cache
@@ -646,7 +647,19 @@ def run_stats() -> Dict[str, Any]:
             # compile-count feature of the learned-cost-model training row
             "compiles": _sweep_scope.get("compiles"),
             "compile_s": _sweep_scope.get("compile_s"),
+            # warm-start retrain accounting (continual loop): how many grid
+            # candidates actually swept vs the cold grid's full count
+            "pruned_candidates": _sweep_scope.get("pruned_candidates"),
+            "full_candidates": _sweep_scope.get("full_candidates"),
             "fallbacks": _sweep_scope.list("fallbacks")}
+
+
+def record_warm_start(pruned: int, full: int) -> None:
+    """Stamp a warm-started sweep's pruned-vs-full candidate counts (called
+    by the validator after the sweep so the fused path's scope reset cannot
+    wipe them)."""
+    _sweep_scope.set("pruned_candidates", int(pruned))
+    _sweep_scope.set("full_candidates", int(full))
 
 
 def _aot(name: str, fn, spec, device, dyn_args) -> Tuple[Any, float, Tuple]:

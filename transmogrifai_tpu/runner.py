@@ -45,6 +45,7 @@ class OpWorkflowRunType(str, enum.Enum):
     Features = "features"
     Evaluate = "evaluate"
     Serve = "serve"
+    Continual = "continual"
 
 
 @dataclass
@@ -97,6 +98,7 @@ class OpWorkflowRunner:
                 OpWorkflowRunType.Features: self._features,
                 OpWorkflowRunType.Evaluate: self._evaluate,
                 OpWorkflowRunType.Serve: self._serve,
+                OpWorkflowRunType.Continual: self._continual,
             }
             result = dispatch[run_type](params, listener)
         result.app_metrics = listener.metrics
@@ -253,6 +255,74 @@ class OpWorkflowRunner:
                                       metrics={"serve": snapshot},
                                       n_scored=snapshot["responses"])
 
+    def _continual(self, params: OpParams, listener: OpListener
+                   ) -> OpWorkflowRunnerResult:
+        """Continual learning: deploy the champion, sketch the recent scoring
+        window as serve-side observations, then run the drift -> warm-start
+        retrain -> gate -> rolling hot-swap policy loop.
+
+        Settings come from ``params.custom_params["continual"]`` (populated
+        by the CLI flags): iterations, interval_s, holdout_fraction, explore,
+        max_batch, version.  The scoring reader supplies the recent window;
+        the runner's (unfitted) workflow is retrained on it.
+        """
+        from .continual import ServeSketch, baselines_from_model
+        from .continual.controller import scope as continual_scope
+        from .continual.loop import ContinualLoop
+        from .serve import ModelRegistry, ServeMetrics
+
+        if self.evaluator is None:
+            raise ValueError("Continual requires an evaluator (the promotion "
+                             "gate scores champion vs challenger with it)")
+        reader = self.scoring_reader or self.train_reader
+        if reader is None:
+            raise ValueError("Continual requires a scoring_reader (the recent "
+                             "data window)")
+        model = self._load_model(params, listener)
+        cfg = dict(params.custom_params.get("continual", {}))
+        metrics = ServeMetrics()
+        registry = ModelRegistry(max_batch=int(cfg.get("max_batch", 64)),
+                                 metrics=metrics)
+        registry.deploy(model, version=cfg.get("version"))
+        sketch = ServeSketch(baselines_from_model(model))
+        metrics.attach_sketch(sketch)
+        reader_params = params.reader_params or None
+
+        def window() -> Dataset:
+            return reader.generate_dataset(model.raw_features, reader_params)
+
+        def factory(ds: Dataset) -> OpWorkflow:
+            return self.workflow.set_input_dataset(ds)
+
+        loop = ContinualLoop(
+            registry, metrics, factory, window, self.evaluator,
+            holdout_fraction=float(cfg.get("holdout_fraction", 0.25)),
+            explore=cfg.get("explore"))
+        listener.add_custom_provider("continual", continual_scope.snapshot)
+        listener.add_custom_provider("serve_registry", registry.info)
+        outcomes: List[Dict[str, Any]] = []
+        iters = int(cfg.get("iterations", 1))
+        interval = float(cfg.get("interval_s", 0.0))
+        with listener.step(OpStep.FeatureEngineering):
+            for i in range(iters):
+                raw = reader.read(reader_params)
+                records = raw.to_dict(orient="records") \
+                    if hasattr(raw, "to_dict") else list(raw)
+                sketch.observe(records)
+                outcomes.append(loop.run_once())
+                rb = loop.check_rollback()
+                if rb:
+                    outcomes.append({"outcome": "rollback", "version": rb})
+                if interval and i + 1 < iters:
+                    time.sleep(interval)
+        promoted = sum(1 for o in outcomes if o.get("outcome") == "promote")
+        return OpWorkflowRunnerResult(
+            OpWorkflowRunType.Continual,
+            model_location=params.model_location,
+            metrics={"continual": continual_scope.snapshot(),
+                     "outcomes": outcomes, "registry": registry.info()},
+            n_scored=promoted)
+
     def _evaluate(self, params: OpParams, listener: OpListener) -> OpWorkflowRunnerResult:
         if self.evaluator is None:
             raise ValueError("Evaluate requires an evaluator")
@@ -319,6 +389,19 @@ class OpApp:
                                 "TMOG_SERVE_REPLICAS or one per device)")
         serve.add_argument("--serve-duration", type=float, default=None,
                            help="seconds to serve (default: until Ctrl-C)")
+        ct = p.add_argument_group("continual",
+                                  "options for --run-type=continual")
+        ct.add_argument("--continual-iterations", type=int, default=1,
+                        help="policy-loop evaluations to run")
+        ct.add_argument("--continual-interval", type=float, default=0.0,
+                        help="seconds between policy-loop evaluations")
+        ct.add_argument("--holdout-fraction", type=float, default=0.25,
+                        help="trailing window fraction held out for the "
+                             "champion-challenger gate")
+        ct.add_argument("--explore", type=int, default=None,
+                        help="exploration candidates per non-winning family "
+                             "in warm-started sweeps (default: "
+                             "TMOG_WARMSTART_EXPLORE or 1)")
         return p
 
     def parse_params(self, args: argparse.Namespace) -> OpParams:
@@ -337,6 +420,14 @@ class OpApp:
                 "max_batch": args.max_batch, "max_wait_ms": args.max_wait_ms,
                 "queue_size": args.queue_size, "replicas": args.replicas,
                 "duration_s": args.serve_duration,
+            })
+        if args.run_type == OpWorkflowRunType.Continual.value:
+            params.custom_params.setdefault("continual", {}).update({
+                "iterations": args.continual_iterations,
+                "interval_s": args.continual_interval,
+                "holdout_fraction": args.holdout_fraction,
+                "explore": args.explore,
+                "max_batch": args.max_batch,
             })
         return params
 

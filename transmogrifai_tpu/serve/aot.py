@@ -35,7 +35,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from .. import types as T
-from ..columns import NumericColumn, VectorColumn
+from ..columns import NumericColumn, PredictionColumn, VectorColumn
 from ..local.scoring import BatchScoreFunction, _emit
 from ..obs import trace
 from ..utils import devcache
@@ -118,6 +118,9 @@ class BucketScorer:
         # device copies per replica (lowering args re-resolve without
         # re-uploading on every rolling re-warm)
         self._templates: Dict[int, Dict[str, Any]] = {}
+        # per-host-head AOT executables: uid -> (compiled, shape), or False
+        # once a head proved unloadable (tree families, lowering failures)
+        self._heads: Dict[str, Any] = {}
 
     # ---- compile / warm ----------------------------------------------------
     def _template_args(self, bucket: int) -> Dict[str, Any]:
@@ -242,7 +245,9 @@ class BucketScorer:
                 host_new: Dict[str, Any] = {}
                 for t in layer:
                     out_feats = t.get_outputs()
-                    col = t.transform_dataset(ds)
+                    col = self._head_call(t, ds)
+                    if col is None:
+                        col = t.transform_dataset(ds)
                     if t.n_outputs == 1:
                         host_new[out_feats[0].name] = col
                     else:
@@ -253,6 +258,59 @@ class BucketScorer:
                     if nm in ds.columns]
         return [{nm: _emit(col.to_scalar(i)) for nm, col in out_cols}
                 for i in range(n)]
+
+    def _head_call(self, t: Any, ds: Any) -> Optional[Any]:
+        """Run a prediction-head stage through its per-device AOT executable.
+
+        The unfusable host heads used to jit generically per (shape, device)
+        inside XLA's in-memory cache only — every process restart re-traced
+        and recompiled them.  Heads whose predictor exposes a pure-JAX
+        ``predict_program`` are instead lowered once at the canonical cap
+        shape and routed through ``serve.compile_cache``, so a restart
+        deserializes them like the fused bucket programs.  Returns the
+        PredictionColumn, or None to keep the generic ``transform_dataset``
+        path (tree families, multi-output stages, any failure — recorded).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from ..obs.registry import record_fallback
+
+        cls = getattr(t, "predictor_class", None)
+        if cls is None or t.n_outputs != 1 or \
+                self._heads.get(t.uid) is False:
+            return None
+        vec = ds[t.inputs[-1].name]
+        V = np.asarray(vec.values, np.float32)
+        state = self._heads.get(t.uid)
+        if state is None or state[1] != V.shape:
+            try:
+                program = cls.predict_program(t.model_params)
+                lowered = jax.jit(program).lower(
+                    jax.device_put(jnp.zeros(V.shape, jnp.float32),
+                                   self.device))
+                compiled, _ = compile_cache.load_or_compile(
+                    f"serve.head.{cls.__name__}.b{V.shape[0]}", lowered,
+                    self.device, hlo_text=lowered.as_text())
+                state = (compiled, V.shape)
+            except NotImplementedError:
+                self._heads[t.uid] = False
+                return None
+            except Exception as e:  # noqa: BLE001 — head AOT must not break serving
+                record_fallback("serve", "head_aot_failed",
+                                stage=type(t).__name__, error=str(e))
+                self._heads[t.uid] = False
+                return None
+            self._heads[t.uid] = state
+        pred, raw, prob = state[0](jax.device_put(V, self.device))
+        col = PredictionColumn(
+            T.Prediction, np.asarray(pred, np.float64),
+            None if raw is None else np.asarray(raw, np.float64),
+            None if prob is None else np.asarray(prob, np.float64))
+        summary = getattr(t, "summary", None)
+        if summary is not None:  # the SelectedModel metadata contract
+            col.metadata = {"model_selector_summary": summary.to_json()}
+        return col
 
     def __call__(self, records: Sequence[Dict[str, Any]]
                  ) -> List[Dict[str, Any]]:

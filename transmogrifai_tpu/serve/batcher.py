@@ -241,6 +241,7 @@ class MicroBatcher:
         batch_ms = (time.monotonic() - t0) * 1000.0
         self.metrics.observe_batch(batch_ms, n, bucket, replica=rep.slot,
                                    device=str(rep.device))
+        self.metrics.observe_records([p.record for p in batch], outputs)
         done = time.monotonic()
         for p, out in zip(batch, outputs):
             if isinstance(out, Exception):

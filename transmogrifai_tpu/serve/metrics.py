@@ -64,6 +64,8 @@ class ServeMetrics:
         self.replica_stats: Dict[int, Dict[str, Any]] = {}
         #: gauges polled at snapshot time (e.g. live queue depth)
         self._gauges: Dict[str, Callable[[], Any]] = {}
+        #: optional continual-learning drift sketch fed by the batch path
+        self._sketch = None
         _instances.add(self)
 
     def _replica(self, slot: int, device: str = "") -> Dict[str, Any]:
@@ -111,6 +113,25 @@ class ServeMetrics:
     def add_gauge(self, name: str, fn: Callable[[], Any]) -> None:
         with self._lock:
             self._gauges[name] = fn
+
+    def attach_sketch(self, sketch) -> None:
+        """Hook a :class:`~transmogrifai_tpu.continual.drift.ServeSketch`
+        into the batch path; its per-feature drift scores join snapshots."""
+        with self._lock:
+            self._sketch = sketch
+
+    def observe_records(self, records, outputs=()) -> None:
+        """Fold scored records (+ outputs, for the prediction sketch) into
+        the attached drift sketch.  Never raises — drift accounting must not
+        take down the serving path."""
+        with self._lock:
+            sketch = self._sketch
+        if sketch is None:
+            return
+        try:
+            sketch.observe(records, outputs)
+        except Exception:
+            obs_registry.record_fallback("serve", "drift_sketch_failed")
 
     # ---- export ------------------------------------------------------------
     def _merge_into(self, acc: Dict[str, Any]) -> None:
@@ -166,11 +187,17 @@ class ServeMetrics:
                     } for slot, st in sorted(self.replica_stats.items())},
             }
             gauges = dict(self._gauges)
+            sketch = self._sketch
         for name, fn in gauges.items():
             try:
                 out[name] = fn()
             except Exception:
                 out[name] = None
+        if sketch is not None:
+            try:
+                out["drift"] = sketch.scores()
+            except Exception:
+                out["drift"] = {}
         return out
 
 
@@ -204,6 +231,15 @@ def merged_snapshot() -> Dict[str, Any]:
                     "batch_latency": st["batch_latency"].to_json()}
         for slot, st in sorted(acc["replicas"].items())}
     acc["instances"] = n
+    sketches = [m._sketch for m in list(_instances)
+                if getattr(m, "_sketch", None) is not None]
+    if sketches:
+        try:
+            from ..continual import drift as _drift
+            acc["drift"] = _drift.drift_scores(
+                sketches[0].baselines, _drift.merged_distributions(sketches))
+        except Exception:
+            acc["drift"] = {}
     return acc
 
 

@@ -152,8 +152,14 @@ def _make_handler(server: "ModelServer"):
                     text += prometheus_replica_text(server.metrics.snapshot())
                     self._reply_text(200, text)
                     return
+                try:  # continual counters ride along (defaults via import)
+                    from ..continual.controller import scope as _ct_scope
+                    continual = _ct_scope.snapshot()
+                except Exception:
+                    continual = {}
                 self._reply(200, {"serve": server.metrics.snapshot(),
-                                  "registry": server.registry.info()})
+                                  "registry": server.registry.info(),
+                                  "continual": continual})
             elif self.path == "/models":
                 self._reply(200, server.registry.info())
             elif self.path == "/healthz":
