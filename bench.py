@@ -365,6 +365,8 @@ def serve_bench():
             "warmup_s": round(warm_s, 3),
             "qps": round(clients * per_client / dt, 1),
             "p99_ms": snap["request_latency"]["p99_ms"],
+            "resilience": {k: snap[k] for k in (
+                "degraded_batches", "replica_failures", "replica_rebuilds")},
             "replica_slots_hit": sum(
                 1 for s in snap["replicas"].values() if s["batches"]),
             "cache": {k: (round(cache[k], 3) if isinstance(cache[k], float)

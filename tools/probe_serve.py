@@ -13,6 +13,7 @@ telemetry like bench/profile runs do.
     python tools/probe_serve.py --concurrency 64 --duration 10
     python tools/probe_serve.py --replicas 8 --compile-cache /tmp/aotx
     python tools/probe_serve.py --model-location /tmp/m --record '{"x": 1.0}'
+    python tools/probe_serve.py --replicas 2 --kill-replica 0 --duration 8
 """
 from __future__ import annotations
 
@@ -101,6 +102,16 @@ def main(argv=None) -> int:
     p.add_argument("--drift-after", type=float, default=None,
                    help="seconds into the run before the shift kicks in "
                         "(default: half the duration)")
+    p.add_argument("--kill-replica", type=int, default=None, metavar="N",
+                   help="chaos: inject a permanent scoring fault into "
+                        "replica slot N partway through the run, clear it "
+                        "after --kill-duration, and report the supervisor's "
+                        "recovery latency (circuit re-close) in the JSONL")
+    p.add_argument("--kill-after", type=float, default=None,
+                   help="seconds into the run before the kill (default: a "
+                        "third of the duration)")
+    p.add_argument("--kill-duration", type=float, default=2.0,
+                   help="seconds the injected fault stays armed")
     args = p.parse_args(argv)
 
     if args.compile_cache:
@@ -178,9 +189,40 @@ def main(argv=None) -> int:
             errors[0] += local_err
             count[0] += local_n
 
+    chaos: dict = {}
+
+    def chaos_thread():
+        """Kill replica N mid-run, heal it, time the supervisor recovery."""
+        from transmogrifai_tpu.resilience import inject
+
+        slot = args.kill_replica
+        sup = server.batcher.supervisor
+        brk = sup.breaker(slot)
+        time.sleep(args.kill_after if args.kill_after is not None
+                   else args.duration / 3.0)
+        inject.add_rule(f"serve.score#{slot}:fatal")
+        chaos["killed_at_s"] = round(time.monotonic() - t0, 3)
+        time.sleep(args.kill_duration)
+        inject.clear_rules("serve.score")
+        cleared = time.monotonic()
+        chaos["cleared_at_s"] = round(cleared - t0, 3)
+        deadline = cleared + 30.0
+        while time.monotonic() < deadline:
+            if brk.available:
+                chaos["recovery_s"] = round(time.monotonic() - cleared, 3)
+                break
+            time.sleep(0.02)
+        chaos["circuit"] = brk.snapshot()
+        chaos["supervisor_recoveries"] = sup.recoveries
+
     threads = [threading.Thread(target=client, daemon=True)
                for _ in range(args.concurrency)]
     t0 = time.monotonic()
+    if args.kill_replica is not None:
+        if not 0 <= args.kill_replica < registry.n_replicas:
+            p.error(f"--kill-replica {args.kill_replica} out of range "
+                    f"(0..{registry.n_replicas - 1})")
+        threads.append(threading.Thread(target=chaos_thread, daemon=True))
     for t in threads:
         t.start()
     for t in threads:
@@ -215,6 +257,10 @@ def main(argv=None) -> int:
         "continual": server_metrics.get("continual", {}),
         "server_metrics": server_metrics["serve"],
     }
+    if args.kill_replica is not None:
+        out["chaos"] = {"kill_replica": args.kill_replica,
+                        "kill_duration_s": args.kill_duration, **chaos}
+        out["resilience"] = server_metrics.get("resilience", {})
     print(json.dumps(out))
     if not args.no_record:
         # schema-versioned run record (context + full obs snapshot included)

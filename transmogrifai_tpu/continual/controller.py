@@ -38,6 +38,7 @@ __all__ = ["ControllerConfig", "Decision", "RetrainController", "scope"]
 scope = obs_registry.scope("continual", defaults={
     "evaluations": 0, "triggers": 0, "skips": 0, "retrains": 0,
     "promotions": 0, "rejections": 0, "rollbacks": 0,
+    "iteration_failures": 0, "backoff_skips": 0,
     "decisions": [], "last_drift": {}})
 
 

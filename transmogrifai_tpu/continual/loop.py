@@ -25,6 +25,7 @@ import numpy as np
 
 from ..obs import record as obs_record
 from ..obs import trace
+from ..resilience import inject as _inject
 from ..utils import env
 from . import promote as promote_mod
 from .controller import ControllerConfig, RetrainController, scope
@@ -77,6 +78,14 @@ class ContinualLoop:
         #: (champion_model, champion_version, pre-swap metrics snapshot) of
         #: the most recent promotion — the rollback watch's reference point
         self._watch: Optional[tuple] = None
+        # fault containment: a failed iteration must never take down the
+        # serving loop — it is recorded, the incumbent keeps serving, and
+        # retraining backs off exponentially until an iteration succeeds
+        self._clock = clock
+        self._backoff_s = max(0.0, env.env_float("TMOG_CONTINUAL_BACKOFF_S",
+                                                 30.0))
+        self._failures = 0
+        self._backoff_until = 0.0
 
     # ---- helpers -----------------------------------------------------------
     def _cost_hints(self) -> Dict[str, Any]:
@@ -127,6 +136,7 @@ class ContinualLoop:
         t0 = time.perf_counter()
         with trace.span("continual.retrain",
                         warm_start=bool(summary), rows=len(train_ds)):
+            _inject.maybe_fail("continual.retrain")
             challenger = wf.train()
         wall = time.perf_counter() - t0
         stats = sweep_ops.run_stats()
@@ -143,14 +153,42 @@ class ContinualLoop:
     def run_once(self, scores: Optional[Dict[str, Dict[str, float]]] = None,
                  version: Optional[str] = None) -> Dict[str, Any]:
         """One full policy iteration.  Returns the outcome record (also
-        appended to the telemetry JSONL as kind="continual")."""
+        appended to the telemetry JSONL as kind="continual").
+
+        Fault-contained: an exception anywhere in retrain/gate/promote is
+        caught and recorded (``iteration_failed`` decision row), the
+        incumbent keeps serving, and further triggered iterations are
+        skipped for an exponential backoff window
+        (``TMOG_CONTINUAL_BACKOFF_S``, doubling per consecutive failure)
+        — the loop never dies, it degrades to "stop retraining"."""
         out: Dict[str, Any] = {"outcome": "skip"}
         with trace.span("continual.run_once"):
             decision = self.controller.evaluate(scores,
                                                 cost_hints=self._cost_hints())
             out["decision"] = decision.to_json()
             if decision.triggered:
-                out.update(self._retrain_and_gate(version))
+                now = self._clock()
+                if now < self._backoff_until:
+                    out.update(outcome="backoff", backoff_remaining_s=round(
+                        self._backoff_until - now, 3))
+                    scope.inc("backoff_skips")
+                else:
+                    try:
+                        out.update(self._retrain_and_gate(version))
+                    except Exception as e:  # noqa: BLE001 — loop must survive
+                        self._failures += 1
+                        wait = self._backoff_s * (2 ** (self._failures - 1))
+                        self._backoff_until = now + wait
+                        scope.inc("iteration_failures")
+                        scope.append("decisions", {
+                            "action": "iteration_failed", "error": repr(e),
+                            "consecutive": self._failures,
+                            "backoff_s": round(wait, 3)})
+                        out.update(outcome="iteration_failed",
+                                   error=repr(e), backoff_s=round(wait, 3))
+                    else:
+                        self._failures = 0
+                        self._backoff_until = 0.0
         obs_record.write_record("continual", extra=out)
         return out
 

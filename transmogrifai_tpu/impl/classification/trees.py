@@ -210,16 +210,21 @@ class _BoostedClassifierBase(_TreeClassifierBase):
         # stored eta is the per-tree one
         k_eff = effective_trees_per_round(bp.get("trees_per_round", 1),
                                           bp["n_rounds"])
-        trees, _ = Tr.fit_gbt(jnp.asarray(Xb), _as_f32(y), jnp.asarray(sw),
-                              jnp.asarray(rw), jnp.asarray(fms), loss=loss,
-                              n_rounds=bp["n_rounds"], max_depth=bp["max_depth"],
-                              n_bins=bp["n_bins"], frontier=frontier,
-                              eta=bp["eta"],
-                              reg_lambda=bp["reg_lambda"], gamma=bp["gamma"],
-                              min_child_weight=bp["min_child_weight"],
-                              n_classes=k,
-                              min_info_gain=bp.get("min_info_gain", 0.0),
-                              trees_per_round=k_eff)
+        # preemption-safe: with TMOG_CHECKPOINT_DIR set the fit runs in
+        # checkpointed round segments (margins carried); otherwise this is
+        # exactly one fit_gbt call
+        from ...resilience import checkpointed_gbt_fit
+        trees, _ = checkpointed_gbt_fit(
+            Tr.fit_gbt, jnp.asarray(Xb), _as_f32(y), jnp.asarray(sw),
+            jnp.asarray(rw), jnp.asarray(fms), loss=loss,
+            n_rounds=bp["n_rounds"], max_depth=bp["max_depth"],
+            n_bins=bp["n_bins"], frontier=frontier,
+            eta=bp["eta"],
+            reg_lambda=bp["reg_lambda"], gamma=bp["gamma"],
+            min_child_weight=bp["min_child_weight"],
+            n_classes=k,
+            min_info_gain=bp.get("min_info_gain", 0.0),
+            trees_per_round=k_eff)
         return tree_params(trees, edges=edges, max_depth=bp["max_depth"],
                            eta=bp["eta"] / k_eff, num_classes=k, loss=loss)
 

@@ -49,6 +49,7 @@ def snapshot() -> Dict[str, Any]:
                 "transmogrifai_tpu.utils.flops",
                 "transmogrifai_tpu.serve.metrics",
                 "transmogrifai_tpu.serve.compile_cache",
+                "transmogrifai_tpu.resilience",
                 "transmogrifai_tpu.continual.controller"):
         try:
             __import__(mod)

@@ -74,6 +74,9 @@ except ImportError:  # the 0.4.x experimental home
 from ..obs import registry as obs_registry
 from ..obs import trace
 from ..parallel import mesh as mesh_mod
+from ..resilience import checkpoint as _ckpt
+from ..resilience import inject as _inject
+from ..resilience import retry as _retry
 from ..parallel.mesh import mesh_all_gather, mesh_psum
 from ..utils import devcache, flops
 from . import linear as L
@@ -537,6 +540,20 @@ def run_sweep(spec, X, xbs: Tuple, y, train_w, val_w, blob):
     F = train_w.shape[0]
     k = spec[0][1] if isinstance(spec[0], tuple) else 1
     split = F * C * n * k > SPLIT_METRICS_ELEMS
+    # whole-launch checkpoint (the single-device sweep is one work unit)
+    _ck = _ckpt.store()
+    ck_key = None
+    if _ck.enabled:
+        ck_key = _ckpt.content_key(
+            "sweep_launch", spec, blob, _ckpt.data_fingerprint(X),
+            _ckpt.data_fingerprint(y), _ckpt.data_fingerprint(train_w),
+            _ckpt.data_fingerprint(val_w))
+        hit = _ck.load("sweep_launch", ck_key)
+        if hit is not None:
+            _sweep_scope.inc("checkpoint_skips")
+            _sweep_scope.append("launches", {
+                "shards": 1, "candidates": C, "checkpoint": "hit"})
+            return jnp.asarray(hit[0]["metrics"])
     entry = {"shards": 1, "candidates": C, "split": bool(split)}
     chain = _spec_gbt_chain(spec)
     if chain:
@@ -547,6 +564,7 @@ def run_sweep(spec, X, xbs: Tuple, y, train_w, val_w, blob):
         if chain:
             trace.instant("gbt.chain", steps=chain["steps"],
                           levels=chain["levels"])
+        _inject.maybe_fail("sweep.dispatch", key="fused")
         if split:
             with mesh_mod.trace_collectives() as colls:
                 scores = _run_scores(spec, X, tuple(xbs), y, train_w, blob)
@@ -556,12 +574,15 @@ def run_sweep(spec, X, xbs: Tuple, y, train_w, val_w, blob):
                          tuple(xbs), y, train_w, blob)
             flops.record("sweep.run_metrics", _run_metrics, spec, y, scores,
                          val_w)
-            return out
-        with mesh_mod.trace_collectives() as colls:
-            out = _run(spec, X, tuple(xbs), y, train_w, val_w, blob)
-        _replay_trace_events(spec, n, colls)
-        flops.record("sweep.run", _run, spec, X, tuple(xbs), y, train_w,
-                     val_w, blob)
+        else:
+            with mesh_mod.trace_collectives() as colls:
+                out = _run(spec, X, tuple(xbs), y, train_w, val_w, blob)
+            _replay_trace_events(spec, n, colls)
+            flops.record("sweep.run", _run, spec, X, tuple(xbs), y, train_w,
+                         val_w, blob)
+        if ck_key is not None:
+            _ck.save("sweep_launch", ck_key, {"metrics": np.asarray(out)},
+                     meta={"candidates": C, "split": bool(split)})
         return out
 
 
@@ -577,7 +598,7 @@ def run_sweep(spec, X, xbs: Tuple, y, train_w, val_w, blob):
 #: also what ``obs.snapshot()["sweep"]`` reports.
 _sweep_scope = obs_registry.scope("sweep", defaults={
     "launches": [], "fallbacks": [], "compiles": 0, "compile_s": 0.0,
-    "pruned_candidates": 0, "full_candidates": 0})
+    "pruned_candidates": 0, "full_candidates": 0, "checkpoint_skips": 0})
 obs_registry.register_provider("sweep", lambda: run_stats())
 
 #: per-(name, spec, device, arg-signature) AOT executables.  jit's own cache
@@ -651,6 +672,9 @@ def run_stats() -> Dict[str, Any]:
             # candidates actually swept vs the cold grid's full count
             "pruned_candidates": _sweep_scope.get("pruned_candidates"),
             "full_candidates": _sweep_scope.get("full_candidates"),
+            # shards/launches skipped because a TMOG_CHECKPOINT_DIR
+            # checkpoint from a previous (possibly killed) run covered them
+            "checkpoint_skips": _sweep_scope.get("checkpoint_skips"),
             "fallbacks": _sweep_scope.list("fallbacks")}
 
 
@@ -677,7 +701,11 @@ def _aot(name: str, fn, spec, device, dyn_args) -> Tuple[Any, float, Tuple]:
     t0 = time.perf_counter()
     with trace.span("sweep.compile", fn=name, device=str(device)):
         with mesh_mod.trace_collectives() as colls:
-            compiled = fn.lower(spec, *dyn_args).compile()
+            def _compile():
+                _inject.maybe_fail("sweep.compile", key=name)
+                return fn.lower(spec, *dyn_args).compile()
+
+            compiled = _retry.with_retry("sweep.compile", _compile)
     dt = time.perf_counter() - t0
     _sweep_scope.inc("compiles")
     _sweep_scope.inc("compile_s", dt)
@@ -779,9 +807,31 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
     d = int(X_host.shape[1]) if X_host is not None else int(X.shape[1])
     k = shards[0].spec[0][1] if isinstance(shards[0].spec[0], tuple) else 1
     t_all = time.perf_counter()
+    # preemption-safe shard checkpoints: content-keyed on (sub-spec, global
+    # candidate ids, hyperparam blob, data fingerprints) so a killed sweep
+    # that restarts with the same inputs skips its completed shards
+    _ck = _ckpt.store()
+    ck_data = () if not _ck.enabled else (
+        _ckpt.data_fingerprint(X_host if X_host is not None else X),
+        _ckpt.data_fingerprint(y_host if y_host is not None else y),
+        _ckpt.data_fingerprint(train_w), _ckpt.data_fingerprint(val_w))
 
     def worker(shard, dev):
         t0 = time.perf_counter()
+        ck_key = None
+        if _ck.enabled:
+            ck_key = _ckpt.content_key(
+                "sweep_shard", shard.spec, tuple(map(int, shard.cis)),
+                shard.blob, *ck_data)
+            hit = _ck.load("sweep_shard", ck_key)
+            if hit is not None:
+                _sweep_scope.inc("checkpoint_skips")
+                stat = {"device": str(dev), "candidates": len(shard.cis),
+                        "predicted_cost": float(shard.cost),
+                        "compile_s": 0.0, "split": False,
+                        "checkpoint": "hit",
+                        "wall_s": round(time.perf_counter() - t0, 4)}
+                return hit[0]["metrics"], stat, []
         with trace.span("sweep.shard", device=str(dev),
                         candidates=len(shard.cis)):
             with trace.span("sweep.upload", device=str(dev)):
@@ -797,13 +847,20 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
                 args_s = (Xd, xbs_d, yd, tw, bl)
                 cs, dt_s, ev_s = _aot("sweep.run_scores", _run_scores,
                                       shard.spec, dev, args_s)
-                with trace.span("sweep.dispatch", device=str(dev),
-                                split=True):
-                    scores = cs(*args_s)
-                    args_m = (yd, scores, vw)
-                    cm, dt_m, ev_m = _aot("sweep.run_metrics", _run_metrics,
-                                          shard.spec, dev, args_m)
-                    out = cm(*args_m)
+
+                def _go_split():
+                    _inject.maybe_fail("sweep.dispatch", key=str(dev))
+                    with trace.span("sweep.dispatch", device=str(dev),
+                                    split=True):
+                        scores = cs(*args_s)
+                        args_m = (yd, scores, vw)
+                        cm, dt_m, ev_m = _aot("sweep.run_metrics",
+                                              _run_metrics, shard.spec, dev,
+                                              args_m)
+                        return cm(*args_m), args_m, cm, dt_m, ev_m
+
+                out, args_m, cm, dt_m, ev_m = _retry.with_retry(
+                    "sweep.dispatch", _go_split)
                 compile_s = dt_s + dt_m
                 records = [("sweep.run_scores", cs, args_s, ev_s),
                            ("sweep.run_metrics", cm, args_m, ev_m)]
@@ -811,9 +868,14 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
                 args = (Xd, xbs_d, yd, tw, vw, bl)
                 c, compile_s, ev = _aot("sweep.run", _run, shard.spec, dev,
                                         args)
-                with trace.span("sweep.dispatch", device=str(dev),
-                                split=False):
-                    out = c(*args)
+
+                def _go():
+                    _inject.maybe_fail("sweep.dispatch", key=str(dev))
+                    with trace.span("sweep.dispatch", device=str(dev),
+                                    split=False):
+                        return c(*args)
+
+                out = _retry.with_retry("sweep.dispatch", _go)
                 records = [("sweep.run", c, args, ev)]
             # block in THIS thread only: other shards keep dispatching/running
             with trace.span("sweep.gather", device=str(dev)):
@@ -825,6 +887,10 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
         feat = _shard_feat(shard.spec, n, d, F)
         if feat is not None:
             stat["feat"] = feat
+        if ck_key is not None:
+            _ck.save("sweep_shard", ck_key, {"metrics": out},
+                     meta={"candidates": C_s, "split": bool(split)})
+            stat["checkpoint"] = "saved"
         return out, stat, records
 
     with trace.span("sweep.launch", shards=len(shards),
@@ -873,8 +939,12 @@ def _aot_rs(spec, submesh, n_orig: int, dyn_args) -> Tuple[Any, float, Tuple]:
     with trace.span("sweep.compile", fn="sweep.run_rs",
                     devices=len(np.asarray(submesh.devices).flat)):
         with mesh_mod.trace_collectives() as colls:
-            compiled = _run_rs.lower(spec, submesh, n_orig,
+            def _compile():
+                _inject.maybe_fail("sweep.compile", key="sweep.run_rs")
+                return _run_rs.lower(spec, submesh, n_orig,
                                      *dyn_args).compile()
+
+            compiled = _retry.with_retry("sweep.compile", _compile)
     dt = time.perf_counter() - t0
     _sweep_scope.inc("compiles")
     _sweep_scope.inc("compile_s", dt)
@@ -954,9 +1024,31 @@ def run_sweep_rowsharded(shards, X, xbs: Tuple, y, train_w, val_w,
     tw_host = np.asarray(train_w, np.float32)
     vw_host = np.asarray(val_w, np.float32)
     t_all = time.perf_counter()
+    # shard checkpoints, as in run_sweep_partitioned; the key carries the
+    # data-shard count because the launch layout is part of the artifact
+    _ck = _ckpt.store()
+    ck_data = () if not _ck.enabled else (
+        ("rs", int(n_data)),
+        _ckpt.data_fingerprint(X_host if X_host is not None else X),
+        _ckpt.data_fingerprint(y_host if y_host is not None else y),
+        _ckpt.data_fingerprint(tw_host), _ckpt.data_fingerprint(vw_host))
 
     def worker(shard, j):
         t0 = time.perf_counter()
+        ck_key = None
+        if _ck.enabled:
+            ck_key = _ckpt.content_key(
+                "sweep_shard", shard.spec, tuple(map(int, shard.cis)),
+                shard.blob, *ck_data)
+            hit = _ck.load("sweep_shard", ck_key)
+            if hit is not None:
+                _sweep_scope.inc("checkpoint_skips")
+                stat = {"devices": [str(d) for d in grid[:, j]],
+                        "candidates": len(shard.cis),
+                        "predicted_cost": float(shard.cost),
+                        "compile_s": 0.0, "checkpoint": "hit",
+                        "wall_s": round(time.perf_counter() - t0, 4)}
+                return hit[0]["metrics"], stat, None
         submesh = Mesh(grid[:, j], (mesh_mod.DATA_AXIS,))
         with trace.span("sweep.shard", column=j, data_shards=int(n_data),
                         candidates=len(shard.cis)):
@@ -977,8 +1069,13 @@ def run_sweep_rowsharded(shards, X, xbs: Tuple, y, train_w, val_w,
             args = (Xd, xbs_d, yd, tw, vw, bl)
             compiled, compile_s, colls = _aot_rs(shard.spec, submesh, n_orig,
                                                  args)
-            with trace.span("sweep.dispatch", column=j):
-                out = compiled(*args)
+
+            def _go():
+                _inject.maybe_fail("sweep.dispatch", key=f"rs{j}")
+                with trace.span("sweep.dispatch", column=j):
+                    return compiled(*args)
+
+            out = _retry.with_retry("sweep.dispatch", _go)
             # block in THIS thread only: other columns keep
             # dispatching/running
             with trace.span("sweep.gather", column=j):
@@ -995,6 +1092,10 @@ def run_sweep_rowsharded(shards, X, xbs: Tuple, y, train_w, val_w,
                            rows_local=n_pad // n_data)
         if feat is not None:
             stat["feat"] = feat
+        if ck_key is not None:
+            _ck.save("sweep_shard", ck_key, {"metrics": out},
+                     meta={"candidates": len(shard.cis), "rowsharded": True})
+            stat["checkpoint"] = "saved"
         return out, stat, ("sweep.run_rs", compiled, args, label, colls,
                            n_orig, n_pad)
 
@@ -1016,6 +1117,8 @@ def run_sweep_rowsharded(shards, X, xbs: Tuple, y, train_w, val_w,
     for (out, stat, rec), shard in zip(results, shards):
         metrics[:, np.asarray(shard.cis, np.int64), :] = out[:F]
         per_shard.append(stat)
+        if rec is None:  # shard restored from checkpoint: nothing ran
+            continue
         name, compiled, args, label, colls, n_orig, n_pad = rec
         flops.record_compiled(name, compiled, args, device=label)
         flops.record_collectives(colls, device=label)

@@ -1099,7 +1099,8 @@ def _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int
               gamma, min_child_weight, base_score: float, n_classes: int,
               min_info_gain=0.0, exact_cap: bool = False,
               axis_name: Optional[str] = None,
-              trees_per_round: int = 1) -> Tuple[Tree, jax.Array]:
+              trees_per_round: int = 1,
+              init_margins=None) -> Tuple[Tree, jax.Array]:
     """Traceable boosting body shared by fit_gbt and fit_gbt_batch.
 
     ``trees_per_round`` = K > 1 collapses the boosting chain: the scan takes
@@ -1108,12 +1109,19 @@ def _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int
     ``eta / K`` — the boosted-forest round-collapse.  K must divide
     ``n_rounds``.  The stacked tree axis stays [n_rounds, ...] and
     ``predict_gbt`` with ``eta / K`` scores it unchanged.
+
+    ``init_margins`` (f32[n, c], default None) seeds the boosting carry F
+    instead of ``base_score`` — a later segment of a checkpointed fit
+    resumes from the previous segment's final margins and grows the exact
+    trees the unsegmented scan would have (boosting is sequential over F,
+    so carrying F is the whole fit state besides the up-front rw/fm draws).
     """
     n = Xb.shape[0]
     c = n_classes if loss == "softmax" else 1
     Y = jax.nn.one_hot(y.astype(jnp.int32), max(c, 2), dtype=jnp.float32) \
         if loss == "softmax" else jnp.zeros((n, 2), jnp.float32)
-    F0 = jnp.full((n, c), base_score, jnp.float32)
+    F0 = (jnp.asarray(init_margins, jnp.float32) if init_margins is not None
+          else jnp.full((n, c), base_score, jnp.float32))
     use_mm = _hist_via_matmul(n, Xb.shape[1], n_bins, c + 1)
     K = int(trees_per_round)
 
@@ -1178,7 +1186,8 @@ def fit_gbt(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int,
             min_child_weight: float = 1.0, base_score: float = 0.0,
             n_classes: int = 1, min_info_gain: float = 0.0,
             exact_cap: bool = False,
-            trees_per_round: int = 1) -> Tuple[Tree, jax.Array]:
+            trees_per_round: int = 1,
+            init_margins=None) -> Tuple[Tree, jax.Array]:
     """XGBoost-style boosting: scan over rounds, one histogram tree per round.
 
     row_w_rounds: f32[R, n] subsample weights per round; feat_mask_rounds:
@@ -1186,13 +1195,16 @@ def fit_gbt(Xb, y, w, row_w_rounds, feat_mask_rounds, loss: str, n_rounds: int,
     vector per class) — a TPU-friendly variant of per-class tree sets.
     ``trees_per_round`` = K > 1 grows K trees per boosting step at eta / K
     (round-collapse; callers scoring the stacked trees must scale eta the
-    same way).  Returns (stacked Tree [R, ...], final margins F [n, c]).
+    same way).  ``init_margins`` seeds the carry F for segmented
+    (checkpoint-resumable) fits.  Returns (stacked Tree [R, ...], final
+    margins F [n, c]).
     """
     return _gbt_impl(Xb, y, w, row_w_rounds, feat_mask_rounds, loss, n_rounds,
                      max_depth, n_bins, frontier, eta, reg_lambda, gamma,
                      min_child_weight, base_score, n_classes,
                      min_info_gain=min_info_gain, exact_cap=exact_cap,
-                     trees_per_round=trees_per_round)
+                     trees_per_round=trees_per_round,
+                     init_margins=init_margins)
 
 
 def _gbt_batch_impl(Xb, y, w_batch, row_w_rounds, feat_mask_rounds, loss: str,

@@ -1,0 +1,63 @@
+"""Fault-tolerance layer: deterministic fault injection, retries with
+backoff, circuit breakers, and preemption-safe content-keyed checkpoints.
+
+The reference system inherited fault tolerance from Spark (RDD lineage and
+task retry); this package is the JAX port's replacement substrate:
+
+- :mod:`.inject` — env-driven deterministic fault injection
+  (``TMOG_FAULTS="site:kind:prob:seed,..."``) with named hook sites threaded
+  through the hot paths, so chaos runs reproduce bit-for-bit in CI.
+- :mod:`.retry` — ONE retry-with-exponential-backoff+jitter wrapper
+  (deadline-aware, transient-vs-fatal classification) used at every site.
+- :mod:`.circuit` — a closed/open/half-open circuit breaker (per serve
+  replica slot; generic otherwise).
+- :mod:`.checkpoint` — atomic (temp + ``os.replace``) content-keyed
+  checkpoints under ``TMOG_CHECKPOINT_DIR``: completed sweep shards, the GBT
+  boosting carry (trees-so-far + margins) at a round cadence, and streaming
+  transform chunks, so a SIGKILL mid-fit resumes instead of restarting.
+
+Everything is off by default: ``TMOG_FAULTS`` / ``TMOG_CHECKPOINT_DIR``
+unset leaves every hot path bit-identical to the pre-resilience code (one
+boolean test per site).
+"""
+from __future__ import annotations
+
+from ..obs import registry as _obs_registry
+
+# One shared obs scope for the whole layer.  Created here, before the
+# submodules import, so every module sees the same defaulted scope.
+scope = _obs_registry.scope("resilience", defaults=dict(
+    faults_injected=0,
+    attempts=0,
+    retries=0,
+    recoveries=0,
+    gave_up=0,
+    checkpoint_saves=0,
+    checkpoint_hits=0,
+    checkpoint_corrupt=0,
+    checkpoint_errors=0,
+    gbt_rounds_skipped=0,
+    circuit_opens=0,
+    circuit_closes=0,
+    replica_recoveries=0,
+    supervisor_beats=0,
+    faults=[],
+))
+
+from .checkpoint import (CheckpointStore, checkpoint_dir,  # noqa: E402
+                         checkpointed_gbt_fit, content_key, data_fingerprint,
+                         store)
+from .circuit import CLOSED, HALF_OPEN, OPEN, CircuitBreaker  # noqa: E402
+from .inject import (InjectedFault, InjectedFatal, active, add_rule,  # noqa: E402
+                     clear_rules, configure, maybe_fail)
+from .retry import RetryPolicy, is_transient, with_retry  # noqa: E402
+
+__all__ = [
+    "scope",
+    "InjectedFault", "InjectedFatal", "maybe_fail", "configure", "add_rule",
+    "clear_rules", "active",
+    "RetryPolicy", "with_retry", "is_transient",
+    "CircuitBreaker", "CLOSED", "OPEN", "HALF_OPEN",
+    "CheckpointStore", "store", "checkpoint_dir", "content_key",
+    "data_fingerprint", "checkpointed_gbt_fit",
+]

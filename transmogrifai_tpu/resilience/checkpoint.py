@@ -1,0 +1,247 @@
+"""Preemption-safe, content-keyed checkpoints under ``TMOG_CHECKPOINT_DIR``.
+
+Checkpoints are keyed by a content hash of the work unit (spec + a strided
+fingerprint of the input arrays), not by run id: a killed process that
+restarts with the same inputs finds its own completed work, and a changed
+input silently misses — no staleness to invalidate.  Writes are atomic
+(temp file + ``os.replace``, the compile-cache idiom), so a kill mid-write
+leaves either the previous checkpoint or none, never a torn one.  Unset
+``TMOG_CHECKPOINT_DIR`` disables everything at a single boolean test.
+
+Three producers:
+
+- sweep shards (:mod:`..ops.sweep`) checkpoint each completed shard's
+  metric block; a resumed sweep skips straight past them
+  (``checkpoint_skips`` in ``run_stats()``).
+- :func:`checkpointed_gbt_fit` segments a boosting fit at a
+  ``TMOG_CHECKPOINT_ROUNDS`` cadence, carrying (trees-so-far + margins)
+  between segments — boosting is sequential over the margins F, so a
+  resumed fit regrows only the unfinished rounds and is bit-identical.
+- streaming transforms (:mod:`..workflow.stream`) checkpoint per-chunk
+  terminal outputs and resume at the chunk boundary.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..obs import registry as obs_registry
+from ..obs import trace
+from ..utils import env as _env
+
+__all__ = ["CheckpointStore", "store", "checkpoint_dir", "content_key",
+           "data_fingerprint", "checkpointed_gbt_fit"]
+
+_scope = obs_registry.scope("resilience")
+
+_KEY_SALT = b"tmog-ckpt-v1"
+
+
+def checkpoint_dir() -> str:
+    return _env.env_str("TMOG_CHECKPOINT_DIR", "")
+
+
+def data_fingerprint(arr) -> str:
+    """Cheap deterministic array fingerprint: shape + dtype + a strided
+    ~4096-element sample of the values.  Works on numpy and jax arrays; for
+    a device array only the sampled slice is pulled to host."""
+    shape = tuple(getattr(arr, "shape", ()))
+    dtype = str(getattr(arr, "dtype", type(arr).__name__))
+    h = hashlib.sha256(_KEY_SALT)
+    h.update(repr((shape, dtype)).encode())
+    n = 1
+    for s in shape:
+        n *= int(s)
+    if n:
+        step = max(1, n // 4096)
+        try:
+            flat = arr.reshape(-1)[::step]
+        except Exception:
+            flat = np.asarray(arr).reshape(-1)[::step]
+        h.update(np.ascontiguousarray(np.asarray(flat)).tobytes())
+    return h.hexdigest()[:20]
+
+
+def content_key(*parts) -> str:
+    """Hash heterogeneous parts (arrays via :func:`data_fingerprint`,
+    everything else via ``repr``) into one checkpoint key."""
+    h = hashlib.sha256(_KEY_SALT)
+    for p in parts:
+        if hasattr(p, "shape") and hasattr(p, "dtype"):
+            h.update(data_fingerprint(p).encode())
+        elif isinstance(p, bytes):
+            h.update(p)
+        else:
+            h.update(repr(p).encode())
+        h.update(b"\x00")
+    return h.hexdigest()[:24]
+
+
+class CheckpointStore:
+    """Atomic npz checkpoints (arrays + a JSON meta blob) in one flat dir."""
+
+    def __init__(self, root: Optional[str] = None):
+        self.root = checkpoint_dir() if root is None else root
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.root)
+
+    def _path(self, kind: str, key: str) -> str:
+        return os.path.join(self.root, f"{kind}-{key}.npz")
+
+    def save(self, kind: str, key: str, arrays: Dict[str, Any],
+             meta: Optional[Dict[str, Any]] = None) -> Optional[str]:
+        if not self.enabled:
+            return None
+        path = self._path(kind, key)
+        payload = {f"a_{k}": np.asarray(v) for k, v in arrays.items()}
+        payload["__meta__"] = np.frombuffer(
+            json.dumps(meta or {}, default=str).encode(), dtype=np.uint8)
+        tmp = ""
+        try:
+            os.makedirs(self.root, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
+            with trace.span("resilience.checkpoint_save", kind=kind, key=key):
+                with os.fdopen(fd, "wb") as fh:
+                    np.savez_compressed(fh, **payload)
+                os.replace(tmp, path)
+        except OSError as exc:
+            _scope.inc("checkpoint_errors")
+            obs_registry.record_fallback(
+                "resilience", "checkpoint_save_failed", kind=kind,
+                path=path, error=repr(exc))
+            return None
+        finally:
+            if tmp and os.path.exists(tmp):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+        _scope.inc("checkpoint_saves")
+        return path
+
+    def load(self, kind: str, key: str
+             ) -> Optional[Tuple[Dict[str, np.ndarray], Dict[str, Any]]]:
+        """-> (arrays, meta), or None when absent.  A corrupt/truncated file
+        (a kill mid-write can't produce one, but a bad disk can) is counted,
+        recorded, deleted, and treated as absent — resume redoes that unit."""
+        if not self.enabled:
+            return None
+        path = self._path(kind, key)
+        if not os.path.exists(path):
+            return None
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                meta = (json.loads(bytes(z["__meta__"].tobytes()).decode())
+                        if "__meta__" in z.files else {})
+                arrays = {k[2:]: z[k] for k in z.files if k.startswith("a_")}
+        except Exception as exc:
+            _scope.inc("checkpoint_corrupt")
+            obs_registry.record_fallback(
+                "resilience", "corrupt_checkpoint", kind=kind, path=path,
+                error=repr(exc))
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return None
+        _scope.inc("checkpoint_hits")
+        return arrays, meta
+
+
+def store() -> CheckpointStore:
+    """A store bound to the CURRENT ``TMOG_CHECKPOINT_DIR`` value (rebuilt
+    per call so tests and subprocesses that mutate the env never see a
+    stale root)."""
+    return CheckpointStore()
+
+
+def gbt_cadence(trees_per_round: int = 1) -> int:
+    """Checkpoint cadence in boosting rounds, aligned down to a multiple of
+    the round-collapse K (segments must start on a scan-step boundary)."""
+    cadence = _env.env_int("TMOG_CHECKPOINT_ROUNDS", 100)
+    if cadence <= 0:
+        return 0
+    k = max(1, int(trees_per_round))
+    return max(k, (cadence // k) * k)
+
+
+def checkpointed_gbt_fit(fit_fn, Xb, y, w, rw, fms, *, n_rounds: int,
+                         trees_per_round: int = 1, key_extra=(), **kw):
+    """Run ``fit_fn`` (a ``fit_gbt``-shaped callable) in checkpointed
+    segments of ``TMOG_CHECKPOINT_ROUNDS`` rounds, carrying the margins F
+    between segments and persisting (trees-so-far + margins) after each
+    non-final segment.  With checkpointing disabled this is exactly one
+    ``fit_fn`` call — bit-identical to the pre-resilience path.
+
+    The rw/fms draws are made up-front by the caller, so slicing
+    ``rw[lo:hi]`` hands each segment exactly the draws the unsegmented scan
+    would have consumed; boosting's only other state is F.  Returns
+    ``(trees, F)`` with the stacked tree axis concatenated across segments
+    on host.
+    """
+    st = store()
+    cadence = gbt_cadence(trees_per_round)
+    if not st.enabled or cadence <= 0 or cadence >= n_rounds:
+        return fit_fn(Xb, y, w, rw, fms, n_rounds=n_rounds,
+                      trees_per_round=trees_per_round, **kw)
+
+    import jax
+
+    from .inject import maybe_fail
+
+    key = content_key("gbt", n_rounds, trees_per_round,
+                      tuple(sorted(kw.items())), Xb, y, w, rw, fms,
+                      *key_extra)
+    done_rounds = 0
+    tree_parts = []            # list of leaf-lists, one per resolved block
+    margins = None
+    n_leaves = None
+    ck = st.load("gbt", key)
+    if ck is not None:
+        arrays, meta = ck
+        saved = int(meta.get("rounds", 0))
+        nl = int(meta.get("n_leaves", -1))
+        if (0 < saved < n_rounds and saved % cadence == 0
+                and all(f"t{i}" in arrays for i in range(max(nl, 0)))
+                and "margins" in arrays and nl >= 0):
+            done_rounds = saved
+            n_leaves = nl
+            margins = arrays["margins"]
+            tree_parts.append([arrays[f"t{i}"] for i in range(nl)])
+            _scope.inc("gbt_rounds_skipped", done_rounds)
+
+    treedef = None
+    for lo in range(0, n_rounds, cadence):
+        hi = min(n_rounds, lo + cadence)
+        if hi <= done_rounds:
+            continue
+        maybe_fail("trees.gbt_segment")
+        with trace.span("resilience.gbt_segment", lo=lo, hi=hi):
+            seg_trees, margins = fit_fn(
+                Xb, y, w, rw[lo:hi], fms[lo:hi], n_rounds=hi - lo,
+                trees_per_round=trees_per_round, init_margins=margins, **kw)
+        leaves = [np.asarray(a) for a in jax.tree_util.tree_leaves(seg_trees)]
+        treedef = jax.tree_util.tree_structure(seg_trees)
+        n_leaves = len(leaves)
+        tree_parts.append(leaves)
+        if hi < n_rounds:  # the final segment never needs a checkpoint
+            acc = [np.concatenate(parts, axis=0) if len(tree_parts) > 1
+                   else parts[0] for parts in zip(*tree_parts)]
+            tree_parts = [acc]
+            st.save("gbt", key,
+                    {**{f"t{i}": a for i, a in enumerate(acc)},
+                     "margins": np.asarray(margins)},
+                    meta={"rounds": hi, "n_leaves": n_leaves,
+                          "n_rounds": n_rounds})
+
+    merged = [np.concatenate(parts, axis=0) if len(tree_parts) > 1
+              else parts[0] for parts in zip(*tree_parts)]
+    trees = jax.tree_util.tree_unflatten(treedef, merged)
+    return trees, margins

@@ -1,0 +1,182 @@
+"""Deterministic, env-driven fault injection.
+
+``TMOG_FAULTS`` arms a comma-separated list of rules::
+
+    site[#key]:kind[:prob[:seed[:after[:fires]]]]
+
+- ``site`` — a named hook site (``sweep.compile``, ``sweep.dispatch``,
+  ``stream.upload``, ``stream.pull``, ``serve.score``, ``serve.warm``,
+  ``compile_cache.load``, ``continual.retrain``, ``trees.gbt_segment``).
+  An optional ``#key`` suffix narrows the rule to one instance of the site
+  (e.g. ``serve.score#1`` fails only replica slot 1).
+- ``kind`` — ``error`` (raises :class:`InjectedFault`, classified
+  transient, so the retry wrapper absorbs it), ``fatal`` (raises
+  :class:`InjectedFatal`, never retried), or ``kill`` (``SIGKILL`` to the
+  current process — a deterministic preemption).
+- ``prob`` — firing probability per eligible invocation (default 1).
+- ``seed`` — seeds the rule's private ``random.Random`` so a chaos run is
+  reproducible under a fixed ``TMOG_FAULTS`` string (default 0).
+- ``after`` — skip the first N matching invocations (default 0); with
+  ``prob=1`` this pins the fault to the (N+1)-th hit exactly, independent
+  of RNG, which is what the kill-and-resume tests use.
+- ``fires`` — stop after N injected faults (default 0 = unlimited).
+  ``error:1:0:0:1`` is the canonical deterministic TRANSIENT fault: it
+  fails the first invocation once and lets the retry succeed.
+
+``maybe_fail(site, key=...)`` is the hook the hot paths call.  With
+``TMOG_FAULTS`` unset it is a single module-global boolean test — the
+no-faults path stays bit-identical to a build without this module.
+"""
+from __future__ import annotations
+
+import os
+import random
+import signal
+import threading
+from typing import List, Optional
+
+from ..obs import registry as obs_registry
+from ..utils import env as _env
+
+__all__ = ["InjectedFault", "InjectedFatal", "maybe_fail", "configure",
+           "add_rule", "clear_rules", "active"]
+
+_scope = obs_registry.scope("resilience")
+
+
+class InjectedFault(RuntimeError):
+    """A transient injected failure: the retry wrapper may absorb it."""
+
+    transient = True
+
+
+class InjectedFatal(RuntimeError):
+    """A permanent injected failure: never retried."""
+
+    transient = False
+
+
+_KINDS = ("error", "fatal", "kill")
+
+
+class _Rule:
+    __slots__ = ("site", "key", "kind", "prob", "seed", "after", "fires",
+                 "rng", "count", "fired")
+
+    def __init__(self, site: str, key: Optional[str], kind: str,
+                 prob: float, seed: int, after: int, fires: int = 0):
+        self.site = site
+        self.key = key
+        self.kind = kind
+        self.prob = prob
+        self.seed = seed
+        self.after = after
+        self.fires = fires   # max injections (0 = unlimited)
+        self.rng = random.Random(seed)
+        self.count = 0   # eligible invocations seen
+        self.fired = 0   # faults actually injected
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        tgt = self.site + (f"#{self.key}" if self.key is not None else "")
+        return (f"_Rule({tgt}:{self.kind}:{self.prob}:{self.seed}"
+                f":{self.after}:{self.fires} "
+                f"count={self.count} fired={self.fired})")
+
+
+_rules: List[_Rule] = []
+_active = False
+_lock = threading.Lock()
+
+
+def parse_rules(spec: str) -> List[_Rule]:
+    rules: List[_Rule] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        if len(fields) < 2:
+            raise ValueError(
+                f"bad TMOG_FAULTS rule {part!r}: want "
+                "site[#key]:kind[:prob[:seed[:after[:fires]]]]")
+        site = fields[0].strip()
+        key: Optional[str] = None
+        if "#" in site:
+            site, key = site.split("#", 1)
+        kind = fields[1].strip().lower()
+        if kind not in _KINDS:
+            raise ValueError(f"bad TMOG_FAULTS kind {kind!r} in {part!r}: "
+                             f"want one of {_KINDS}")
+        prob = float(fields[2]) if len(fields) > 2 and fields[2].strip() else 1.0
+        seed = int(fields[3]) if len(fields) > 3 and fields[3].strip() else 0
+        after = int(fields[4]) if len(fields) > 4 and fields[4].strip() else 0
+        fires = int(fields[5]) if len(fields) > 5 and fields[5].strip() else 0
+        rules.append(_Rule(site, key, kind, prob, seed, after, fires))
+    return rules
+
+
+def configure(spec: Optional[str] = None) -> int:
+    """(Re)arm the registry from ``spec`` (or ``$TMOG_FAULTS`` when None);
+    returns the number of active rules.  ``configure("")`` disarms."""
+    global _rules, _active
+    if spec is None:
+        spec = _env.env_str("TMOG_FAULTS", "")
+    with _lock:
+        _rules = parse_rules(spec) if spec else []
+        _active = bool(_rules)
+    return len(_rules)
+
+
+def add_rule(rule_spec: str) -> None:
+    """Arm extra rules programmatically (probe_serve ``--kill-replica``)."""
+    global _active
+    new = parse_rules(rule_spec)
+    with _lock:
+        _rules.extend(new)
+        _active = bool(_rules)
+
+
+def clear_rules(site: Optional[str] = None) -> None:
+    """Disarm every rule, or only the rules for one site."""
+    global _rules, _active
+    with _lock:
+        _rules = [] if site is None else [r for r in _rules if r.site != site]
+        _active = bool(_rules)
+
+
+def active() -> bool:
+    return _active
+
+
+def maybe_fail(site: str, key=None) -> None:
+    """Fault hook: raise/kill if an armed rule matches this invocation."""
+    if not _active:  # the TMOG_FAULTS-unset fast path: one boolean test
+        return
+    skey = None if key is None else str(key)
+    for r in _rules:
+        if r.site != site or (r.key is not None and r.key != skey):
+            continue
+        with _lock:
+            r.count += 1
+            hit = (r.count > r.after
+                   and (r.fires <= 0 or r.fired < r.fires)
+                   and r.rng.random() < r.prob)
+            if hit:
+                r.fired += 1
+        if not hit:
+            continue
+        _scope.inc("faults_injected")
+        _scope.append("faults", {
+            "event": "injected", "site": site, "key": skey,
+            "kind": r.kind, "hit": r.fired, "invocation": r.count,
+        })
+        if r.kind == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        cls = InjectedFault if r.kind == "error" else InjectedFatal
+        where = site if skey is None else f"{site}#{skey}"
+        raise cls(f"injected {r.kind} at {where} "
+                  f"(hit {r.fired}, invocation {r.count})")
+
+
+# Arm from the environment at import so subprocess chaos runs need no code.
+configure()

@@ -36,6 +36,27 @@ from .workflow.params import OpParams
 from .workflow.workflow import OpWorkflow
 
 
+def _resume_stats() -> Optional[Dict[str, Any]]:
+    """Checkpoint/resume accounting for the run record, or None when this
+    run touched no checkpoint (``TMOG_CHECKPOINT_DIR`` unset).  Pulled from
+    the resilience scope plus the per-subsystem skip counters, so a resumed
+    train shows exactly how much work the checkpoints saved it."""
+    from . import resilience
+    from .obs import registry as obs_registry
+
+    snap = resilience.scope.snapshot()
+    out = {k: snap.get(k, 0) for k in (
+        "checkpoint_saves", "checkpoint_hits", "checkpoint_corrupt",
+        "gbt_rounds_skipped")}
+    out["sweep_shard_skips"] = obs_registry.scope("sweep").get(
+        "checkpoint_skips")
+    out["stream_chunk_skips"] = obs_registry.scope("stream").get(
+        "checkpoint_skips")
+    if not any(out.values()):
+        return None
+    return out
+
+
 class OpWorkflowRunType(str, enum.Enum):
     """OpWorkflowRunner.scala:358-365, plus the online ``Serve`` type."""
 
@@ -121,8 +142,12 @@ class OpWorkflowRunner:
         if loc:
             with listener.step(OpStep.ModelIO):
                 model.save(loc)
+        metrics: Dict[str, Any] = {"summary": model.summary()}
+        resume = _resume_stats()
+        if resume is not None:  # checkpointed/resumed work this run
+            metrics["resume"] = resume
         return OpWorkflowRunnerResult(OpWorkflowRunType.Train, model_location=loc,
-                                      metrics={"summary": model.summary()})
+                                      metrics=metrics)
 
     def _load_model(self, params: OpParams, listener: OpListener) -> OpWorkflowModel:
         if not params.model_location:

@@ -17,6 +17,8 @@ Layering::
     batcher.py        bounded admission queue -> padded bucket batches ->
                       least-outstanding-work replica routing
     registry.py       versioned models, N replica slots, rolling hot-swap
+    supervisor.py     self-healing: per-slot circuit breakers + the probe/
+                      rebuild daemon (degraded host path when all slots down)
     aot.py            per-(bucket, device) AOT score programs over the
                       streaming planner (device-resident score feed)
     compile_cache.py  persistent serialized-executable cache
@@ -32,9 +34,11 @@ from .metrics import LatencyHistogram, ServeMetrics, prometheus_replica_text
 from .registry import (ModelRegistry, Replica, ServingModel, bucket_for,
                        shape_buckets)
 from .server import ModelServer
+from .supervisor import ReplicaSupervisor
 
 __all__ = [
     "LatencyHistogram", "MicroBatcher", "ModelRegistry", "ModelServer",
-    "Replica", "Scored", "ServeMetrics", "ServingModel", "ShedError",
+    "Replica", "ReplicaSupervisor", "Scored", "ServeMetrics",
+    "ServingModel", "ShedError",
     "bucket_for", "prometheus_replica_text", "shape_buckets",
 ]

@@ -34,8 +34,10 @@ from concurrent.futures import Future
 from typing import Any, Dict, List, NamedTuple, Optional
 
 from ..obs import trace
+from ..resilience import retry as _retry
 from .metrics import ServeMetrics
 from .registry import ModelRegistry, bucket_for
+from .supervisor import ReplicaSupervisor
 
 
 class ShedError(RuntimeError):
@@ -86,6 +88,11 @@ class MicroBatcher:
         self.metrics.add_gauge("outstanding", lambda: self._outstanding)
         self._slot_queues: List["queue.Queue"] = [
             queue.Queue() for _ in range(registry.n_replicas)]
+        # self-healing: per-slot circuit breakers + the probe/rebuild daemon
+        # (serve/supervisor.py); shared with the registry so /metrics and
+        # /models surface per-slot health
+        self.supervisor = ReplicaSupervisor(registry, metrics=self.metrics)
+        registry.supervisor = self.supervisor
         self._running = False
         self._collector: Optional[threading.Thread] = None
         self._workers: List[threading.Thread] = []
@@ -104,10 +111,12 @@ class MicroBatcher:
             for i in range(len(self._slot_queues))]
         for w in self._workers:
             w.start()
+        self.supervisor.start()
         return self
 
     def stop(self, timeout_s: float = 10.0) -> None:
         self._running = False
+        self.supervisor.stop()
         if self._collector is not None:
             self._collector.join(timeout_s)
             self._collector = None
@@ -178,10 +187,19 @@ class MicroBatcher:
             self._slot_queues[self._pick_slot()].put(batch)
 
     def _pick_slot(self) -> int:
-        """Least-outstanding-work routing: queued batches + in-flight work."""
+        """Least-outstanding-work routing: queued batches + in-flight work.
+        Slots with an open circuit are routed AROUND (survivors absorb the
+        load); a slot due its half-open trial counts as routable so real
+        traffic can re-admit it.  With every circuit open the least-loaded
+        slot still wins — dispatch then degrades those batches to the host
+        row path rather than failing them."""
         slots = self.registry.slots()
+        sup = self.supervisor
+        all_down = not sup.any_routable()
         best, best_load = 0, None
         for i, q in enumerate(self._slot_queues):
+            if not all_down and not sup.routable(i):
+                continue
             load = q.qsize()
             rep = slots[i] if i < len(slots) else None
             if rep is not None:
@@ -228,14 +246,26 @@ class MicroBatcher:
         n = len(batch)
         bucket = bucket_for(n, entry.buckets)
         records = [p.record for p in batch] + [{} for _ in range(bucket - n)]
+        sup = self.supervisor
+        brk = sup.breaker(slot)
         t0 = time.monotonic()
         try:
             with trace.span("serve.batch", records=n, bucket=bucket,
                             version=entry.version, replica=rep.id):
-                try:
-                    outputs = rep.score(records)[:n]
-                except Exception:
+                if not brk.available and not brk.try_trial():
+                    # circuit open and no trial due: don't touch the dead
+                    # replica — degraded mode, host numpy row path (reduced
+                    # throughput, zero downtime)
+                    self.metrics.inc("degraded_batches")
                     outputs = self._fallback(entry, batch)
+                else:
+                    try:
+                        outputs = _retry.with_retry(
+                            "serve.score", rep.score, records)[:n]
+                        sup.note_success(slot)
+                    except Exception as e:  # noqa: BLE001 — breaker decides
+                        sup.note_failure(slot, e)
+                        outputs = self._fallback(entry, batch)
         finally:
             ctx.__exit__(None, None, None)
         batch_ms = (time.monotonic() - t0) * 1000.0

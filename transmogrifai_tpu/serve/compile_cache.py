@@ -36,6 +36,7 @@ from typing import Any, Optional, Sequence, Tuple
 
 from ..obs import registry as obs_registry
 from ..obs import trace
+from ..resilience import inject as _inject
 from ..utils import env
 
 __all__ = ["cache_dir", "fingerprint", "load_or_compile", "cache_stats",
@@ -99,6 +100,7 @@ def _try_load(path: str) -> Optional[Any]:
 
     t0 = time.perf_counter()
     try:
+        _inject.maybe_fail("compile_cache.load")
         with open(path, "rb") as f:
             entry = pickle.load(f)
         if not (isinstance(entry, tuple) and len(entry) == 4

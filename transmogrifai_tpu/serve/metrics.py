@@ -41,7 +41,10 @@ class ServeMetrics:
     ``fallback_records`` the records that degraded to the numpy row path,
     ``errors`` the requests that failed outright.  Batch-side:
     ``batches``, per-bucket dispatch counts, occupancy (real records per
-    dispatched batch) and padded-row totals.
+    dispatched batch) and padded-row totals.  Self-healing:
+    ``degraded_batches`` (served host-side while a slot's circuit was
+    open), ``replica_failures`` (breaker-counted scoring failures) and
+    ``replica_rebuilds`` (slots restored from the active artifact).
     """
 
     def __init__(self):
@@ -52,6 +55,9 @@ class ServeMetrics:
         self.errors = 0
         self.fallback_records = 0
         self.fallback_batches = 0
+        self.degraded_batches = 0
+        self.replica_failures = 0
+        self.replica_rebuilds = 0
         self.batches = 0
         self.occupancy_sum = 0
         self.padded_rows = 0
@@ -139,7 +145,9 @@ class ServeMetrics:
         this instance's lock; the accumulator is provider-local)."""
         with self._lock:
             for k in ("requests", "responses", "shed", "errors",
-                      "fallback_records", "fallback_batches", "batches",
+                      "fallback_records", "fallback_batches",
+                      "degraded_batches", "replica_failures",
+                      "replica_rebuilds", "batches",
                       "occupancy_sum", "padded_rows", "swaps"):
                 acc[k] += getattr(self, k)
             for b, c in self.bucket_counts.items():
@@ -166,6 +174,9 @@ class ServeMetrics:
                 "errors": self.errors,
                 "fallback_records": self.fallback_records,
                 "fallback_batches": self.fallback_batches,
+                "degraded_batches": self.degraded_batches,
+                "replica_failures": self.replica_failures,
+                "replica_rebuilds": self.replica_rebuilds,
                 "batches": self.batches,
                 "swaps": self.swaps,
                 "batch_occupancy_mean": (self.occupancy_sum / self.batches
@@ -207,7 +218,9 @@ def merged_snapshot() -> Dict[str, Any]:
     This is ``obs.snapshot()["serve"]``."""
     acc: Dict[str, Any] = {
         k: 0 for k in ("requests", "responses", "shed", "errors",
-                       "fallback_records", "fallback_batches", "batches",
+                       "fallback_records", "fallback_batches",
+                       "degraded_batches", "replica_failures",
+                       "replica_rebuilds", "batches",
                        "occupancy_sum", "padded_rows", "swaps")}
     acc["bucket_counts"] = {}
     acc["request_latency"] = LatencyHistogram()

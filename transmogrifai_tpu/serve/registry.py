@@ -35,6 +35,7 @@ from typing import Dict, List, Optional, Sequence
 from ..local.scoring import BatchScoreFunction, ScoreFunction
 from ..obs import registry as obs_registry
 from ..obs import trace
+from ..resilience import inject as _inject
 from ..workflow.model import OpWorkflowModel
 from .metrics import ServeMetrics
 
@@ -97,6 +98,7 @@ class Replica:
         the pristine default — wrapping/replacing ``entry.batch``
         (instrumentation, tests) routes every replica through it instead.
         """
+        _inject.maybe_fail("serve.score", key=self.slot)
         owner = self.owner
         if self.scorer is not None and owner.batch is owner._default_batch:
             return self.scorer(records)
@@ -113,6 +115,7 @@ class Replica:
         The AOT scorer needs exactly one null score per replica (its host
         shape is canonicalized to the largest bucket); the generic path
         must score every bucket to populate jit's per-shape caches."""
+        _inject.maybe_fail("serve.warm", key=self.slot)
         if self.scorer is not None:
             self.scorer.warm()
         elif self.device is None:
@@ -231,6 +234,9 @@ class ModelRegistry:
             devices = serve_devices(replicas)
         self.devices = list(devices)
         self._slots: List[Optional[Replica]] = [None] * len(self.devices)
+        #: the ReplicaSupervisor watching these slots, when serving started
+        #: one (serve/supervisor.py); wired by the batcher/server lifecycle
+        self.supervisor = None
 
     @property
     def n_replicas(self) -> int:
@@ -283,6 +289,31 @@ class ModelRegistry:
             old.drain(drain_timeout_s)  # belt-and-braces for legacy guards
         return entry
 
+    def rebuild_slot(self, slot: int) -> Optional[Replica]:
+        """Self-healing: replace one slot's replica with a freshly built and
+        warmed copy of the ACTIVE version's artifact (same model, same
+        device).  Warmup routes through the persistent compile cache, so a
+        rebuild is milliseconds, not a recompile.  Returns the installed
+        replica, or None when nothing is deployed; a failed warm raises and
+        leaves the slot untouched.  The dead occupant is NOT drained — its
+        in-flight batches already failed, which is why we are here."""
+        with self._lock:
+            entry = self._active
+        if entry is None:
+            return None
+        with trace.span("serve.rebuild", slot=slot, version=entry.version):
+            rep = Replica(entry, slot, self.devices[slot])
+            rep.warm()
+        with self._lock:
+            if self._active is not entry:
+                # a deploy raced the rebuild: its fresh slots win
+                return self._slots[slot]
+            self._slots[slot] = rep
+            entry.replicas[slot] = rep
+        if self.metrics is not None:
+            self.metrics.inc("replica_rebuilds")
+        return rep
+
     def active(self) -> ServingModel:
         with self._lock:
             if self._active is None:
@@ -301,6 +332,7 @@ class ModelRegistry:
         with self._lock:
             slots = list(self._slots)
             active = self._active
+        sup = self.supervisor
         return {
             "active": None if active is None else active.version,
             "warmed": bool(active and active.warmed),
@@ -314,4 +346,5 @@ class ModelRegistry:
                     "id": r.id, "slot": r.slot, "device": str(r.device),
                     "aot": r.scorer is not None, "inflight": r.inflight}
                 for r in slots],
+            "health": None if sup is None else sup.health(),
         }

@@ -157,8 +157,13 @@ def _make_handler(server: "ModelServer"):
                     continual = _ct_scope.snapshot()
                 except Exception:
                     continual = {}
+                sup = server.batcher.supervisor
                 self._reply(200, {"serve": server.metrics.snapshot(),
                                   "registry": server.registry.info(),
+                                  "resilience": {
+                                      "supervisor": sup.snapshot(),
+                                      **obs.registry.scope(
+                                          "resilience").snapshot()},
                                   "continual": continual})
             elif self.path == "/models":
                 self._reply(200, server.registry.info())

@@ -19,6 +19,7 @@ import importlib
 import inspect
 import json
 import os
+import tempfile
 import textwrap
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -295,18 +296,54 @@ def save_model(model, path: str, overwrite: bool = True) -> None:
         "parameters": model.parameters.to_json(),
         "rffResults": _jsonable(model.rff_results.to_json()) if model.rff_results else None,
     }
-    with open(manifest_path, "w") as fh:
-        json.dump(manifest, fh, indent=1, default=str)
-    np.savez_compressed(os.path.join(path, MODEL_ARRAYS), **arrays)
+    # crash-safe: both files go through temp + atomic rename, and the arrays
+    # land BEFORE the manifest — the manifest's presence implies a complete
+    # model, so a kill mid-save leaves either the previous model or an
+    # obviously-incomplete directory, never a manifest over torn arrays
+    arrays_path = os.path.join(path, MODEL_ARRAYS)
+    fd, tmp = tempfile.mkstemp(dir=path, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            np.savez_compressed(fh, **arrays)
+        os.replace(tmp, arrays_path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    fd, tmp = tempfile.mkstemp(dir=path, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as fh:
+            json.dump(manifest, fh, indent=1, default=str)
+        os.replace(tmp, manifest_path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def load_model(path: str):
     from .model import OpWorkflowModel
 
-    with open(os.path.join(path, MODEL_MANIFEST)) as fh:
-        manifest = json.load(fh)
+    manifest_path = os.path.join(path, MODEL_MANIFEST)
+    try:
+        with open(manifest_path) as fh:
+            manifest = json.load(fh)
+    except FileNotFoundError:
+        raise FileNotFoundError(
+            f"No model at {path!r}: missing {MODEL_MANIFEST} (an interrupted "
+            f"save never produces a manifest — re-save the model)") from None
+    except json.JSONDecodeError as e:
+        raise ValueError(
+            f"Corrupt model manifest at {manifest_path!r}: {e}. Saves are "
+            f"atomic, so this file was damaged after the fact (bad disk or "
+            f"manual edit) — re-save the model") from e
     arrays_path = os.path.join(path, MODEL_ARRAYS)
-    arrays = dict(np.load(arrays_path, allow_pickle=False)) if os.path.exists(arrays_path) else {}
+    try:
+        arrays = dict(np.load(arrays_path, allow_pickle=False)) \
+            if os.path.exists(arrays_path) else {}
+    except Exception as e:
+        raise ValueError(
+            f"Corrupt model arrays at {arrays_path!r}: {e}. The manifest is "
+            f"intact, so the arrays file was damaged after the save — "
+            f"re-save the model") from e
 
     # 1. generator stages
     stages_by_uid: Dict[str, PipelineStage] = {}

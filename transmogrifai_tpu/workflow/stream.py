@@ -55,6 +55,9 @@ from .. import types as T
 from ..columns import Dataset, NumericColumn, ObjectColumn, VectorColumn
 from ..obs import registry as obs_registry
 from ..obs import trace
+from ..resilience import checkpoint as _ckpt
+from ..resilience import inject as _inject
+from ..resilience import retry as _retry
 from ..utils import env
 
 
@@ -140,6 +143,7 @@ _stream_scope = obs_registry.scope("stream", defaults=dict(
     bytes_in=0.0, bytes_out=0.0, compiles=0,
     device_handoffs=0, handoff_bytes=0.0,
     upload_s=0.0, pull_wait_s=0.0, wall_s=0.0,
+    checkpoint_skips=0,
     autotune={}, fallbacks=[],
 ))
 
@@ -560,34 +564,88 @@ def execute(plan: StreamPlan, ds: Dataset) -> Dict[str, Any]:
         {nm: [] for nm in plan.handoff}
     terminals = [e for e in plan.stages if e.terminal]
 
+    # chunk-boundary resume: with TMOG_CHECKPOINT_DIR set, each drained
+    # chunk's terminal outputs persist keyed by (plan signature, chunk
+    # index, the chunk's own host-arg fingerprints) — a killed transform
+    # rerun restores completed chunks and executes only the remainder
+    _ck = _ckpt.store()
+    plan_sig = None
+    if _ck.enabled:
+        plan_sig = (C, n, tuple(
+            (getattr(e.stage, "uid", "?"),
+             getattr(e.stage, "operation_name", "?"),
+             e.out_name, e.out_kind, bool(e.terminal))
+            for e in plan.stages))
+
+    def _chunk_key(lo, host_args):
+        fps = []
+        for k in sorted(host_args):
+            v = host_args[k]
+            for a in (v if isinstance(v, (list, tuple)) else (v,)):
+                fps.append(_ckpt.data_fingerprint(a))
+        return _ckpt.content_key("stream_chunk", plan_sig, lo, tuple(fps))
+
+    def _restore(lo, rows, arrays) -> bool:
+        need = {f"v_{e.out_name}" for e in terminals} | {
+            f"m_{e.out_name}" for e in terminals if e.out_kind == "numeric"}
+        if not need.issubset(arrays):
+            return False
+        for e in terminals:
+            hv = arrays[f"v_{e.out_name}"]
+            if e.out_kind == "numeric":
+                if e.out_name not in out_vals:
+                    out_vals[e.out_name] = np.empty(n, hv.dtype)
+                    out_masks[e.out_name] = np.empty(n, bool)
+                out_masks[e.out_name][lo:lo + rows] = \
+                    arrays[f"m_{e.out_name}"][:rows]
+            elif e.out_name not in out_vals:
+                out_vals[e.out_name] = np.empty((n, hv.shape[1]), np.float32)
+            out_vals[e.out_name][lo:lo + rows] = hv[:rows]
+        return True
+
     def drain(item) -> None:
-        lo, rows, outs = item
+        lo, rows, outs, ck_key = item
         t0 = time.perf_counter()
-        with trace.span("stream.chunk.pull", lo=lo, rows=rows):
-            for e in terminals:
-                o = outs[e.out_name]
-                if e.out_kind == "numeric":
-                    hv = np.asarray(o[0])
-                    hm = np.asarray(o[1])
-                    if e.out_name not in out_vals:
-                        out_vals[e.out_name] = np.empty(n, hv.dtype)
-                        out_masks[e.out_name] = np.empty(n, bool)
-                    out_vals[e.out_name][lo:lo + rows] = hv[:rows]
-                    out_masks[e.out_name][lo:lo + rows] = hm[:rows]
-                    _stream_scope.inc("bytes_out", float(
-                        rows * (hv.itemsize + hm.itemsize)))
-                else:
-                    hv = np.asarray(o)
-                    if e.out_name not in out_vals:
-                        out_vals[e.out_name] = np.empty((n, hv.shape[1]),
-                                                        np.float32)
-                    out_vals[e.out_name][lo:lo + rows] = hv[:rows]
-                    _stream_scope.inc("bytes_out",
-                                      float(rows * hv.shape[1] * 4))
+        saved: Dict[str, np.ndarray] = {}
+
+        def _pull():
+            _inject.maybe_fail("stream.pull", key=lo)
+            with trace.span("stream.chunk.pull", lo=lo, rows=rows):
+                for e in terminals:
+                    o = outs[e.out_name]
+                    if e.out_kind == "numeric":
+                        hv = np.asarray(o[0])
+                        hm = np.asarray(o[1])
+                        if e.out_name not in out_vals:
+                            out_vals[e.out_name] = np.empty(n, hv.dtype)
+                            out_masks[e.out_name] = np.empty(n, bool)
+                        out_vals[e.out_name][lo:lo + rows] = hv[:rows]
+                        out_masks[e.out_name][lo:lo + rows] = hm[:rows]
+                        _stream_scope.inc("bytes_out", float(
+                            rows * (hv.itemsize + hm.itemsize)))
+                        if ck_key is not None:
+                            saved[f"v_{e.out_name}"] = hv[:rows]
+                            saved[f"m_{e.out_name}"] = hm[:rows]
+                    else:
+                        hv = np.asarray(o)
+                        if e.out_name not in out_vals:
+                            out_vals[e.out_name] = np.empty((n, hv.shape[1]),
+                                                            np.float32)
+                        out_vals[e.out_name][lo:lo + rows] = hv[:rows]
+                        _stream_scope.inc("bytes_out",
+                                          float(rows * hv.shape[1] * 4))
+                        if ck_key is not None:
+                            saved[f"v_{e.out_name}"] = hv[:rows]
+
+        _retry.with_retry("stream.pull", _pull)
+        if ck_key is not None:
+            _ck.save("stream_chunk", ck_key, saved, meta={"lo": lo,
+                                                          "rows": rows})
         _stream_scope.inc("pull_wait_s", time.perf_counter() - t0)
 
     inflight: deque = deque()
     n_chunks = 0
+    restored = 0
     with trace.span("stream.execute", rows=n, chunk_rows=C, window=B):
         for lo in range(0, n, C):
             hi = min(lo + C, n)
@@ -595,23 +653,36 @@ def execute(plan: StreamPlan, ds: Dataset) -> Dict[str, Any]:
             t0 = time.perf_counter()
             with trace.span("stream.chunk.upload", lo=lo, rows=rows):
                 host_args, nbytes = _host_chunk_args(plan, ds, lo, hi, C)
-                dev_args = jax.device_put(host_args)
-                with warnings.catch_warnings():
-                    # XLA can't reuse every donated buffer (e.g. bool masks
-                    # with no same-shape output); that's expected, not
-                    # actionable
-                    warnings.filterwarnings(
-                        "ignore",
-                        message="Some donated buffers were not usable")
-                    # async dispatch; donates the uploads
-                    outs = jitted(dev_args)
+                ck_key = None
+                if _ck.enabled:
+                    ck_key = _chunk_key(lo, host_args)
+                    hit = _ck.load("stream_chunk", ck_key)
+                    if hit is not None and _restore(lo, rows, hit[0]):
+                        _stream_scope.inc("checkpoint_skips")
+                        restored += 1
+                        continue
+
+                def _go():
+                    _inject.maybe_fail("stream.upload", key=lo)
+                    dev_args = jax.device_put(host_args)
+                    with warnings.catch_warnings():
+                        # XLA can't reuse every donated buffer (e.g. bool
+                        # masks with no same-shape output); that's expected,
+                        # not actionable
+                        warnings.filterwarnings(
+                            "ignore",
+                            message="Some donated buffers were not usable")
+                        # async dispatch; donates the uploads
+                        return jitted(dev_args)
+
+                outs = _retry.with_retry("stream.upload", _go)
             _stream_scope.inc("upload_s", time.perf_counter() - t0)
             _stream_scope.inc("bytes_in", nbytes)
             _stream_scope.inc("pad_rows", C - rows)
             n_chunks += 1
             for nm in plan.handoff:
                 hand_chunks[nm].append((outs[nm], rows))
-            inflight.append((lo, rows, outs))
+            inflight.append((lo, rows, outs, ck_key))
             while len(inflight) > B:
                 drain(inflight.popleft())
         while inflight:
@@ -645,7 +716,13 @@ def execute(plan: StreamPlan, ds: Dataset) -> Dict[str, Any]:
             new_cols[e.out_name] = VectorColumn(
                 T.OPVector, out_vals[e.out_name], e.metadata)
     for nm, chunks in hand_chunks.items():
-        if chunks and nm in new_cols:
+        if restored and chunks and nm in new_cols:
+            # resumed run: restored chunks never reached the device, so the
+            # chunk list is incomplete — the selector falls back to its own
+            # upload instead of a torn handoff
+            obs_registry.record_fallback("stream", "handoff_skipped_resume",
+                                         name=nm, restored=restored)
+        elif chunks and nm in new_cols:
             _register_view(new_cols[nm].values, chunks, n)
     return new_cols
 
