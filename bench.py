@@ -518,17 +518,31 @@ def main():
     sel.find_best_estimator(X, y)
     warm = time.perf_counter() - t_first
 
+    from transmogrifai_tpu.obs import timeline, trace
+
     flops.enable()
     flops.reset()
     reps = 3
+    trace_was_on = trace.enabled()
+    if not trace_was_on:
+        trace.enable(path=None)  # in-memory: feed the bubble profiler
     t0 = time.perf_counter()
-    for r in range(reps):
-        # new seed -> new folds -> new device buffers (defeats the tunnel's
-        # (executable, args) memoization; also what a fresh run would do)
-        sel2 = make_selector(seed=100 + r)
-        _, _, summary = sel2.find_best_estimator(X, y)
-        assert summary.best.metric_value == summary.best.metric_value  # finite
+    with trace.span("bench.window", reps=reps):
+        for r in range(reps):
+            # new seed -> new folds -> new device buffers (defeats the
+            # tunnel's (executable, args) memoization; also what a fresh
+            # run would do)
+            sel2 = make_selector(seed=100 + r)
+            _, _, summary = sel2.find_best_estimator(X, y)
+            assert summary.best.metric_value == summary.best.metric_value
     dt = (time.perf_counter() - t0) / reps
+    try:
+        bubble = timeline.bubble_report(window="bench.window",
+                                        wall_s=dt * reps)
+    except ValueError:
+        bubble = None
+    if not trace_was_on:
+        trace.disable()
     acct = flops.totals()
     flops.disable()
 
@@ -637,10 +651,18 @@ def main():
         out["flops_note"] = "cost_analysis unavailable on this backend"
     if fallback:
         out["backend_fallback"] = fallback
+    if bubble:
+        # keep the headline report lean: bubble fractions inline, the full
+        # per-lane report in the JSONL record only
+        out["bubble_fraction"] = bubble["bubble_fraction"]
+        print(timeline.format_report(bubble), file=sys.stderr)
     print(json.dumps(out))
     from transmogrifai_tpu import obs
 
-    obs.write_record("bench", extra={"report": out})
+    extra = {"report": out}
+    if bubble:
+        extra["bubble_report"] = bubble
+    obs.write_record("bench", extra=extra)
 
 
 if __name__ == "__main__":

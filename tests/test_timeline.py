@@ -1,0 +1,173 @@
+"""Timeline bubble attribution: golden report on synthetic spans.
+
+The invariants the profiler sells:
+
+- every lane's buckets (idle included) sum to the analysis window's wall
+  EXACTLY, and the aggregate (per-lane mean) inherits it;
+- overlap resolves innermost-wins (serve.request queue wait vs its inner
+  serve.batch compute);
+- structural wrapper spans never absorb time; lanes holding only structural
+  spans are dropped;
+- the critical path is the backward chain of last-finishers, with gaps
+  surfaced.
+
+All on hand-built Chrome-trace events — no JAX, no clock.
+"""
+import json
+import subprocess
+import sys
+
+import pytest
+
+from transmogrifai_tpu.obs import timeline, trace
+
+
+def _ev(name, ts_us, dur_us, tid=1, **args):
+    return {"name": name, "ph": "X", "cat": "tmog", "ts": float(ts_us),
+            "dur": float(dur_us), "pid": 1, "tid": tid, "args": args}
+
+
+def _golden_events():
+    """One worker lane, 100 ms window: 7.5 prep + 22.5 compile + 2 dispatch
+    + 38 gather, remainder 30 idle.  A structural wrapper covers it all and
+    a second lane holds ONLY structural spans (must be dropped)."""
+    return [
+        _ev("profile.window", 0, 100_000, tid=9),
+        _ev("sweep.launch", 0, 95_000, tid=9),      # structural-only lane
+        _ev("sweep.shard", 0, 70_000, tid=1, device="cpu:0"),  # structural
+        _ev("sweep.upload", 0, 7_500, tid=1, device="cpu:0"),
+        _ev("sweep.compile", 7_500, 22_500, tid=1),
+        _ev("sweep.dispatch", 30_000, 2_000, tid=1),
+        _ev("sweep.gather", 32_000, 38_000, tid=1, bytes=1024),
+    ]
+
+
+def test_classify():
+    assert timeline.classify("sweep.upload") == "host_prep"
+    assert timeline.classify("sweep.compile") == "compile"
+    assert timeline.classify("sweep.gather") == "gather"
+    assert timeline.classify("stream.chunk.pull") == "gather"
+    assert timeline.classify("mesh.psum") == "collective"
+    assert timeline.classify("serve.batch") == "compute"
+    assert timeline.classify("some.new.span") == "compute"  # instrumented
+    for s in ("sweep.launch", "sweep.shard", "stream.execute",
+              "profile.window", "bench.window"):
+        assert timeline.classify(s) is None
+
+
+def test_golden_buckets_sum_to_wall():
+    rep = timeline.bubble_report(events=_golden_events(),
+                                 window="profile.window", wall_s=0.1)
+    assert rep["schema"] == "tmog.bubble_report"
+    assert rep["wall_s"] == pytest.approx(0.1)
+    # the structural-only lane is dropped: one worker lane remains
+    assert len(rep["lanes"]) == 1
+    (lane_label, lane), = rep["lanes"].items()
+    assert "cpu:0" in lane_label
+    b = rep["buckets_s"]
+    assert b["host_prep"] == pytest.approx(0.0075)
+    assert b["compile"] == pytest.approx(0.0225)
+    assert b["dispatch"] == pytest.approx(0.002)
+    assert b["gather"] == pytest.approx(0.038)
+    assert b["collective"] == 0.0 and b["compute"] == 0.0
+    assert b["idle"] == pytest.approx(0.030)
+    # THE invariant: buckets sum to the window wall (far inside the 5%
+    # acceptance tolerance — it holds by construction)
+    assert rep["bucket_sum_s"] == pytest.approx(rep["wall_s"], rel=1e-6)
+    assert rep["window_vs_measured"] == pytest.approx(1.0)
+    # bubble = everything but compute+gather
+    assert rep["bubble_fraction"] == pytest.approx(0.62, abs=1e-3)
+
+
+def test_golden_critical_path():
+    rep = timeline.bubble_report(events=_golden_events(),
+                                 window="profile.window")
+    names = [p["name"] for p in rep["critical_path"]]
+    assert names == ["sweep.upload", "sweep.compile", "sweep.dispatch",
+                     "sweep.gather", "(gap)"]
+    durs = [p["dur_s"] for p in rep["critical_path"]]
+    assert durs == pytest.approx([0.0075, 0.0225, 0.002, 0.038, 0.030])
+    assert rep["critical_path_coverage"] == pytest.approx(0.70, abs=1e-3)
+
+
+def test_innermost_wins_serve_overlap():
+    """serve.request (dispatch/queue wait) loses its overlap with the inner
+    serve.batch (compute): queue wait is only the uncovered slice."""
+    evs = [
+        _ev("serve.request", 0, 10_000, tid=3),
+        _ev("serve.batch", 4_000, 5_000, tid=3),
+    ]
+    rep = timeline.bubble_report(events=evs, window=(0.0, 10_000.0))
+    b = rep["buckets_s"]
+    assert b["dispatch"] == pytest.approx(0.005)   # 10 - 5 covered inner
+    assert b["compute"] == pytest.approx(0.005)
+    assert b["idle"] == 0.0
+    assert rep["bucket_sum_s"] == pytest.approx(0.01)
+
+
+def test_multi_lane_mean_keeps_invariant():
+    """Two worker lanes with different mixes: the aggregate is the per-lane
+    mean, so it still sums to the window wall."""
+    evs = [
+        _ev("sweep.gather", 0, 60_000, tid=1, device="cpu:0"),
+        _ev("sweep.upload", 0, 20_000, tid=2, device="cpu:1"),
+    ]
+    rep = timeline.bubble_report(events=evs, window=(0.0, 100_000.0))
+    assert len(rep["lanes"]) == 2
+    for lane in rep["lanes"].values():
+        assert sum(lane["buckets_s"].values()) == pytest.approx(0.1)
+    assert rep["buckets_s"]["gather"] == pytest.approx(0.03)
+    assert rep["buckets_s"]["host_prep"] == pytest.approx(0.01)
+    assert rep["buckets_s"]["idle"] == pytest.approx(0.06)
+    assert rep["bucket_sum_s"] == pytest.approx(0.1)
+
+
+def test_no_events_raises():
+    with pytest.raises(ValueError):
+        timeline.bubble_report(events=[])
+    with pytest.raises(ValueError):
+        timeline.bubble_report(events=_golden_events(), window="nope")
+
+
+def test_live_tracer_feed():
+    """bubble_report() with no args reads the live ring buffer."""
+    was = trace.enabled()
+    trace.enable(path=None)
+    trace.reset()
+    try:
+        with trace.span("profile.window"):
+            with trace.span("sweep.gather", device="cpu:0"):
+                pass
+        rep = timeline.bubble_report(window="profile.window")
+        assert rep["buckets_s"]["gather"] >= 0.0
+        # sub-microsecond spans: rounding to 1e-6 s dominates, compare abs
+        assert rep["bucket_sum_s"] == pytest.approx(rep["wall_s"], abs=3e-6)
+    finally:
+        trace.reset()
+        if not was:
+            trace.disable()
+
+
+def test_format_report_renders():
+    rep = timeline.bubble_report(events=_golden_events(),
+                                 window="profile.window")
+    text = timeline.format_report(rep)
+    for b in timeline.BUCKETS:
+        assert b in text
+    assert "critical path" in text
+
+
+def test_cli_on_exported_trace(tmp_path):
+    """python -m transmogrifai_tpu.obs.timeline over a trace file (the CI
+    artifact path) prints a report and writes --out JSON."""
+    tr = tmp_path / "trace.json"
+    out = tmp_path / "bubble.json"
+    tr.write_text(json.dumps({"traceEvents": _golden_events()}))
+    r = subprocess.run(
+        [sys.executable, "-m", "transmogrifai_tpu.obs.timeline", str(tr),
+         "--window", "profile.window", "--out", str(out)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert "bubble report" in r.stdout
+    rep = json.loads(out.read_text())
+    assert rep["bucket_sum_s"] == pytest.approx(rep["wall_s"], rel=1e-6)

@@ -1,0 +1,108 @@
+"""CI perf-regression gate: fresh run records vs committed baselines.
+
+Compares run reports against the newest committed ``BENCH_r*.json`` /
+``STREAM_BENCH.json`` baselines with per-metric direction (throughput up,
+walls down) and a relative noise tolerance (``--tol`` /
+``TMOG_PERFGATE_TOL``, default 0.25).  Exit codes: 0 pass, 1 regression,
+2 no baselines found.
+
+- ``--record PATH`` (repeatable): a report JSON (flat or the ``BENCH_r*``
+  ``{"parsed": ...}`` wrapper) or a telemetry JSONL whose rows carry
+  ``report`` dicts (``bench.py`` writes these).  Rows whose ``metric`` has
+  no committed baseline, and platform-mismatched pairs (CPU-proxy CI run vs
+  a TPU baseline), are skipped, not failed.
+- With no ``--record`` (or none readable) the gate self-checks each
+  baseline against itself — validating the baseline set and the policy
+  table still parse — and passes.
+- ``--warn-only``: print verdicts, always exit 0 (the CPU-proxy tier1 step).
+
+No JAX import: the gate is pure JSON comparison and runs anywhere.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from transmogrifai_tpu.obs import regress  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--record", action="append", default=[],
+                    help="fresh run record(s): report JSON or telemetry "
+                         "JSONL (repeatable; default: self-check baselines)")
+    ap.add_argument("--baseline-dir", default=None,
+                    help="where the committed BENCH_*/STREAM_BENCH live "
+                         "(default: the repo root)")
+    ap.add_argument("--tol", type=float, default=None,
+                    help="relative tolerance (default TMOG_PERFGATE_TOL "
+                         f"or {regress.DEFAULT_TOL})")
+    ap.add_argument("--warn-only", action="store_true",
+                    help="report regressions but exit 0 (CPU-proxy CI)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the verdicts as one JSON object on stdout")
+    args = ap.parse_args(argv)
+
+    root = args.baseline_dir or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    baselines = regress.load_baselines(root)
+    if not baselines:
+        print(f"perfgate: no BENCH_r*/STREAM_BENCH baselines under {root}",
+              file=sys.stderr)
+        return 2
+    tol = regress.default_tolerance() if args.tol is None else args.tol
+
+    reports = []
+    for path in args.record:
+        got = regress.extract_reports(path)
+        if not got:
+            print(f"perfgate: no reports readable from {path} (skipped)")
+        reports.extend(got)
+    self_check = not reports
+    if self_check:
+        reports = [dict(rep) for _, rep in baselines.values()]
+
+    verdicts, regressed = [], False
+    for rep in reports:
+        metric = rep.get("metric")
+        entry = baselines.get(metric)
+        if entry is None:
+            verdicts.append({"metric": metric, "ok": True,
+                             "skipped": "no committed baseline"})
+            continue
+        name, base = entry
+        v = regress.compare(rep, base, tol=tol)
+        v["baseline_file"] = name
+        verdicts.append(v)
+        regressed = regressed or not v["ok"]
+
+    if args.json:
+        print(json.dumps({"tol": tol, "self_check": self_check,
+                          "warn_only": args.warn_only,
+                          "regressed": regressed, "verdicts": verdicts}))
+    else:
+        mode = "self-check (no fresh records)" if self_check else \
+            f"{len(reports)} fresh report(s)"
+        print(f"perfgate: {mode}, tol={tol:g}")
+        for v in verdicts:
+            if v.get("skipped"):
+                print(f"  {v['metric']}: SKIP ({v['skipped']})")
+                continue
+            for r in v["results"]:
+                mark = {"ok": "ok", "improved": "OK+", "regressed": "REGRESS",
+                        "skipped_missing": "-", "skipped_platform": "-"}
+                ratio = "" if r["ratio"] is None else f" x{r['ratio']:g}"
+                print(f"  {v['metric']}.{r['key']} [{v['baseline_file']}]: "
+                      f"{r['baseline']} -> {r['current']}{ratio}  "
+                      f"{mark[r['status']]}")
+        print("perfgate: " + ("REGRESSION" if regressed else "pass")
+              + (" (warn-only)" if regressed and args.warn_only else ""))
+    if regressed and not args.warn_only:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,7 +9,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from bench import init_backend
 
-init_backend()
+platform, _fb = init_backend()
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -52,6 +52,9 @@ def onehot_only(slot, w):
     return (S * w[:, :, None]).sum()
 
 
+results = {}
+
+
 def timed(name, fn, reps=10):
     fn(slot, w).block_until_ready()
     outs = []
@@ -62,9 +65,18 @@ def timed(name, fn, reps=10):
     dt = (time.perf_counter() - t0) / reps
     gf = 2 * T * m * n * dBc / 1e9
     print(f"{name:16s} {dt*1e3:8.2f} ms   ({gf/dt/1e3:6.2f} TFLOP/s)")
+    results[name] = {"ms": round(dt * 1e3, 4),
+                     "tflops": round(gf / dt / 1e3, 4)}
 
 
 timed("batched-gemm", batched)
 timed("flat-gemm", flat)
 timed("flat-bf16", flat_bf16)
 timed("onehot-only", onehot_only)
+
+from transmogrifai_tpu import obs  # noqa: E402
+
+obs.write_record("probe_hist_mm", extra={"report": {
+    "metric": "hist_matmul_tflops", "platform": platform,
+    "value": results["flat-gemm"]["tflops"],
+    "shape": {"n": n, "dBc": dBc, "m": m, "T": T}, "cases": results}})

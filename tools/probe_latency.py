@@ -32,6 +32,9 @@ def loop2000(a):
     return jax.lax.fori_loop(0, 2000, body, jnp.zeros((24, 24), jnp.float32))
 
 
+results = {}
+
+
 def timed(name, fn, arg, reps=20):
     fn(arg).block_until_ready()
     t0 = time.perf_counter()
@@ -39,6 +42,7 @@ def timed(name, fn, arg, reps=20):
         fn(arg).block_until_ready()
     dt = (time.perf_counter() - t0) / reps
     print(f"{name:24s} {dt*1e3:9.2f} ms")
+    results[name] = round(dt * 1e3, 4)
     return dt
 
 
@@ -51,7 +55,8 @@ trivial(x).block_until_ready()
 t0 = time.perf_counter()
 outs = [trivial(x + i) for i in range(10)]
 outs[-1].block_until_ready()
-print(f"{'10 async trivial':24s} {(time.perf_counter()-t0)*1e3:9.2f} ms total")
+results["10 async trivial"] = round((time.perf_counter() - t0) * 1e3, 4)
+print(f"{'10 async trivial':24s} {results['10 async trivial']:9.2f} ms total")
 
 # host pull of a small array
 y = trivial(x)
@@ -59,11 +64,19 @@ y.block_until_ready()
 t0 = time.perf_counter()
 for _ in range(20):
     np.asarray(y)
-print(f"{'small pull (86KB)':24s} {(time.perf_counter()-t0)/20*1e3:9.2f} ms")
+results["small pull (86KB)"] = round((time.perf_counter() - t0) / 20 * 1e3, 4)
+print(f"{'small pull (86KB)':24s} {results['small pull (86KB)']:9.2f} ms")
 
 # device_put of the same
 arr = np.ones((891, 24), np.float32)
 t0 = time.perf_counter()
 for _ in range(20):
     jax.device_put(arr).block_until_ready()
-print(f"{'device_put (86KB)':24s} {(time.perf_counter()-t0)/20*1e3:9.2f} ms")
+results["device_put (86KB)"] = round((time.perf_counter() - t0) / 20 * 1e3, 4)
+print(f"{'device_put (86KB)':24s} {results['device_put (86KB)']:9.2f} ms")
+
+from transmogrifai_tpu import obs  # noqa: E402
+
+obs.write_record("probe_latency", extra={"report": {
+    "metric": "device_roundtrip_latency_ms", "platform": platform,
+    "value": results["trivial add"], "cases_ms": results}})

@@ -10,7 +10,10 @@ Consolidates the former ``profile_trees.py`` / ``profile_trees2.py`` /
 - ``trees-stats``  — min/median timing of the three sweep-representative RF
   cases + the GBT batch case (noise-robust numbers for before/after diffs);
 - ``trace``        — one warmed depth-12 forest build under
-  ``jax.profiler.trace`` (XLA-level, for TensorBoard).
+  ``jax.profiler.trace`` (XLA-level, for TensorBoard);
+- ``fused``        — per-fragment device-time profile of the fused Titanic
+  sweep (the former ``profile_fused.py``): the full spec, each fragment
+  kind alone, and each forest depth group alone.
 
 ``--trace out.json`` on any subcommand additionally records obs spans
 (``profile.case`` per timed case) and exports Chrome trace-event JSON
@@ -31,7 +34,8 @@ from bench import init_backend
 
 parser = argparse.ArgumentParser(description=__doc__)
 parser.add_argument("cmd", nargs="?", default="trees",
-                    choices=["trees", "trees-beam", "trees-stats", "trace"])
+                    choices=["trees", "trees-beam", "trees-stats", "trace",
+                             "fused"])
 parser.add_argument("--reps", type=int, default=0,
                     help="timing repetitions (default: 3, trees-stats 6)")
 parser.add_argument("--trace", default="",
@@ -179,12 +183,70 @@ def cmd_trace(reps):
     print(f"trace done -> {out}")
 
 
+def cmd_fused(reps):
+    """Per-fragment device time of the fused Titanic sweep (the folded-in
+    ``profile_fused.py``): ALL, each fragment kind alone, each forest
+    depth group alone — at the real selector shapes."""
+    from bench import make_selector, titanic_arrays
+    from transmogrifai_tpu.impl.sweep_fragments import build_sweep_plan
+    from transmogrifai_tpu.ops.sweep import run_sweep
+
+    Xt, yt = titanic_arrays()
+    sel = make_selector()
+    v = sel.validator
+    train_w, val_mask = v.make_folds(len(yt), None)
+    prep_w = sel.splitter.prepare_weights(yt)
+    train_w = train_w * prep_w[None, :].astype(np.float32)
+    val_mask = val_mask & (prep_w > 0)[None, :]
+    plan = build_sweep_plan(sel.models, Xt, yt, train_w, v.evaluator)
+    if plan is None:
+        print("default grid did not build a fused plan; nothing to profile")
+        return
+    full = plan.spec
+
+    def time_spec(name, frags):
+        # keep the global candidate tuple: the metrics tensor stays sized by
+        # the full spec; scores for absent candidates stay zero, harmless
+        spec = (full[0], frags, full[2])
+        with obs_trace.span("profile.case", case=name, reps=reps):
+            t0 = time.perf_counter()
+            m = run_sweep(spec, plan.X, plan.xbs, plan.y, train_w, val_mask,
+                          plan.blob)
+            np.asarray(m)
+            warm = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for r in range(reps):
+                tw = train_w * (1.0 + 1e-7 * r)  # new buffer: defeat memo
+                m = run_sweep(spec, plan.X, plan.xbs, plan.y, tw, val_mask,
+                              plan.blob)
+                np.asarray(m)
+            dt = (time.perf_counter() - t0) / reps
+        print(f"{name:44s} warm={warm:7.2f}s steady={dt * 1e3:9.1f} ms")
+        return dt
+
+    frags = full[1]
+    by_kind = {}
+    for f in frags:
+        by_kind.setdefault(f[0], []).append(f)
+    time_spec("ALL", frags)
+    for kind, fs in by_kind.items():
+        time_spec(f"only:{kind}", tuple(fs))
+    if "forest" in by_kind:
+        groups = by_kind["forest"][0][2]
+        for g in groups:
+            frag = ("forest", by_kind["forest"][0][1], (g,))
+            time_spec(f"forest depth={g[1]} frontier={g[9]} chunk={g[11]}",
+                      (frag,))
+
+
 if cli.cmd == "trees":
     cmd_trees(cli.reps or 3)
 elif cli.cmd == "trees-beam":
     cmd_trees_beam(cli.reps or 3)
 elif cli.cmd == "trees-stats":
     cmd_trees_stats(cli.reps or 6)
+elif cli.cmd == "fused":
+    cmd_fused(cli.reps or 5)
 else:
     cmd_trace(cli.reps or 1)
 

@@ -105,12 +105,14 @@ def profile_shards(n_shards: int, reps: int = 3,
                    use_costmodel: bool = False):
     """Predicted vs measured per-shard cost of the default 28-candidate grid.
 
-    Returns the predicted-vs-measured eval dict (MAPE, makespan ratios)
-    when ``--costmodel`` supplied a trained model, else None — appended to
-    the run's JSONL record either way."""
+    Returns ``(cm_eval, bubble_report)``: the predicted-vs-measured eval
+    dict (MAPE, makespan ratios) when ``--costmodel`` supplied a trained
+    model, and the timeline bubble report over the measured window — both
+    appended to the run's JSONL record."""
     import jax
 
     from transmogrifai_tpu.impl.sweep_fragments import build_sweep_plan
+    from transmogrifai_tpu.obs import timeline, trace
     from transmogrifai_tpu.ops.sweep import run_sweep
     from transmogrifai_tpu.parallel.spec_partition import (partition_spec,
                                                            predicted_balance)
@@ -125,7 +127,7 @@ def profile_shards(n_shards: int, reps: int = 3,
                             train_w, ev)
     if plan is None:
         print("default grid did not build a fused plan; nothing to profile")
-        return None
+        return None, None
     from transmogrifai_tpu.ops import sweep as sweep_ops
     from transmogrifai_tpu.utils import flops
     flops.enable()
@@ -157,17 +159,28 @@ def profile_shards(n_shards: int, reps: int = 3,
                   f"calib~{p['calib_wall_s']:.4f}s")
     tw = np.asarray(train_w, np.float32)
     vw = np.asarray(val_mask, np.float32)
+    trace_was_on = trace.enabled()
+    if not trace_was_on:
+        trace.enable(path=None)  # in-memory only: feed the bubble profiler
     walls = []
-    for i, sh in enumerate(shards):
-        # sequential, all on the default device: isolates per-shard COST
-        # (the thing the partitioner predicts) from device contention
-        out = run_sweep(sh.spec, plan.X, plan.xbs, plan.y, tw, vw, sh.blob)
-        np.asarray(out)  # warm (compile)
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            np.asarray(run_sweep(sh.spec, plan.X, plan.xbs, plan.y, tw, vw,
-                                 sh.blob))
-        walls.append((time.perf_counter() - t0) / reps)
+    t_win = time.perf_counter()
+    with trace.span("profile.window", shards=len(shards), reps=reps):
+        for i, sh in enumerate(shards):
+            # sequential, all on the default device: isolates per-shard COST
+            # (the thing the partitioner predicts) from device contention
+            with trace.span("sweep.compile", shard=i):
+                out = run_sweep(sh.spec, plan.X, plan.xbs, plan.y, tw, vw,
+                                sh.blob)
+                np.asarray(out)  # warm (compile)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = run_sweep(sh.spec, plan.X, plan.xbs, plan.y, tw, vw,
+                                sh.blob)
+                with trace.span("sweep.gather", shard=i) as _gsp:
+                    out = np.asarray(out)
+                    _gsp.set(bytes=int(out.nbytes))
+            walls.append((time.perf_counter() - t0) / reps)
+    wall_meas = time.perf_counter() - t_win
     wmean = float(np.mean(walls))
     print(f"{'shard':>5s} {'cands':>5s} {'predicted':>12s} {'pred/mean':>9s} "
           f"{'measured_s':>10s} {'meas/mean':>9s}")
@@ -192,9 +205,18 @@ def profile_shards(n_shards: int, reps: int = 3,
         print(f"costmodel: MAPE={cm_eval['mape']:.3f} makespan ratio "
               f"predicted={cm_eval['predicted_makespan_ratio']:.3f} "
               f"measured={cm_eval['measured_makespan_ratio']:.3f}")
+    bub = None
+    try:
+        bub = timeline.bubble_report(window="profile.window",
+                                     wall_s=wall_meas)
+        print(timeline.format_report(bub))
+    except ValueError as e:
+        print(f"bubble report unavailable: {e}")
+    if not trace_was_on:
+        trace.disable()
     _print_gbt_telemetry(sweep_ops)
     flops.disable()
-    return cm_eval
+    return cm_eval, bub
 
 
 def profile_rowsharded(n_data: int, n_model: int, reps: int = 3) -> None:
@@ -284,10 +306,12 @@ if args.data_shards > 0:
     sys.exit(0)
 
 if args.shards > 0:
-    cm_eval = profile_shards(args.shards, use_costmodel=args.costmodel)
+    cm_eval, bub = profile_shards(args.shards, use_costmodel=args.costmodel)
     extra = {"mode": "shards"}
     if cm_eval:
         extra["costmodel_eval"] = cm_eval
+    if bub:
+        extra["bubble_report"] = bub
     obs.write_record("profile_sweep", extra=extra)
     sys.exit(0)
 

@@ -23,16 +23,19 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-from . import record, registry, trace
+from . import record, registry, regress, slo, timeline, trace
 from .record import write_record
 from .registry import (REGISTRY, SCHEMA_VERSION, prometheus_text,
                        record_fallback, register_provider, scope)
+from .slo import SLOMonitor
+from .timeline import bubble_report, format_report
 from .trace import complete, instant, span
 
-__all__ = ["trace", "registry", "record", "snapshot", "write_record",
-           "span", "instant", "complete", "scope", "register_provider",
-           "record_fallback", "prometheus_text", "REGISTRY",
-           "SCHEMA_VERSION"]
+__all__ = ["trace", "registry", "record", "timeline", "slo", "regress",
+           "snapshot", "write_record", "span", "instant", "complete",
+           "scope", "register_provider", "record_fallback",
+           "prometheus_text", "REGISTRY", "SCHEMA_VERSION", "SLOMonitor",
+           "bubble_report", "format_report"]
 
 
 def snapshot() -> Dict[str, Any]:

@@ -1,0 +1,172 @@
+"""Noise-tolerant perf-regression comparison against committed baselines.
+
+The repo commits one headline report per bench round (``BENCH_r*.json`` —
+the selector sweep, with the numbers under ``parsed``; ``STREAM_BENCH.json``
+— the streaming transform path, flat) but until now nothing *read* them:
+the bench trajectory was write-only.  This module is the comparison engine
+behind ``tools/perfgate.py`` (the tier-1 perf gate):
+
+- :func:`load_baselines` finds the newest committed report per metric;
+- :func:`compare` judges a fresh report against its baseline with a
+  per-metric **direction** (higher-better throughput vs lower-better walls)
+  and a **relative tolerance** (``TMOG_PERFGATE_TOL``, default 0.25 — bench
+  numbers are noisy, especially on shared CI runners);
+- platform mismatches (a CPU-proxy CI run vs a TPU baseline) are *skipped*,
+  not failed — cross-platform magnitudes are not comparable.
+
+Pure stdlib + :mod:`~transmogrifai_tpu.utils.env` so the gate runs without
+importing JAX.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils import env as _env
+
+__all__ = ["POLICIES", "DEFAULT_TOL", "default_tolerance", "compare",
+           "load_baselines", "extract_reports"]
+
+DEFAULT_TOL = 0.25
+
+#: per-metric-family comparison policy: report key -> direction
+#: (+1 higher-is-better, -1 lower-is-better).  Keys absent from either side
+#: are skipped; unknown metric families compare ``value`` higher-better.
+POLICIES: Dict[str, Dict[str, int]] = {
+    "selector_sweep_models_per_sec": {
+        "value": +1, "vs_baseline": +1, "mfu": +1,
+        "warmup_s": -1, "steady_s": -1,
+    },
+    "transform_stream_speedup": {
+        "value": +1, "transform_rows_per_sec": +1,
+        "stream_steady_s": -1, "stream_warm_s": -1, "compiles_steady": -1,
+    },
+    "serve_replica_qps": {
+        "value": +1, "warm_restart_speedup": +1, "p99_ms": -1,
+    },
+    "continual_warm_retrain_speedup": {"value": +1},
+}
+_DEFAULT_POLICY = {"value": +1}
+
+
+def default_tolerance() -> float:
+    return max(0.0, _env.env_float("TMOG_PERFGATE_TOL", DEFAULT_TOL))
+
+
+def compare(current: Dict[str, Any], baseline: Dict[str, Any],
+            tol: Optional[float] = None) -> Dict[str, Any]:
+    """Judge one fresh report against one baseline report.
+
+    Returns ``{"metric", "tol", "platform", "results": [...], "regressed":
+    [keys], "ok": bool}``; each result row carries ``key`` / ``direction`` /
+    ``baseline`` / ``current`` / ``ratio`` / ``status`` with status one of
+    ``ok`` / ``regressed`` / ``improved`` / ``skipped_missing`` /
+    ``skipped_platform``.
+    """
+    tol = default_tolerance() if tol is None else max(0.0, float(tol))
+    metric = baseline.get("metric") or current.get("metric") or "?"
+    policy = POLICIES.get(metric, _DEFAULT_POLICY)
+    b_plat = baseline.get("platform")
+    c_plat = current.get("platform")
+    results: List[Dict[str, Any]] = []
+    regressed: List[str] = []
+    mismatch = bool(b_plat and c_plat and b_plat != c_plat)
+    for key in sorted(policy):
+        direction = policy[key]
+        b, c = baseline.get(key), current.get(key)
+        row: Dict[str, Any] = {"key": key, "direction": direction,
+                               "baseline": b, "current": c, "ratio": None}
+        if mismatch:
+            row["status"] = "skipped_platform"
+        elif not _num(b) or not _num(c):
+            row["status"] = "skipped_missing"
+        elif b == 0:
+            # no ratio exists; a lower-better zero baseline (e.g.
+            # compiles_steady=0) regresses on ANY nonzero current
+            row["status"] = ("regressed" if direction < 0 and c > 0
+                             else "ok")
+        else:
+            ratio = c / b
+            row["ratio"] = round(ratio, 4)
+            if direction > 0:
+                row["status"] = ("regressed" if ratio < 1.0 - tol else
+                                 "improved" if ratio > 1.0 + tol else "ok")
+            else:
+                row["status"] = ("regressed" if ratio > 1.0 + tol else
+                                 "improved" if ratio < 1.0 - tol else "ok")
+        if row["status"] == "regressed":
+            regressed.append(key)
+        results.append(row)
+    return {"metric": metric, "tol": tol,
+            "platform": {"baseline": b_plat, "current": c_plat},
+            "results": results, "regressed": regressed,
+            "ok": not regressed}
+
+
+def _num(v: Any) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _unwrap(doc: Any) -> Optional[Dict[str, Any]]:
+    """A report dict from a loaded JSON doc: tolerate the ``BENCH_r*``
+    ``{"parsed": {...}}`` wrapper and run-record rows (``report`` key)."""
+    if not isinstance(doc, dict):
+        return None
+    for key in ("parsed", "report"):
+        inner = doc.get(key)
+        if isinstance(inner, dict) and "metric" in inner:
+            return inner
+    return doc if "metric" in doc else None
+
+
+def load_baselines(root: str = ".") -> Dict[str, Tuple[str, Dict[str, Any]]]:
+    """metric -> (filename, report) for the newest committed baseline of
+    each family: the highest-numbered ``BENCH_r*.json`` plus
+    ``STREAM_BENCH.json``."""
+    out: Dict[str, Tuple[str, Dict[str, Any]]] = {}
+    bench = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    candidates = ([bench[-1]] if bench else []) + [
+        p for p in (os.path.join(root, "STREAM_BENCH.json"),)
+        if os.path.exists(p)]
+    for path in candidates:
+        try:
+            with open(path) as f:
+                rep = _unwrap(json.load(f))
+        except (OSError, ValueError):
+            continue
+        if rep and isinstance(rep.get("metric"), str):
+            out[rep["metric"]] = (os.path.basename(path), rep)
+    return out
+
+
+def extract_reports(path: str) -> List[Dict[str, Any]]:
+    """Report dicts from a file: a single report JSON (wrapped or flat), or
+    a telemetry JSONL whose rows carry ``report`` extras.  Unreadable rows
+    are skipped — the gate judges what it can parse."""
+    reports: List[Dict[str, Any]] = []
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return reports
+    if path.endswith(".jsonl"):
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rep = _unwrap(json.loads(line))
+            except ValueError:
+                continue
+            if rep and "metric" in rep:
+                reports.append(rep)
+    else:
+        try:
+            rep = _unwrap(json.loads(text))
+        except ValueError:
+            rep = None
+        if rep:
+            reports.append(rep)
+    return reports

@@ -33,7 +33,7 @@ from collections import deque
 from typing import Any, Deque, Dict, Optional
 
 __all__ = ["enabled", "enable", "disable", "span", "instant", "complete",
-           "now", "export", "reset", "DEFAULT_BUF_EVENTS"]
+           "now", "export", "reset", "events", "DEFAULT_BUF_EVENTS"]
 
 DEFAULT_BUF_EVENTS = 65536
 
@@ -84,6 +84,12 @@ def disable() -> None:
 
 def reset() -> None:
     _buf.clear()
+
+
+def events() -> list:
+    """A snapshot copy of the buffered events (the timeline/bubble
+    profiler's input; same dicts :func:`export` would write)."""
+    return list(_buf)
 
 
 class _NullSpan:

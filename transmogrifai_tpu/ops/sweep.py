@@ -566,23 +566,30 @@ def run_sweep(spec, X, xbs: Tuple, y, train_w, val_w, blob):
                           levels=chain["levels"])
         _inject.maybe_fail("sweep.dispatch", key="fused")
         if split:
-            with mesh_mod.trace_collectives() as colls:
-                scores = _run_scores(spec, X, tuple(xbs), y, train_w, blob)
-            _replay_trace_events(spec, n, colls)
-            out = _run_metrics(spec, y, scores, val_w)
-            flops.record("sweep.run_scores", _run_scores, spec, X,
-                         tuple(xbs), y, train_w, blob)
-            flops.record("sweep.run_metrics", _run_metrics, spec, y, scores,
-                         val_w)
+            with trace.span("sweep.dispatch", shards=1, split=True):
+                with mesh_mod.trace_collectives() as colls:
+                    scores = _run_scores(spec, X, tuple(xbs), y, train_w,
+                                         blob)
+                _replay_trace_events(spec, n, colls)
+                out = _run_metrics(spec, y, scores, val_w)
+            with trace.span("sweep.account", fn="sweep.run_scores+metrics"):
+                flops.record("sweep.run_scores", _run_scores, spec, X,
+                             tuple(xbs), y, train_w, blob)
+                flops.record("sweep.run_metrics", _run_metrics, spec, y,
+                             scores, val_w)
         else:
-            with mesh_mod.trace_collectives() as colls:
-                out = _run(spec, X, tuple(xbs), y, train_w, val_w, blob)
-            _replay_trace_events(spec, n, colls)
-            flops.record("sweep.run", _run, spec, X, tuple(xbs), y, train_w,
-                         val_w, blob)
+            with trace.span("sweep.dispatch", shards=1, split=False):
+                with mesh_mod.trace_collectives() as colls:
+                    out = _run(spec, X, tuple(xbs), y, train_w, val_w, blob)
+                _replay_trace_events(spec, n, colls)
+            with trace.span("sweep.account", fn="sweep.run"):
+                flops.record("sweep.run", _run, spec, X, tuple(xbs), y,
+                             train_w, val_w, blob)
         if ck_key is not None:
-            _ck.save("sweep_launch", ck_key, {"metrics": np.asarray(out)},
-                     meta={"candidates": C, "split": bool(split)})
+            with trace.span("sweep.checkpoint", candidates=C):
+                _ck.save("sweep_launch", ck_key,
+                         {"metrics": np.asarray(out)},
+                         meta={"candidates": C, "split": bool(split)})
         return out
 
 
@@ -816,7 +823,7 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
         _ckpt.data_fingerprint(y_host if y_host is not None else y),
         _ckpt.data_fingerprint(train_w), _ckpt.data_fingerprint(val_w))
 
-    def worker(shard, dev):
+    def worker(shard, dev, idx):
         t0 = time.perf_counter()
         ck_key = None
         if _ck.enabled:
@@ -832,9 +839,9 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
                         "checkpoint": "hit",
                         "wall_s": round(time.perf_counter() - t0, 4)}
                 return hit[0]["metrics"], stat, []
-        with trace.span("sweep.shard", device=str(dev),
+        with trace.span("sweep.shard", device=str(dev), shard=idx,
                         candidates=len(shard.cis)):
-            with trace.span("sweep.upload", device=str(dev)):
+            with trace.span("sweep.upload", device=str(dev), shard=idx):
                 Xd, xbs_d, yd = _shard_arrays(shard, dev, X, xbs, y,
                                               X_host, y_host, xb_bins)
                 tw = jax.device_put(jnp.asarray(train_w), dev)
@@ -851,7 +858,7 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
                 def _go_split():
                     _inject.maybe_fail("sweep.dispatch", key=str(dev))
                     with trace.span("sweep.dispatch", device=str(dev),
-                                    split=True):
+                                    shard=idx, split=True):
                         scores = cs(*args_s)
                         args_m = (yd, scores, vw)
                         cm, dt_m, ev_m = _aot("sweep.run_metrics",
@@ -872,14 +879,16 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
                 def _go():
                     _inject.maybe_fail("sweep.dispatch", key=str(dev))
                     with trace.span("sweep.dispatch", device=str(dev),
-                                    split=False):
+                                    shard=idx, split=False):
                         return c(*args)
 
                 out = _retry.with_retry("sweep.dispatch", _go)
                 records = [("sweep.run", c, args, ev)]
             # block in THIS thread only: other shards keep dispatching/running
-            with trace.span("sweep.gather", device=str(dev)):
+            with trace.span("sweep.gather", device=str(dev),
+                            shard=idx) as _gsp:
                 out = np.asarray(out)
+                _gsp.set(bytes=int(out.nbytes))
         stat = {"device": str(dev), "candidates": C_s,
                 "predicted_cost": float(shard.cost),
                 "compile_s": round(compile_s, 4), "split": bool(split),
@@ -900,7 +909,8 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
             trace.instant("gbt.chain", steps=chain["steps"],
                           levels=chain["levels"])
         with ThreadPoolExecutor(max_workers=len(shards)) as pool:
-            results = list(pool.map(worker, shards, devices))
+            results = list(pool.map(worker, shards, devices,
+                                    range(len(shards))))
 
         M = results[0][0].shape[-1]
         metrics = np.zeros((F, n_candidates, M), np.float32)
@@ -1078,8 +1088,9 @@ def run_sweep_rowsharded(shards, X, xbs: Tuple, y, train_w, val_w,
             out = _retry.with_retry("sweep.dispatch", _go)
             # block in THIS thread only: other columns keep
             # dispatching/running
-            with trace.span("sweep.gather", column=j):
+            with trace.span("sweep.gather", column=j) as _gsp:
                 out = np.asarray(out)
+                _gsp.set(bytes=int(out.nbytes))
         label = ",".join(str(d) for d in grid[:, j])
         stat = {"devices": [str(d) for d in grid[:, j]],
                 "candidates": len(shard.cis),

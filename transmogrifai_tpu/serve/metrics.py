@@ -165,6 +165,17 @@ class ServeMetrics:
                 dst["request_latency"].merge(st["request_latency"])
                 dst["batch_latency"].merge(st["batch_latency"])
 
+    def slo_sample(self) -> Dict[str, Any]:
+        """The cumulative counters the SLO monitor differences at its
+        window: :class:`~transmogrifai_tpu.obs.slo.SLOMonitor` sample feed."""
+        with self._lock:
+            return {"requests": self.requests, "responses": self.responses,
+                    "errors": self.errors, "shed": self.shed,
+                    "latency_counts": list(self.request_latency.counts),
+                    "latency_n": self.request_latency.n,
+                    "latency_sum_ms": self.request_latency.sum_ms,
+                    "latency_max_ms": self.request_latency.max_ms}
+
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             out: Dict[str, Any] = {

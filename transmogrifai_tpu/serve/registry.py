@@ -347,4 +347,6 @@ class ModelRegistry:
                     "aot": r.scorer is not None, "inflight": r.inflight}
                 for r in slots],
             "health": None if sup is None else sup.health(),
+            "slo": (None if sup is None or getattr(sup, "slo", None) is None
+                    else sup.slo.status()),
         }

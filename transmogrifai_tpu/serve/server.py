@@ -158,8 +158,11 @@ def _make_handler(server: "ModelServer"):
                 except Exception:
                     continual = {}
                 sup = server.batcher.supervisor
+                slo = (None if getattr(sup, "slo", None) is None
+                       else sup.slo.status())
                 self._reply(200, {"serve": server.metrics.snapshot(),
                                   "registry": server.registry.info(),
+                                  "slo": slo,
                                   "resilience": {
                                       "supervisor": sup.snapshot(),
                                       **obs.registry.scope(

@@ -29,6 +29,7 @@ from typing import Any, Dict, List, Optional
 
 from ..obs import registry as obs_registry
 from ..obs import trace
+from ..obs.slo import SLOMonitor
 from ..resilience import CircuitBreaker
 from ..utils import env as _env
 
@@ -53,6 +54,12 @@ class ReplicaSupervisor:
                                 "TMOG_SUPERVISOR_HEARTBEAT_S", 30.0)))
         self.breakers = [CircuitBreaker(name=f"serve.slot{i}")
                          for i in range(registry.n_replicas)]
+        #: rolling-window SLO judgment over the batcher's ServeMetrics,
+        #: ticked from the probe loop (None when no metrics were attached)
+        self.slo: Optional[SLOMonitor] = (
+            SLOMonitor(metrics.slo_sample)
+            if metrics is not None and hasattr(metrics, "slo_sample")
+            else None)
         self.recoveries = 0
         self._running = False
         self._thread: Optional[threading.Thread] = None
@@ -111,6 +118,11 @@ class ReplicaSupervisor:
             if now - self._last_beat >= self.heartbeat_s:
                 self._last_beat = now
                 _scope.inc("supervisor_beats")
+            if self.slo is not None:
+                try:
+                    self.slo.tick()
+                except Exception:  # judgment must never kill the probe loop
+                    pass
             for slot, brk in enumerate(self.breakers):
                 if not self._running:
                     break
@@ -157,4 +169,5 @@ class ReplicaSupervisor:
             "recoveries": self.recoveries,
             "interval_s": self.interval_s,
             "slots": self.health(),
+            "slo": None if self.slo is None else self.slo.status(),
         }

@@ -610,7 +610,8 @@ def execute(plan: StreamPlan, ds: Dataset) -> Dict[str, Any]:
 
         def _pull():
             _inject.maybe_fail("stream.pull", key=lo)
-            with trace.span("stream.chunk.pull", lo=lo, rows=rows):
+            pulled = 0
+            with trace.span("stream.chunk.pull", lo=lo, rows=rows) as _psp:
                 for e in terminals:
                     o = outs[e.out_name]
                     if e.out_kind == "numeric":
@@ -621,6 +622,7 @@ def execute(plan: StreamPlan, ds: Dataset) -> Dict[str, Any]:
                             out_masks[e.out_name] = np.empty(n, bool)
                         out_vals[e.out_name][lo:lo + rows] = hv[:rows]
                         out_masks[e.out_name][lo:lo + rows] = hm[:rows]
+                        pulled += rows * (hv.itemsize + hm.itemsize)
                         _stream_scope.inc("bytes_out", float(
                             rows * (hv.itemsize + hm.itemsize)))
                         if ck_key is not None:
@@ -632,10 +634,12 @@ def execute(plan: StreamPlan, ds: Dataset) -> Dict[str, Any]:
                             out_vals[e.out_name] = np.empty((n, hv.shape[1]),
                                                             np.float32)
                         out_vals[e.out_name][lo:lo + rows] = hv[:rows]
+                        pulled += rows * hv.shape[1] * 4
                         _stream_scope.inc("bytes_out",
                                           float(rows * hv.shape[1] * 4))
                         if ck_key is not None:
                             saved[f"v_{e.out_name}"] = hv[:rows]
+                _psp.set(bytes=int(pulled))
 
         _retry.with_retry("stream.pull", _pull)
         if ck_key is not None:
@@ -651,8 +655,9 @@ def execute(plan: StreamPlan, ds: Dataset) -> Dict[str, Any]:
             hi = min(lo + C, n)
             rows = hi - lo
             t0 = time.perf_counter()
-            with trace.span("stream.chunk.upload", lo=lo, rows=rows):
+            with trace.span("stream.chunk.upload", lo=lo, rows=rows) as _usp:
                 host_args, nbytes = _host_chunk_args(plan, ds, lo, hi, C)
+                _usp.set(bytes=int(nbytes))
                 ck_key = None
                 if _ck.enabled:
                     ck_key = _chunk_key(lo, host_args)
