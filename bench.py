@@ -86,14 +86,10 @@ def baseline_models_per_sec():
     except Exception:
         return 4.0, "estimate"  # pre-round-4 fallback constant
 
-#: peak dense arithmetic throughput per chip, FLOP/s (bf16 MXU peak; our
-#: kernels run f32, so utilization vs this figure is conservative)
-PEAK_FLOPS = {
-    "TPU v5 lite": 197e12,   # v5e: 197 TFLOP/s bf16
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,        # v5p
-    "TPU v4": 275e12,
-}
+#: peak dense arithmetic throughput per chip, FLOP/s — the canonical table
+#: now lives in utils/backend.py next to the HBM-bandwidth peaks the
+#: roofline ledger classifies against; re-exported here for compatibility
+from transmogrifai_tpu.utils.backend import PEAK_FLOPS, device_peaks
 
 
 def init_backend():
@@ -518,10 +514,12 @@ def main():
     sel.find_best_estimator(X, y)
     warm = time.perf_counter() - t_first
 
-    from transmogrifai_tpu.obs import timeline, trace
+    from transmogrifai_tpu.obs import ledger, timeline, trace
 
     flops.enable()
     flops.reset()
+    ledger.enable()
+    ledger.reset()
     reps = 3
     trace_was_on = trace.enabled()
     if not trace_was_on:
@@ -545,6 +543,16 @@ def main():
         trace.disable()
     acct = flops.totals()
     flops.disable()
+    # roofline ledger: per-launch FLOPs/bytes vs the device peaks, factored
+    # per family — the "which lever does each family need" report
+    try:
+        roof = ledger.ledger_report(window_wall_s=dt * reps,
+                                    device_kind=device_kind,
+                                    platform=platform, reps=reps)
+    except ValueError:
+        roof = None
+    ledger.disable()
+    ledger.reset()
 
     # sweep-launch telemetry (reset per validate: this is the LAST rep's),
     # so a multi-chip run shows its shard count + per-shard wall/compile —
@@ -631,6 +639,12 @@ def main():
         # shapes; residual (metrics glue, XLA fusion deltas) stays labeled
         tw, vm = sel.validator.make_folds(X.shape[0], y)
         fam = family_flops_breakdown(sel, X, y, tw, vm)
+        if not fam and roof:
+            # standalone re-lowering failed (BENCH_r05 fell back to the
+            # single sweep.run bucket here): the ledger's per-family split
+            # of the same cost_analysis totals is always available
+            fam = {k: round(v["flops"] / reps)
+                   for k, v in roof["by_family"].items()}
         if fam:
             out["flops_by_family"] = fam
             if "sweep.run" in out["flops_by_kernel"]:
@@ -640,7 +654,12 @@ def main():
                 rest = round(total - sum(fam.values()))
                 if rest > 0:
                     out["flops_by_kernel"]["sweep.run[other]"] = rest
-        peak = PEAK_FLOPS.get(device_kind)
+        out["bytes_per_rep"] = round(acct["bytes_accessed"] / reps)
+        if roof:
+            out["bytes_by_family"] = {
+                k: round(v["bytes"] / reps)
+                for k, v in roof["by_family"].items()}
+        peak = device_peaks(device_kind)["peak_flops"]
         if platform != "cpu" and peak:
             out["mfu"] = round(flops_per_rep / dt / peak, 6)
             out["peak_flops"] = peak
@@ -656,12 +675,18 @@ def main():
         # per-lane report in the JSONL record only
         out["bubble_fraction"] = bubble["bubble_fraction"]
         print(timeline.format_report(bubble), file=sys.stderr)
+    if roof:
+        out["mfu_decomposition"] = roof["mfu_decomposition"]
+        out["launch_bound_fraction"] = roof["launch_bound_fraction"]
+        print(ledger.format_report(roof), file=sys.stderr)
     print(json.dumps(out))
     from transmogrifai_tpu import obs
 
     extra = {"report": out}
     if bubble:
         extra["bubble_report"] = bubble
+    if roof:
+        extra["roofline"] = roof
     obs.write_record("bench", extra=extra)
 
 

@@ -96,7 +96,9 @@ def test_golden_rows_stable_vectors(tmp_path):
         7.0, 3.0, 0.0, 3.0, 1.0,
         feat["log_rows"], feat["log_features"], 3.0,
         feat["log_gbt_chain_levels"], 12.0, feat["log_bins_max"],
-        2.0, feat["log_rows_local"], 8.0, 1.0])
+        2.0, feat["log_rows_local"], 8.0, 1.0,
+        # PR-12 measured-cost tail: absent from this golden row -> 0.0
+        0.0, 0.0, 0.0])
     v = feature_vector(samples[0]["feat"])
     assert v.shape == (len(FEATURE_NAMES),)
     np.testing.assert_array_equal(v, expected)
@@ -124,6 +126,48 @@ def test_missing_and_nan_fields_degrade(tmp_path):
         {"device": "TPU_2", "feat": {"log_units": 1.0}})  # no wall
     row["snapshot"]["sweep"]["launches"].append("not-a-dict")
     assert len(shard_samples([row, "not-a-row", None, {}])) == 1
+
+
+def test_feature_names_append_only_with_cost_tail():
+    """PR-12 appended the measured-cost features; the contract is that the
+    tail is append-only and old rows without them still vectorize (0.0 in
+    the new slots, original prefix untouched)."""
+    from transmogrifai_tpu.costmodel.features import cost_feature_dict
+
+    assert FEATURE_NAMES[-3:] == ("log_flops", "log_bytes_accessed",
+                                  "arith_intensity")
+    assert FEATURE_NAMES[:2] == ("log_units", "log_units_linear")
+    assert len(FEATURE_NAMES) == len(set(FEATURE_NAMES)) == 23
+
+    legacy = _golden_feat()  # pre-PR-12 dict: no cost features at all
+    v = feature_vector(legacy)
+    assert v.shape == (23,)
+    assert np.all(v[-3:] == 0.0)
+    assert v[0] == pytest.approx(math.log1p(5.5e8))
+
+    new = dict(legacy)
+    new.update(cost_feature_dict(2e9, 1e8))
+    v2 = feature_vector(new)
+    assert np.array_equal(v2[:-3], v[:-3])  # prefix order unchanged
+    assert v2[-3] == pytest.approx(math.log1p(2e9))
+    assert v2[-2] == pytest.approx(math.log1p(1e8))
+    assert v2[-1] == pytest.approx(20.0)
+    # zero-byte launches (cost_analysis without the bytes key) stay finite
+    z = cost_feature_dict(1e6, 0.0)
+    assert z["arith_intensity"] == 0.0
+
+
+def test_old_jsonl_rows_without_bytes_features_still_extract(tmp_path):
+    """shard_samples over a pre-PR-12 telemetry row: extraction and
+    vectorization both succeed, new slots read 0.0."""
+    p = tmp_path / "old.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps(_golden_row(_golden_feat())) + "\n")
+    samples = shard_samples(iter_records(str(p)))
+    assert len(samples) == 1
+    v = feature_vector(samples[0]["feat"])
+    assert v.shape == (len(FEATURE_NAMES),)
+    assert np.all(v[-3:] == 0.0)
 
 
 def test_schema_version_bump_still_extracts():

@@ -342,6 +342,58 @@ class TestTraceCoverage:
 
 
 # ---------------------------------------------------------------------------
+# Launch ledger disabled path (same contract as the null span above)
+# ---------------------------------------------------------------------------
+class TestLedgerDisabled:
+    def test_disabled_ledger_is_shared_singleton(self):
+        from transmogrifai_tpu.obs import ledger
+
+        ledger.disable()
+        l1, l2 = ledger.get(), ledger.get()
+        assert l1 is l2
+        assert not l1.enabled
+        assert l1.now() == 0.0
+        assert l1.launch("k", wall_s=1.0, flops=1.0) is None
+        assert l1.rows() == []
+        assert ledger.rows() == []  # the live ledger saw nothing either
+
+    def test_overhead_when_disabled_is_free(self):
+        import timeit
+
+        from transmogrifai_tpu.obs import ledger
+
+        ledger.disable()
+        base = timeit.timeit(lambda: None, number=20000)
+        hooks = timeit.timeit(
+            lambda: ledger.get().launch("x", wall_s=0.0, flops=0.0),
+            number=20000)
+        # one module-global boolean check + a no-op method: same generous
+        # bound the null-span overhead test uses
+        assert hooks < max(base * 20, 0.05)
+
+    def test_enable_reflects_in_get_and_snapshot(self):
+        from transmogrifai_tpu.obs import ledger
+
+        try:
+            ledger.enable()
+            ledger.reset()
+            lg = ledger.get()
+            assert lg.enabled
+            lg.launch("k", wall_s=0.5, flops=10.0, bytes=5.0)
+            assert len(ledger.rows()) == 1
+            snap = obs.snapshot()
+            assert snap["ledger"]["enabled"]
+            assert snap["ledger"]["n_rows"] == 1
+        finally:
+            from transmogrifai_tpu.utils import flops
+
+            ledger.disable()
+            ledger.reset()
+            flops.disable()  # ledger.enable() turned accounting on
+            flops.reset()
+
+
+# ---------------------------------------------------------------------------
 # JSONL run records
 # ---------------------------------------------------------------------------
 class TestRunRecord:

@@ -13,7 +13,12 @@ Consolidates the former ``profile_trees.py`` / ``profile_trees2.py`` /
   ``jax.profiler.trace`` (XLA-level, for TensorBoard);
 - ``fused``        — per-fragment device-time profile of the fused Titanic
   sweep (the former ``profile_fused.py``): the full spec, each fragment
-  kind alone, and each forest depth group alone.
+  kind alone, and each forest depth group alone;
+- ``roofline``     — the launch ledger over the fused Titanic sweep:
+  per-launch FLOPs + bytes-accessed vs the device peaks, per-family MFU
+  decomposition and compute/memory/launch-bound labels
+  (transmogrifai_tpu/obs/ledger.py; set TMOG_PEAK_FLOPS /
+  TMOG_PEAK_HBM_GBPS to calibrate off-TPU).
 
 ``--trace out.json`` on any subcommand additionally records obs spans
 (``profile.case`` per timed case) and exports Chrome trace-event JSON
@@ -35,7 +40,7 @@ from bench import init_backend
 parser = argparse.ArgumentParser(description=__doc__)
 parser.add_argument("cmd", nargs="?", default="trees",
                     choices=["trees", "trees-beam", "trees-stats", "trace",
-                             "fused"])
+                             "fused", "roofline"])
 parser.add_argument("--reps", type=int, default=0,
                     help="timing repetitions (default: 3, trees-stats 6)")
 parser.add_argument("--trace", default="",
@@ -239,6 +244,48 @@ def cmd_fused(reps):
                       (frag,))
 
 
+def cmd_roofline(reps):
+    """Launch ledger + roofline/MFU decomposition of the fused Titanic
+    sweep: reps selector fits with FLOPs+bytes accounting and the launch
+    ledger on, then the per-family report (obs/ledger.format_report)."""
+    from bench import make_selector, titanic_arrays
+    from transmogrifai_tpu.obs import ledger
+    from transmogrifai_tpu.utils import flops
+
+    Xt, yt = titanic_arrays()
+    sel = make_selector()
+    sel.find_best_estimator(Xt, yt)  # warmup: compile everything first
+    flops.enable()
+    flops.reset()
+    ledger.enable()
+    ledger.reset()
+    trace_was_on = obs_trace.enabled()
+    if not trace_was_on:
+        obs_trace.enable(path=None)
+    t0 = time.perf_counter()
+    with obs_trace.span("profile.window", reps=reps):
+        for r in range(reps):
+            sel2 = make_selector(seed=100 + r)
+            sel2.find_best_estimator(Xt, yt)
+    wall = time.perf_counter() - t0
+    if not trace_was_on:
+        obs_trace.disable()
+    flops.disable()
+    try:
+        roof = ledger.ledger_report(window_wall_s=wall,
+                                    device_kind=jax.devices()[0].device_kind,
+                                    platform=jax.devices()[0].platform,
+                                    reps=reps)
+    except ValueError:
+        print("ledger is empty (cost_analysis unavailable?); no report")
+        return None
+    finally:
+        ledger.disable()
+    print(ledger.format_report(roof))
+    return roof
+
+
+_roof = None
 if cli.cmd == "trees":
     cmd_trees(cli.reps or 3)
 elif cli.cmd == "trees-beam":
@@ -247,9 +294,15 @@ elif cli.cmd == "trees-stats":
     cmd_trees_stats(cli.reps or 6)
 elif cli.cmd == "fused":
     cmd_fused(cli.reps or 5)
+elif cli.cmd == "roofline":
+    _roof = cmd_roofline(cli.reps or 3)
 else:
     cmd_trace(cli.reps or 1)
 
 if cli.trace:
     print(f"obs trace -> {obs_trace.export(cli.trace)}")
-obs.write_record("profile", extra={"cmd": cli.cmd})
+_extra = {"cmd": cli.cmd}
+if _roof:
+    _extra["roofline"] = _roof
+    _extra["mfu_decomposition"] = _roof["mfu_decomposition"]
+obs.write_record("profile", extra=_extra)

@@ -105,14 +105,15 @@ def profile_shards(n_shards: int, reps: int = 3,
                    use_costmodel: bool = False):
     """Predicted vs measured per-shard cost of the default 28-candidate grid.
 
-    Returns ``(cm_eval, bubble_report)``: the predicted-vs-measured eval
-    dict (MAPE, makespan ratios) when ``--costmodel`` supplied a trained
-    model, and the timeline bubble report over the measured window — both
-    appended to the run's JSONL record."""
+    Returns ``(cm_eval, bubble_report, roofline)``: the predicted-vs-
+    measured eval dict (MAPE, makespan ratios) when ``--costmodel``
+    supplied a trained model, the timeline bubble report over the measured
+    window, and the launch-ledger roofline report — all appended to the
+    run's JSONL record."""
     import jax
 
     from transmogrifai_tpu.impl.sweep_fragments import build_sweep_plan
-    from transmogrifai_tpu.obs import timeline, trace
+    from transmogrifai_tpu.obs import ledger, timeline, trace
     from transmogrifai_tpu.ops.sweep import run_sweep
     from transmogrifai_tpu.parallel.spec_partition import (partition_spec,
                                                            predicted_balance)
@@ -127,11 +128,13 @@ def profile_shards(n_shards: int, reps: int = 3,
                             train_w, ev)
     if plan is None:
         print("default grid did not build a fused plan; nothing to profile")
-        return None, None
+        return None, None, None
     from transmogrifai_tpu.ops import sweep as sweep_ops
     from transmogrifai_tpu.utils import flops
     flops.enable()
     flops.reset()
+    ledger.enable()
+    ledger.reset()
     sweep_ops.reset_run_stats()
     shards = partition_spec(plan.spec, plan.blob, n_shards, plan.n_rows,
                             plan.n_features, F)
@@ -212,11 +215,22 @@ def profile_shards(n_shards: int, reps: int = 3,
         print(timeline.format_report(bub))
     except ValueError as e:
         print(f"bubble report unavailable: {e}")
+    roof = None
+    try:
+        roof = ledger.ledger_report(window_wall_s=wall_meas,
+                                    device_kind=jax.devices()[0].device_kind,
+                                    platform=jax.devices()[0].platform,
+                                    reps=reps)
+        print(ledger.format_report(roof))
+    except ValueError as e:
+        print(f"roofline report unavailable: {e}")
+    ledger.disable()
+    ledger.reset()
     if not trace_was_on:
         trace.disable()
     _print_gbt_telemetry(sweep_ops)
     flops.disable()
-    return cm_eval, bub
+    return cm_eval, bub, roof
 
 
 def profile_rowsharded(n_data: int, n_model: int, reps: int = 3) -> None:
@@ -306,12 +320,16 @@ if args.data_shards > 0:
     sys.exit(0)
 
 if args.shards > 0:
-    cm_eval, bub = profile_shards(args.shards, use_costmodel=args.costmodel)
+    cm_eval, bub, roof = profile_shards(args.shards,
+                                        use_costmodel=args.costmodel)
     extra = {"mode": "shards"}
     if cm_eval:
         extra["costmodel_eval"] = cm_eval
     if bub:
         extra["bubble_report"] = bub
+    if roof:
+        extra["roofline"] = roof
+        extra["mfu_decomposition"] = roof["mfu_decomposition"]
     obs.write_record("profile_sweep", extra=extra)
     sys.exit(0)
 

@@ -26,7 +26,8 @@ import numpy as np
 
 __all__ = [
     "FEATURE_NAMES", "FAMILIES", "unit_family", "shard_feature_dict",
-    "feature_vector", "family_units", "iter_records", "shard_samples",
+    "feature_vector", "family_units", "cost_feature_dict", "iter_records",
+    "shard_samples",
     "stream_samples", "synthetic_samples",
 ]
 
@@ -47,6 +48,10 @@ FEATURE_NAMES = (
     "depth_max", "log_bins_max",
     "data_shards", "log_rows_local",
     "device_count", "is_tpu",
+    # measured-cost features from the launch ledger (PR 12): XLA
+    # cost_analysis FLOPs + bytes accessed per launch.  Old rows without
+    # them vectorize with 0.0 in these slots (missing -> 0.0 contract).
+    "log_flops", "log_bytes_accessed", "arith_intensity",
 )
 
 
@@ -130,6 +135,19 @@ def family_units(feat: Dict[str, Any]) -> Dict[str, float]:
     """Raw (de-logged) analytic units per family — the calibration basis."""
     return {f: max(math.expm1(_finite(feat.get(f"log_units_{f}"))), 0.0)
             for f in FAMILIES}
+
+
+def cost_feature_dict(flops: float, bytes_accessed: float) -> Dict[str, float]:
+    """Measured-cost features (the FEATURE_NAMES tail) from one launch's
+    XLA cost_analysis numbers — stamped into per-shard telemetry by
+    ``ops/sweep`` so recorded rows can price memory traffic."""
+    fl = max(_finite(flops), 0.0)
+    by = max(_finite(bytes_accessed), 0.0)
+    return {
+        "log_flops": math.log1p(fl),
+        "log_bytes_accessed": math.log1p(by),
+        "arith_intensity": fl / by if by > 0 else 0.0,
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -24,6 +24,8 @@ from __future__ import annotations
 from typing import Any, Dict
 
 from . import record, registry, regress, slo, timeline, trace
+from . import ledger
+from .ledger import LaunchLedger, classify_launch, ledger_report
 from .record import write_record
 from .registry import (REGISTRY, SCHEMA_VERSION, prometheus_text,
                        record_fallback, register_provider, scope)
@@ -32,10 +34,11 @@ from .timeline import bubble_report, format_report
 from .trace import complete, instant, span
 
 __all__ = ["trace", "registry", "record", "timeline", "slo", "regress",
-           "snapshot", "write_record", "span", "instant", "complete",
-           "scope", "register_provider", "record_fallback",
+           "ledger", "snapshot", "write_record", "span", "instant",
+           "complete", "scope", "register_provider", "record_fallback",
            "prometheus_text", "REGISTRY", "SCHEMA_VERSION", "SLOMonitor",
-           "bubble_report", "format_report"]
+           "bubble_report", "format_report", "LaunchLedger",
+           "classify_launch", "ledger_report"]
 
 
 def snapshot() -> Dict[str, Any]:

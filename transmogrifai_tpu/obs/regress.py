@@ -38,6 +38,9 @@ POLICIES: Dict[str, Dict[str, int]] = {
     "selector_sweep_models_per_sec": {
         "value": +1, "vs_baseline": +1, "mfu": +1,
         "warmup_s": -1, "steady_s": -1,
+        # roofline ledger (PR 12): fraction of launches whose wall is
+        # dominated by dispatch overhead — lower is better
+        "launch_bound_fraction": -1,
     },
     "transform_stream_speedup": {
         "value": +1, "transform_rows_per_sec": +1,

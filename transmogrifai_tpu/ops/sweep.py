@@ -71,6 +71,7 @@ except ImportError:  # the 0.4.x experimental home
     from jax.experimental.shard_map import shard_map as _shard_map
     _no_check = {"check_rep": False}
 
+from ..obs import ledger as _ledger
 from ..obs import registry as obs_registry
 from ..obs import trace
 from ..parallel import mesh as mesh_mod
@@ -565,26 +566,47 @@ def run_sweep(spec, X, xbs: Tuple, y, train_w, val_w, blob):
             trace.instant("gbt.chain", steps=chain["steps"],
                           levels=chain["levels"])
         _inject.maybe_fail("sweep.dispatch", key="fused")
+        _lg = _ledger.get()
         if split:
+            _lt0 = _lg.now()
             with trace.span("sweep.dispatch", shards=1, split=True):
                 with mesh_mod.trace_collectives() as colls:
                     scores = _run_scores(spec, X, tuple(xbs), y, train_w,
                                          blob)
                 _replay_trace_events(spec, n, colls)
                 out = _run_metrics(spec, y, scores, val_w)
+            _lwall = _lg.now() - _lt0
             with trace.span("sweep.account", fn="sweep.run_scores+metrics"):
-                flops.record("sweep.run_scores", _run_scores, spec, X,
-                             tuple(xbs), y, train_w, blob)
-                flops.record("sweep.run_metrics", _run_metrics, spec, y,
-                             scores, val_w)
+                costs = [
+                    flops.record("sweep.run_scores", _run_scores, spec, X,
+                                 tuple(xbs), y, train_w, blob),
+                    flops.record("sweep.run_metrics", _run_metrics, spec, y,
+                                 scores, val_w)]
+            kernel = "sweep.run_scores+metrics"
         else:
+            _lt0 = _lg.now()
             with trace.span("sweep.dispatch", shards=1, split=False):
                 with mesh_mod.trace_collectives() as colls:
                     out = _run(spec, X, tuple(xbs), y, train_w, val_w, blob)
                 _replay_trace_events(spec, n, colls)
+            _lwall = _lg.now() - _lt0
             with trace.span("sweep.account", fn="sweep.run"):
-                flops.record("sweep.run", _run, spec, X, tuple(xbs), y,
-                             train_w, val_w, blob)
+                costs = [flops.record("sweep.run", _run, spec, X, tuple(xbs),
+                                      y, train_w, val_w, blob)]
+            kernel = "sweep.run"
+        if _lg.enabled:
+            # dispatch is async on this path (nothing gathers here), so the
+            # wall is the dispatch span only — classification still holds
+            # (a tiny wall reads launch-bound, which is the truth for a
+            # launch whose device time we haven't observed yet)
+            costs = [c for c in costs if c]
+            _lg.launch(kernel, wall_s=_lwall,
+                       flops=sum(c.get("flops", 0.0) for c in costs),
+                       bytes=sum(c.get("bytes_accessed", 0.0)
+                                 for c in costs),
+                       families=_launch_families(spec, n, int(X.shape[1]),
+                                                 F),
+                       shard=0, split=bool(split))
         if ck_key is not None:
             with trace.span("sweep.checkpoint", candidates=C):
                 _ck.save("sweep_launch", ck_key,
@@ -765,6 +787,59 @@ def _shard_feat(spec, n, d, F, data_shards=1, rows_local=None):
         return None
 
 
+#: costmodel family names -> the ledger/report labels the paper uses
+_FAM_LABEL = {"linear": "LR", "mlp": "MLP", "forest": "RF", "gbt": "XGB"}
+_fam_cache: Dict[Tuple, Dict[str, float]] = {}
+
+
+def _launch_families(spec, n, d, F) -> Dict[str, float]:
+    """Family label -> fraction of one launch's work, from the costmodel's
+    per-family unit estimates (the PR-4 per-family lowering split) — how the
+    launch ledger splits a mixed-family launch's FLOPs/bytes/wall.  Cached
+    per (spec, n, d, F); degrades to a single "sweep" bucket on any failure
+    (telemetry must never kill the launch)."""
+    key = (spec, int(n), int(d), int(F))
+    hit = _fam_cache.get(key)
+    if hit is not None:
+        return dict(hit)
+    fams: Dict[str, float] = {}
+    try:
+        from ..costmodel.features import FAMILIES, family_units
+
+        feat = _shard_feat(spec, n, d, F)
+        if feat:
+            units = family_units(feat)
+            for f in FAMILIES:
+                u = float(units.get(f, 0.0))
+                if u > 0:
+                    fams[_FAM_LABEL.get(f, f)] = u
+    except Exception:
+        fams = {}
+    if not fams:
+        fams = {"sweep": 1.0}
+    tot = sum(fams.values())
+    fams = {k: v / tot for k, v in fams.items()}
+    _fam_cache[key] = fams
+    return dict(fams)
+
+
+def _stamp_cost_features(stat, costs) -> None:
+    """Fold measured FLOPs/bytes into the shard's cost-model feature dict so
+    recorded JSONL rows carry the memory-traffic features (FEATURE_NAMES
+    tail) the learned cost model prices."""
+    feat = stat.get("feat")
+    if feat is None or not costs:
+        return
+    try:
+        from ..costmodel.features import cost_feature_dict
+
+        feat.update(cost_feature_dict(
+            sum(c.get("flops", 0.0) for c in costs),
+            sum(c.get("bytes_accessed", 0.0) for c in costs)))
+    except Exception:
+        pass
+
+
 def _shard_arrays(shard, dev, X, xbs, y, X_host, y_host, xb_bins):
     """Per-device copies of the shard's static arrays.
 
@@ -850,10 +925,12 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
             C_s = len(shard.cis)
             split = F * C_s * n * k > SPLIT_METRICS_ELEMS
             records = []
+            _lg = _ledger.get()
             if split:
                 args_s = (Xd, xbs_d, yd, tw, bl)
                 cs, dt_s, ev_s = _aot("sweep.run_scores", _run_scores,
                                       shard.spec, dev, args_s)
+                _lt0 = _lg.now()
 
                 def _go_split():
                     _inject.maybe_fail("sweep.dispatch", key=str(dev))
@@ -875,6 +952,7 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
                 args = (Xd, xbs_d, yd, tw, vw, bl)
                 c, compile_s, ev = _aot("sweep.run", _run, shard.spec, dev,
                                         args)
+                _lt0 = _lg.now()
 
                 def _go():
                     _inject.maybe_fail("sweep.dispatch", key=str(dev))
@@ -893,6 +971,11 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
                 "predicted_cost": float(shard.cost),
                 "compile_s": round(compile_s, 4), "split": bool(split),
                 "wall_s": round(time.perf_counter() - t0, 4)}
+        if _lg.enabled:
+            # dispatch start -> gather end: the full device round trip the
+            # ledger row reports (gather blocks in this thread, so this IS
+            # the launch's measured wall, compile/upload excluded)
+            stat["launch_wall_s"] = _lg.now() - _lt0
         feat = _shard_feat(shard.spec, n, d, F)
         if feat is not None:
             stat["feat"] = feat
@@ -915,12 +998,30 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
         M = results[0][0].shape[-1]
         metrics = np.zeros((F, n_candidates, M), np.float32)
         per_shard = []
-        for (out, stat, records), shard, dev in zip(results, shards, devices):
+        _lg = _ledger.get()
+        d = int(X_host.shape[1]) if X_host is not None else int(X.shape[1])
+        for sidx, ((out, stat, records), shard, dev) in enumerate(
+                zip(results, shards, devices)):
             metrics[:, np.asarray(shard.cis, np.int64), :] = out
             per_shard.append(stat)
+            costs = []
             for name, compiled, args, events in records:
-                flops.record_compiled(name, compiled, args, device=dev)
+                cost = flops.record_compiled(name, compiled, args,
+                                             device=dev)
                 flops.record_collectives(events, device=dev)
+                if cost:
+                    costs.append(cost)
+            _stamp_cost_features(stat, costs)
+            if _lg.enabled and records:
+                _lg.launch("sweep.run" if len(records) == 1
+                           else "sweep.run_scores+metrics",
+                           wall_s=stat.get("launch_wall_s",
+                                           stat.get("wall_s", 0.0)),
+                           flops=sum(c.get("flops", 0.0) for c in costs),
+                           bytes=sum(c.get("bytes_accessed", 0.0)
+                                     for c in costs),
+                           families=_launch_families(shard.spec, n, d, F),
+                           shard=sidx, device=str(dev))
     entry = {"shards": len(shards), "candidates": int(n_candidates),
              "wall_s": round(time.perf_counter() - t_all, 4),
              "per_shard": per_shard}
@@ -1079,6 +1180,8 @@ def run_sweep_rowsharded(shards, X, xbs: Tuple, y, train_w, val_w,
             args = (Xd, xbs_d, yd, tw, vw, bl)
             compiled, compile_s, colls = _aot_rs(shard.spec, submesh, n_orig,
                                                  args)
+            _lg = _ledger.get()
+            _lt0 = _lg.now()
 
             def _go():
                 _inject.maybe_fail("sweep.dispatch", key=f"rs{j}")
@@ -1098,6 +1201,8 @@ def run_sweep_rowsharded(shards, X, xbs: Tuple, y, train_w, val_w,
                 "compile_s": round(compile_s, 4),
                 "rows_local": n_pad // n_data,
                 "wall_s": round(time.perf_counter() - t0, 4)}
+        if _lg.enabled:
+            stat["launch_wall_s"] = _lg.now() - _lt0
         feat = _shard_feat(shard.spec, n_orig, n_feat, F,
                            data_shards=int(n_data),
                            rows_local=n_pad // n_data)
@@ -1125,14 +1230,27 @@ def run_sweep_rowsharded(shards, X, xbs: Tuple, y, train_w, val_w,
     per_shard = []
     coll_agg: Dict[str, Dict[str, float]] = {}
     n_orig = n_pad = 0
-    for (out, stat, rec), shard in zip(results, shards):
+    _lg = _ledger.get()
+    _d_feat = int(X_host.shape[1]) if X_host is not None else int(X.shape[1])
+    for j, ((out, stat, rec), shard) in enumerate(zip(results, shards)):
         metrics[:, np.asarray(shard.cis, np.int64), :] = out[:F]
         per_shard.append(stat)
         if rec is None:  # shard restored from checkpoint: nothing ran
             continue
         name, compiled, args, label, colls, n_orig, n_pad = rec
-        flops.record_compiled(name, compiled, args, device=label)
+        cost = flops.record_compiled(name, compiled, args, device=label)
         flops.record_collectives(colls, device=label)
+        _stamp_cost_features(stat, [cost] if cost else [])
+        if _lg.enabled:
+            _lg.launch(name,
+                       wall_s=stat.get("launch_wall_s",
+                                       stat.get("wall_s", 0.0)),
+                       flops=cost.get("flops", 0.0) if cost else 0.0,
+                       bytes=(cost.get("bytes_accessed", 0.0)
+                              if cost else 0.0),
+                       families=_launch_families(shard.spec, n_orig, _d_feat,
+                                                 F),
+                       shard=j, device=label)
         for kind, axis, nbytes in colls:
             if kind in ("hist_subtracted", "gbt_chain"):
                 continue  # kernel trace events, not mesh traffic

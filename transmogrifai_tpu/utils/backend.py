@@ -25,6 +25,46 @@ _RESULT: Optional[Tuple[str, Optional[str]]] = None
 
 _PROBE = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
 
+#: per-device-kind peak dense arithmetic throughput, FLOP/s (bf16 MXU peak;
+#: our kernels run f32, so utilization vs these figures is conservative).
+#: Keys are ``jax.Device.device_kind`` strings.
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e: 197 TFLOP/s bf16
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,        # v5p
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+}
+
+#: per-device-kind peak HBM bandwidth, GB/s — the memory roof the launch
+#: ledger (obs/ledger.py) classifies against
+PEAK_HBM_GBPS = {
+    "TPU v5 lite": 819.0,    # v5e: 16 GB HBM2 @ 819 GB/s
+    "TPU v5e": 819.0,
+    "TPU v5": 2765.0,        # v5p: 95 GB HBM2e @ 2765 GB/s
+    "TPU v5p": 2765.0,
+    "TPU v4": 1228.0,        # 32 GB HBM2 @ 1228 GB/s
+}
+
+
+def device_peaks(device_kind: Optional[str] = None) -> dict:
+    """Roofline peaks for a ``device_kind``: {"peak_flops", "peak_hbm_gbps"}.
+
+    Unknown kinds (CPU hosts, new TPU generations) yield None values — the
+    ledger then labels every launch launch-bound rather than inventing a
+    roof.  ``TMOG_PEAK_FLOPS`` / ``TMOG_PEAK_HBM_GBPS`` override either
+    entry (the CPU-proxy / new-hardware calibration knobs).  Pure table +
+    env lookup: safe to call without initializing JAX.
+    """
+    from . import env as _env
+
+    pf = _env.env_float("TMOG_PEAK_FLOPS", 0.0) \
+        or PEAK_FLOPS.get(device_kind or "")
+    bw = _env.env_float("TMOG_PEAK_HBM_GBPS", 0.0) \
+        or PEAK_HBM_GBPS.get(device_kind or "")
+    return {"peak_flops": float(pf) if pf else None,
+            "peak_hbm_gbps": float(bw) if bw else None}
+
 #: on-disk probe cache so back-to-back app runs (train, then score) don't
 #: each pay the hang-detection timeout.  A cached CPU FALLBACK expires fast:
 #: a transient tunnel blip must not pin later runs to CPU for an hour

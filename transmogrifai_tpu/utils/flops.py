@@ -83,10 +83,12 @@ def reset() -> None:
 def totals() -> Dict[str, Any]:
     """{"flops", "bytes_accessed", "calls", "by_fn": {...}, "by_device": {...}}
 
-    Each ``by_fn`` entry carries a ``by_shape`` sub-dict mapping a compact
-    shape signature -> {"flops", "calls"}, so a kernel recorded once per
-    shard/per chunk under DIFFERENT shapes (the partitioned sweep does
-    exactly this) stays auditable: sum of by_shape calls == entry calls.
+    Each ``by_fn`` entry carries ``flops``, ``bytes`` (XLA "bytes accessed"
+    — the roofline ledger's memory-traffic mirror of the FLOPs bucket),
+    ``calls``, and a ``by_shape`` sub-dict mapping a compact shape signature
+    -> {"flops", "bytes", "calls"}, so a kernel recorded once per shard/per
+    chunk under DIFFERENT shapes (the partitioned sweep does exactly this)
+    stays auditable: sum of by_shape calls == entry calls.
     ``by_device`` splits the same totals by the device label the caller
     attributed the launch to (multi-chip runs; empty on unattributed runs);
     a device that ran collective-bearing programs additionally carries a
@@ -97,7 +99,8 @@ def totals() -> Dict[str, Any]:
     """
     out: Dict[str, Any] = dict(_totals)
     out["by_fn"] = {
-        k: {"flops": v["flops"], "calls": v["calls"],
+        k: {"flops": v["flops"], "bytes": v.get("bytes", 0.0),
+            "calls": v["calls"],
             "by_shape": {s: dict(c) for s, c in v["by_shape"].items()}}
         for k, v in _by_fn.items()}
     out["by_device"] = {
@@ -165,7 +168,8 @@ def record_collectives(colls, device=None) -> None:
         agg[f"{kind}_count"] = agg.get(f"{kind}_count", 0.0) + 1
         if device is not None:
             dv = _by_device.setdefault(str(device),
-                                       {"flops": 0.0, "calls": 0.0})
+                                       {"flops": 0.0, "bytes": 0.0,
+                                        "calls": 0.0})
             dcoll = dv.setdefault("collectives", {})
             dax = dcoll.setdefault(axis, {"count": 0.0, "bytes": 0.0})
             dax["count"] += 1
@@ -215,16 +219,35 @@ def _accumulate(name: str, cost: Dict[str, float], shape_key: str,
     _totals["flops"] += cost["flops"]
     _totals["bytes_accessed"] += cost["bytes_accessed"]
     _totals["calls"] += 1
-    agg = _by_fn.setdefault(name, {"flops": 0.0, "calls": 0.0, "by_shape": {}})
+    agg = _by_fn.setdefault(name, {"flops": 0.0, "bytes": 0.0, "calls": 0.0,
+                                   "by_shape": {}})
     agg["flops"] += cost["flops"]
+    agg["bytes"] = agg.get("bytes", 0.0) + cost["bytes_accessed"]
     agg["calls"] += 1
-    sh = agg["by_shape"].setdefault(shape_key, {"flops": 0.0, "calls": 0.0})
+    sh = agg["by_shape"].setdefault(shape_key,
+                                    {"flops": 0.0, "bytes": 0.0, "calls": 0.0})
     sh["flops"] += cost["flops"]
+    sh["bytes"] = sh.get("bytes", 0.0) + cost["bytes_accessed"]
     sh["calls"] += 1
     if device is not None:
-        dv = _by_device.setdefault(str(device), {"flops": 0.0, "calls": 0.0})
+        dv = _by_device.setdefault(str(device),
+                                   {"flops": 0.0, "bytes": 0.0, "calls": 0.0})
         dv["flops"] += cost["flops"]
+        dv["bytes"] = dv.get("bytes", 0.0) + cost["bytes_accessed"]
         dv["calls"] += 1
+
+
+def bytes_by_kernel() -> Dict[str, float]:
+    """kernel name -> accumulated XLA "bytes accessed" — the per-program
+    memory-traffic mirror of the per-fn FLOPs bucket (the roofline ledger's
+    bytes source)."""
+    return {k: float(v.get("bytes", 0.0)) for k, v in _by_fn.items()}
+
+
+def bytes_by_device() -> Dict[str, float]:
+    """device label -> accumulated XLA "bytes accessed" (mirror of the
+    per-device FLOPs bucket)."""
+    return {k: float(v.get("bytes", 0.0)) for k, v in _by_device.items()}
 
 
 def _cost(fn, args, kwargs) -> Optional[Dict[str, Any]]:
@@ -273,47 +296,55 @@ def wrap(name: str, jitted):
     return wrapper
 
 
-def record(name: str, fn, *args, **kwargs) -> None:
+def record(name: str, fn, *args, **kwargs) -> Optional[Dict[str, Any]]:
     """Accumulate the XLA-optimized cost of ONE call of jitted ``fn`` at
     these arguments.  No-op unless enabled; per-(fn, shapes) cost is cached.
-    ``fn`` must be the jit-wrapped callable itself (has ``.lower``)."""
+    ``fn`` must be the jit-wrapped callable itself (has ``.lower``).
+    Returns the per-call cost dict ({"flops", "bytes_accessed", ...}; treat
+    as read-only — it is the cache entry) so launch sites can feed the
+    roofline ledger, or None when disabled/unavailable."""
     if not _enabled:
-        return
+        return None
     key = (name, _signature(args, kwargs))
     if key not in _cost_cache:
         _cost_cache[key] = _cost(fn, args, kwargs)
     cost = _cost_cache[key]
     if cost is None:
-        return
+        return None
     _accumulate(name, cost, _shape_key(args, kwargs), None)
     record_collectives(cost.get("events", ()))
+    return cost
 
 
-def record_device(name: str, device, fn, *args, **kwargs) -> None:
+def record_device(name: str, device, fn, *args, **kwargs
+                  ) -> Optional[Dict[str, Any]]:
     """:func:`record`, attributing the call to ``device`` in ``by_device``."""
     if not _enabled:
-        return
+        return None
     key = (name, _signature(args, kwargs))
     if key not in _cost_cache:
         _cost_cache[key] = _cost(fn, args, kwargs)
     cost = _cost_cache[key]
     if cost is None:
-        return
+        return None
     _accumulate(name, cost, _shape_key(args, kwargs), str(device))
     record_collectives(cost.get("events", ()), device)
+    return cost
 
 
-def record_compiled(name: str, compiled, args: Tuple, device=None) -> None:
+def record_compiled(name: str, compiled, args: Tuple, device=None
+                    ) -> Optional[Dict[str, float]]:
     """Accumulate ONE call of an already-AOT-compiled executable.
 
     The multi-chip sweep compiles its per-shard programs itself (concurrent
     AOT, ops/sweep.py) — re-lowering them here just to read a cost would
     double every shard's compile, so this variant reads ``cost_analysis()``
     straight off the executable.  ``args`` are the call's dynamic arguments
-    (shape-signature bookkeeping only).
+    (shape-signature bookkeeping only).  Returns the per-call cost dict, or
+    None when disabled/unavailable.
     """
     if not _enabled:
-        return
+        return None
     try:
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):  # older jax returns [dict]
@@ -322,6 +353,7 @@ def record_compiled(name: str, compiled, args: Tuple, device=None) -> None:
                 "bytes_accessed": float(ca.get("bytes accessed",
                                                ca.get("bytes_accessed", 0.0)))}
     except Exception:
-        return
+        return None
     _accumulate(name, cost, _shape_key(args, {}),
                 None if device is None else str(device))
+    return cost
