@@ -593,6 +593,16 @@ def main():
                  for s in l["per_shard"]]
     if per_shard:
         out["sweep_per_shard"] = per_shard
+    # straggler defense: duplicate dispatches fired + the losers' discarded
+    # wall as a fraction of total sweep wall (perfgate lower-better policy —
+    # the key is always present so baselines can compare it)
+    hedges_fired = int(sweep_stats.get("hedges_fired") or 0)
+    wasted_s = float(sweep_stats.get("hedge_wasted_s") or 0.0)
+    total_wall = sum(s.get("wall_s", 0.0) for s in per_shard) or dt
+    out["hedges_fired"] = hedges_fired
+    out["hedge_wasted_s"] = round(wasted_s, 4)
+    out["hedge_wasted_fraction"] = round(
+        wasted_s / max(total_wall + wasted_s, 1e-9), 4)
     # predicted-vs-measured per-shard cost error (MAPE + makespan ratios):
     # every bench run appends its own eval row to the telemetry record, so
     # the learned cost model's eval set grows for free

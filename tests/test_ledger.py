@@ -233,9 +233,11 @@ class TestReconciliation:
         live_walls = sorted(r["wall_s"] for r in rows)
         assert len(off_walls) == len(live_walls)
         for ow, lw in zip(off_walls, live_walls):
-            # 5% relative, with a 100us absolute floor: sub-millisecond CPU
-            # launches put the fixed span/retry overhead above 5%
-            assert ow == pytest.approx(lw, rel=0.05, abs=1e-4)
+            # 5% relative, with a 500us absolute floor: the live wall wraps
+            # the dispatch span in fixed per-launch plumbing (retry wrapper,
+            # fault hook, hedge ctl) that millisecond-scale CPU launches put
+            # above 5%; real-device walls are governed by the relative bar
+            assert ow == pytest.approx(lw, rel=0.05, abs=5e-4)
         evs = [e for e in trace.events() if e.get("ph") == "X"
                and e["name"] == "profile.window"]
         assert evs, "window span missing from trace"

@@ -84,6 +84,30 @@ def _print_gbt_telemetry(sweep_ops) -> None:
               "(TMOG_HIST_SUBTRACT=0 disables)")
 
 
+def _print_hedge_telemetry(sweep_ops) -> dict:
+    """Straggler-defense telemetry: hedges fired, discarded loser wall, and
+    the per-device health EWMAs feeding the next partition.  Returns the
+    dict that rides in the run's JSONL record."""
+    from transmogrifai_tpu.resilience import health as _health
+
+    stats = sweep_ops.run_stats()
+    out = {"hedges_fired": int(stats.get("hedges_fired") or 0),
+           "hedge_wasted_s": round(float(stats.get("hedge_wasted_s") or 0.0),
+                                   4)}
+    snap = _health.tracker().snapshot()
+    if snap.get("devices"):
+        out["device_health"] = snap
+    if out["hedges_fired"]:
+        print(f"hedges: {out['hedges_fired']} fired, "
+              f"{out['hedge_wasted_s']:.3f}s loser wall discarded "
+              "(TMOG_HEDGE=0 disables)")
+    for dev, h in (snap.get("devices") or {}).items():
+        if h.get("slowdown", 1.0) > 1.5:
+            print(f"  device {dev}: slowdown~{h['slowdown']:.2f}x "
+                  f"({h.get('observations', 0)} obs)")
+    return out
+
+
 def _load_costmodel():
     """The trained artifact at TMOG_COSTMODEL_PATH, or None (with a note)."""
     from transmogrifai_tpu import costmodel as cm
@@ -314,6 +338,7 @@ if args.data_shards > 0:
         cm_eval = costmodel.eval_launches(sweep_ops.run_stats()["launches"])
         if cm_eval:
             extra["costmodel_eval"] = cm_eval
+        extra["hedge"] = _print_hedge_telemetry(sweep_ops)
     except Exception:
         pass
     obs.write_record("profile_sweep", extra=extra)
@@ -330,6 +355,12 @@ if args.shards > 0:
     if roof:
         extra["roofline"] = roof
         extra["mfu_decomposition"] = roof["mfu_decomposition"]
+    try:
+        from transmogrifai_tpu.ops import sweep as sweep_ops
+
+        extra["hedge"] = _print_hedge_telemetry(sweep_ops)
+    except Exception:
+        pass
     obs.write_record("profile_sweep", extra=extra)
     sys.exit(0)
 
@@ -346,4 +377,6 @@ timed("XGB x2", [(OpXGBoostClassifier(), D.xgboost_grid())])
 
 from transmogrifai_tpu.ops import sweep as sweep_ops  # noqa: E402
 _print_gbt_telemetry(sweep_ops)
-obs.write_record("profile_sweep", extra={"mode": "families"})
+obs.write_record("profile_sweep",
+                 extra={"mode": "families",
+                        "hedge": _print_hedge_telemetry(sweep_ops)})

@@ -193,8 +193,32 @@ def shard_samples(rows) -> List[Dict[str, Any]]:
     Sample shape: ``{"feat": {...}, "wall_s", "compile_s", "steady_s"}``
     where ``steady_s`` is wall minus first-launch compile (floored at
     0.1 ms) — the quantity LPT balance actually cares about.
+
+    Hedged-out straggler attempts (``launch["hedges"]`` entries carrying a
+    ``feat``) are harvested too: a loser's measured wall is a legitimate
+    observation of that sub-spec's cost on a slow device, and the tail
+    behavior is exactly what the model should learn to price.
     """
     out: List[Dict[str, Any]] = []
+
+    def _harvest(s) -> None:
+        if not isinstance(s, dict):
+            return
+        feat = s.get("feat")
+        wall = _finite(s.get("wall_s"))
+        if not isinstance(feat, dict) or wall <= 0:
+            return
+        compile_s = max(_finite(s.get("compile_s")), 0.0)
+        merged = dict(feat)
+        for k, v in ctx.items():
+            merged.setdefault(k, v)
+        out.append({
+            "feat": merged,
+            "wall_s": wall,
+            "compile_s": compile_s,
+            "steady_s": max(wall - compile_s, 1e-4),
+        })
+
     for row in rows:
         if not isinstance(row, dict):
             continue
@@ -209,22 +233,9 @@ def shard_samples(rows) -> List[Dict[str, Any]]:
             if not isinstance(launch, dict):
                 continue
             for s in launch.get("per_shard") or []:
-                if not isinstance(s, dict):
-                    continue
-                feat = s.get("feat")
-                wall = _finite(s.get("wall_s"))
-                if not isinstance(feat, dict) or wall <= 0:
-                    continue
-                compile_s = max(_finite(s.get("compile_s")), 0.0)
-                merged = dict(feat)
-                for k, v in ctx.items():
-                    merged.setdefault(k, v)
-                out.append({
-                    "feat": merged,
-                    "wall_s": wall,
-                    "compile_s": compile_s,
-                    "steady_s": max(wall - compile_s, 1e-4),
-                })
+                _harvest(s)
+            for s in launch.get("hedges") or []:
+                _harvest(s)
     return out
 
 

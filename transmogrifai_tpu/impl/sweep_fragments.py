@@ -112,23 +112,57 @@ class SweepPlan:
         """Partition the spec over ``devices`` (cost-balanced), compile one
         program per device concurrently, dispatch them all asynchronously and
         gather the per-shard [F, C_s, M] metrics into the global candidate
-        order.  Falls back to :meth:`run` on a single device."""
+        order.  Falls back to :meth:`run` on a single device.
+
+        With the straggler layer armed (``TMOG_HEDGE``, default on), device
+        health feeds the partition: chips past ``TMOG_DEVICE_EVICT_RATIO``
+        (or with an open dispatch breaker) are excluded up front — the sweep
+        degrades to N-1 chips with a recorded fallback — and persistently
+        slow survivors get down-weighted LPT loads."""
         from ..ops.sweep import run_sweep_partitioned
         from ..parallel.spec_partition import partition_spec
+        from ..resilience import health as _health
+        from ..resilience import hedge as _hedge
 
         devices = list(devices)
+        weights = None
+        if _hedge.enabled() and len(devices) > 1:
+            try:  # health feedback must never be able to kill a sweep
+                tracker = _health.tracker()
+                kept, evicted = tracker.filter_devices(devices)
+                if evicted:
+                    from ..obs.registry import record_fallback
+                    record_fallback(
+                        "sweep", "device_evicted",
+                        devices=[str(d) for d in evicted],
+                        slowdowns=[round(tracker.slowdown(d), 3)
+                                   for d in evicted])
+                    devices = kept
+                ws = tracker.partition_weights(devices)
+                if any(w != 1.0 for w in ws):
+                    weights = ws
+            except Exception:
+                weights = None
         if len(devices) <= 1:
             return self.run(train_w, val_mask)
         shards = partition_spec(self.spec, self.blob, len(devices),
                                 self.n_rows, self.n_features,
-                                int(train_w.shape[0]))
+                                int(train_w.shape[0]),
+                                device_weights=weights)
         if len(shards) <= 1:
             return self.run(train_w, val_mask)
+        if any(s.slot is not None for s in shards):
+            # weighted partitions carry their slot: keep each shard on the
+            # device it was balanced for even when empty shards dropped out
+            run_devices = [devices[s.slot] if s.slot is not None
+                           else devices[i] for i, s in enumerate(shards)]
+        else:
+            run_devices = devices[:len(shards)]
         return run_sweep_partitioned(
             shards, self.X, self.xbs, self.y,
             np.asarray(train_w, np.float32),
             np.asarray(val_mask, np.float32),
-            len(self.spec[2]), devices[:len(shards)],
+            len(self.spec[2]), run_devices,
             X_host=self.X_host, y_host=self.y_host, xb_bins=self.xb_bins)
 
     def run_rowsharded(self, train_w: np.ndarray, val_mask: np.ndarray,

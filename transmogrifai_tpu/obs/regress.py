@@ -41,6 +41,10 @@ POLICIES: Dict[str, Dict[str, int]] = {
         # roofline ledger (PR 12): fraction of launches whose wall is
         # dominated by dispatch overhead — lower is better
         "launch_bound_fraction": -1,
+        # straggler defense (PR 13): wall discarded by losing hedge
+        # attempts over total sweep wall — redundant dispatch should stay
+        # a tail bound, not a tax
+        "hedge_wasted_fraction": -1,
     },
     "transform_stream_speedup": {
         "value": +1, "transform_rows_per_sec": +1,

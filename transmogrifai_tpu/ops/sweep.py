@@ -76,6 +76,8 @@ from ..obs import registry as obs_registry
 from ..obs import trace
 from ..parallel import mesh as mesh_mod
 from ..resilience import checkpoint as _ckpt
+from ..resilience import hedge as _hedge
+from ..resilience import health as _health
 from ..resilience import inject as _inject
 from ..resilience import retry as _retry
 from ..parallel.mesh import mesh_all_gather, mesh_psum
@@ -565,17 +567,68 @@ def run_sweep(spec, X, xbs: Tuple, y, train_w, val_w, blob):
         if chain:
             trace.instant("gbt.chain", steps=chain["steps"],
                           levels=chain["levels"])
-        _inject.maybe_fail("sweep.dispatch", key="fused")
         _lg = _ledger.get()
+
+        def _dispatch(ctl=None):
+            _inject.maybe_fail("sweep.dispatch", key="fused")
+            if ctl is not None:
+                ctl.mark_dispatch()
+            _t0 = _lg.now()
+            if split:
+                with trace.span("sweep.dispatch", shards=1, split=True):
+                    with mesh_mod.trace_collectives() as colls:
+                        scores = _run_scores(spec, X, tuple(xbs), y, train_w,
+                                             blob)
+                    res = _run_metrics(spec, y, scores, val_w)
+            else:
+                scores = None
+                with trace.span("sweep.dispatch", shards=1, split=False):
+                    with mesh_mod.trace_collectives() as colls:
+                        res = _run(spec, X, tuple(xbs), y, train_w, val_w,
+                                   blob)
+            return res, scores, tuple(colls), _lg.now() - _t0
+
+        hedged = False
+        if _hedge.enabled():
+            # same-slot redundant dispatch: this path's dispatch is async,
+            # so the deadline only fires when the dispatch CALL itself
+            # stalls (an injected delay, a hung transfer) — the duplicate
+            # re-enters the jit cache and whichever returns first wins
+            feat0 = _shard_feat(spec, n, int(X.shape[1]), F)
+            deadline = _hedge.shard_deadline(_feat_units(feat0), feat0)
+
+            def _waste(task, slot, wall, result):
+                _sweep_scope.inc("hedge_wasted_s", wall)
+                entry.setdefault("hedges", []).append(
+                    {"shard": 0, "wall_s": round(wall, 4), "wasted": True})
+                lg = _ledger.get()
+                if lg.enabled:
+                    lg.launch("sweep.run_scores+metrics" if split
+                              else "sweep.run",
+                              wall_s=wall, flops=0.0, bytes=0.0,
+                              families=_launch_families(
+                                  spec, n, int(X.shape[1]), F),
+                              shard=0, wasted=True)
+
+            def _attempt(task, slot, ctl):
+                if ctl.attempt > 0:
+                    with trace.span("sweep.hedge", shard=0,
+                                    attempt=ctl.attempt):
+                        return _dispatch(ctl)
+                return _dispatch(ctl)
+
+            winners, hstats = _hedge.run_hedged(
+                1, 1, _attempt, [deadline], same_slot=True,
+                on_hedge=lambda *a: _sweep_scope.inc("hedges_fired"),
+                on_waste=_waste)
+            (out, scores, colls, _lwall), _slot, att_no, _awall = winners[0]
+            hedged = att_no > 0
+            if hstats["hedges_fired"]:
+                entry["hedges_fired"] = hstats["hedges_fired"]
+        else:
+            out, scores, colls, _lwall = _dispatch()
+        _replay_trace_events(spec, n, colls)
         if split:
-            _lt0 = _lg.now()
-            with trace.span("sweep.dispatch", shards=1, split=True):
-                with mesh_mod.trace_collectives() as colls:
-                    scores = _run_scores(spec, X, tuple(xbs), y, train_w,
-                                         blob)
-                _replay_trace_events(spec, n, colls)
-                out = _run_metrics(spec, y, scores, val_w)
-            _lwall = _lg.now() - _lt0
             with trace.span("sweep.account", fn="sweep.run_scores+metrics"):
                 costs = [
                     flops.record("sweep.run_scores", _run_scores, spec, X,
@@ -584,12 +637,6 @@ def run_sweep(spec, X, xbs: Tuple, y, train_w, val_w, blob):
                                  scores, val_w)]
             kernel = "sweep.run_scores+metrics"
         else:
-            _lt0 = _lg.now()
-            with trace.span("sweep.dispatch", shards=1, split=False):
-                with mesh_mod.trace_collectives() as colls:
-                    out = _run(spec, X, tuple(xbs), y, train_w, val_w, blob)
-                _replay_trace_events(spec, n, colls)
-            _lwall = _lg.now() - _lt0
             with trace.span("sweep.account", fn="sweep.run"):
                 costs = [flops.record("sweep.run", _run, spec, X, tuple(xbs),
                                       y, train_w, val_w, blob)]
@@ -606,7 +653,8 @@ def run_sweep(spec, X, xbs: Tuple, y, train_w, val_w, blob):
                                  for c in costs),
                        families=_launch_families(spec, n, int(X.shape[1]),
                                                  F),
-                       shard=0, split=bool(split))
+                       shard=0, split=bool(split),
+                       **({"hedged": True} if hedged else {}))
         if ck_key is not None:
             with trace.span("sweep.checkpoint", candidates=C):
                 _ck.save("sweep_launch", ck_key,
@@ -627,7 +675,8 @@ def run_sweep(spec, X, xbs: Tuple, y, train_w, val_w, blob):
 #: also what ``obs.snapshot()["sweep"]`` reports.
 _sweep_scope = obs_registry.scope("sweep", defaults={
     "launches": [], "fallbacks": [], "compiles": 0, "compile_s": 0.0,
-    "pruned_candidates": 0, "full_candidates": 0, "checkpoint_skips": 0})
+    "pruned_candidates": 0, "full_candidates": 0, "checkpoint_skips": 0,
+    "hedges_fired": 0, "hedge_wasted_s": 0.0})
 obs_registry.register_provider("sweep", lambda: run_stats())
 
 #: per-(name, spec, device, arg-signature) AOT executables.  jit's own cache
@@ -704,6 +753,10 @@ def run_stats() -> Dict[str, Any]:
             # shards/launches skipped because a TMOG_CHECKPOINT_DIR
             # checkpoint from a previous (possibly killed) run covered them
             "checkpoint_skips": _sweep_scope.get("checkpoint_skips"),
+            # straggler defense: duplicate dispatches fired past their
+            # deadline, and the losers' discarded wall (resilience/hedge)
+            "hedges_fired": _sweep_scope.get("hedges_fired"),
+            "hedge_wasted_s": _sweep_scope.get("hedge_wasted_s"),
             "fallbacks": _sweep_scope.list("fallbacks")}
 
 
@@ -785,6 +838,19 @@ def _shard_feat(spec, n, d, F, data_shards=1, rows_local=None):
                                   rows_local=rows_local)
     except Exception:
         return None
+
+
+def _feat_units(feat) -> float:
+    """Total analytic cost units of one shard's feature dict (the
+    calibration basis ``resilience.health`` prices deadlines in)."""
+    if not feat:
+        return 0.0
+    try:
+        from ..costmodel.features import family_units
+
+        return float(sum(family_units(feat).values()))
+    except Exception:
+        return 0.0
 
 
 #: costmodel family names -> the ledger/report labels the paper uses
@@ -898,7 +964,7 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
         _ckpt.data_fingerprint(y_host if y_host is not None else y),
         _ckpt.data_fingerprint(train_w), _ckpt.data_fingerprint(val_w))
 
-    def worker(shard, dev, idx):
+    def worker(shard, dev, idx, ctl=None):
         t0 = time.perf_counter()
         ck_key = None
         if _ck.enabled:
@@ -907,6 +973,8 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
                 shard.blob, *ck_data)
             hit = _ck.load("sweep_shard", ck_key)
             if hit is not None:
+                # a checkpoint hit completes instantly, so it also
+                # short-circuits any pending hedge for this shard
                 _sweep_scope.inc("checkpoint_skips")
                 stat = {"device": str(dev), "candidates": len(shard.cis),
                         "predicted_cost": float(shard.cost),
@@ -914,6 +982,7 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
                         "checkpoint": "hit",
                         "wall_s": round(time.perf_counter() - t0, 4)}
                 return hit[0]["metrics"], stat, []
+        _deadline = None if ctl is None else ctl.deadline_s
         with trace.span("sweep.shard", device=str(dev), shard=idx,
                         candidates=len(shard.cis)):
             with trace.span("sweep.upload", device=str(dev), shard=idx):
@@ -931,6 +1000,8 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
                 cs, dt_s, ev_s = _aot("sweep.run_scores", _run_scores,
                                       shard.spec, dev, args_s)
                 _lt0 = _lg.now()
+                if ctl is not None:   # deadline clock starts at dispatch
+                    ctl.mark_dispatch()
 
                 def _go_split():
                     _inject.maybe_fail("sweep.dispatch", key=str(dev))
@@ -944,7 +1015,7 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
                         return cm(*args_m), args_m, cm, dt_m, ev_m
 
                 out, args_m, cm, dt_m, ev_m = _retry.with_retry(
-                    "sweep.dispatch", _go_split)
+                    "sweep.dispatch", _go_split, deadline_s=_deadline)
                 compile_s = dt_s + dt_m
                 records = [("sweep.run_scores", cs, args_s, ev_s),
                            ("sweep.run_metrics", cm, args_m, ev_m)]
@@ -953,6 +1024,8 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
                 c, compile_s, ev = _aot("sweep.run", _run, shard.spec, dev,
                                         args)
                 _lt0 = _lg.now()
+                if ctl is not None:   # deadline clock starts at dispatch
+                    ctl.mark_dispatch()
 
                 def _go():
                     _inject.maybe_fail("sweep.dispatch", key=str(dev))
@@ -960,7 +1033,8 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
                                     shard=idx, split=False):
                         return c(*args)
 
-                out = _retry.with_retry("sweep.dispatch", _go)
+                out = _retry.with_retry("sweep.dispatch", _go,
+                                        deadline_s=_deadline)
                 records = [("sweep.run", c, args, ev)]
             # block in THIS thread only: other shards keep dispatching/running
             with trace.span("sweep.gather", device=str(dev),
@@ -991,9 +1065,84 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
         if chain:
             trace.instant("gbt.chain", steps=chain["steps"],
                           levels=chain["levels"])
-        with ThreadPoolExecutor(max_workers=len(shards)) as pool:
-            results = list(pool.map(worker, shards, devices,
-                                    range(len(shards))))
+        hedge_events: List[Dict[str, Any]] = []
+        hedges_fired = 0
+        if not _hedge.enabled():
+            # TMOG_HEDGE=0: the original dispatch, bit-identical
+            with ThreadPoolExecutor(max_workers=len(shards)) as pool:
+                results = list(pool.map(worker, shards, devices,
+                                        range(len(shards))))
+            win_devs = list(devices)
+        else:
+            tr = _health.tracker()
+            deadlines = []
+            for shard in shards:
+                feat = _shard_feat(shard.spec, n, d, F)
+                # health calibration is fed shard.cost units below, so the
+                # analytic prediction must query in the same basis (feat
+                # units ride along for the learned cost model only)
+                deadlines.append(
+                    _hedge.shard_deadline(float(shard.cost), feat))
+
+            def _attempt(task, slot, ctl):
+                shard, dev = shards[task], devices[slot]
+                try:
+                    if ctl.attempt > 0:
+                        with trace.span("sweep.hedge", shard=task,
+                                        device=str(dev),
+                                        attempt=ctl.attempt):
+                            res = worker(shard, dev, task, ctl=ctl)
+                    else:
+                        res = worker(shard, dev, task, ctl=ctl)
+                except Exception as exc:
+                    tr.record_error(str(dev), repr(exc))
+                    raise
+                tr.record_success(str(dev))
+                return res
+
+            def _on_hedge(task, slot, attempt_no, reason):
+                nonlocal hedges_fired
+                hedges_fired += 1
+                _sweep_scope.inc("hedges_fired")
+                hedge_events.append({
+                    "shard": task, "device": str(devices[slot]),
+                    "attempt": attempt_no, "reason": reason})
+
+            def _on_waste(task, slot, wall, result):
+                # runs in the LOSER's thread, possibly after the sweep
+                # returned — the winner's gather never waits for this
+                _sweep_scope.inc("hedge_wasted_s", wall)
+                shard = shards[task]
+                stat_l = result[1] if isinstance(result, tuple) else None
+                ev = {"shard": task, "device": str(devices[slot]),
+                      "wall_s": round(wall, 4), "wasted": True}
+                if isinstance(stat_l, dict):
+                    ev["wall_s"] = stat_l.get("wall_s", ev["wall_s"])
+                    if stat_l.get("feat") is not None:
+                        ev["feat"] = stat_l["feat"]
+                hedge_events.append(ev)
+                tr.record_straggler(str(devices[slot]), float(shard.cost),
+                                    wall)
+                lg = _ledger.get()
+                if lg.enabled:
+                    lg.launch("sweep.run", wall_s=wall, flops=0.0,
+                              bytes=0.0,
+                              families=_launch_families(shard.spec, n, d,
+                                                        F),
+                              shard=task, device=str(devices[slot]),
+                              wasted=True)
+
+            winners, _hstats = _hedge.run_hedged(
+                len(shards), len(devices), _attempt, deadlines,
+                on_hedge=_on_hedge, on_waste=_on_waste,
+                slot_ok=lambda s: tr.usable(devices[s]))
+            results, win_devs = [], []
+            for res, slot, att_no, _w in winners:
+                if att_no > 0 and isinstance(res, tuple):
+                    res[1]["hedged"] = True
+                    res[1]["attempt"] = att_no
+                results.append(res)
+                win_devs.append(devices[slot])
 
         M = results[0][0].shape[-1]
         metrics = np.zeros((F, n_candidates, M), np.float32)
@@ -1001,7 +1150,7 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
         _lg = _ledger.get()
         d = int(X_host.shape[1]) if X_host is not None else int(X.shape[1])
         for sidx, ((out, stat, records), shard, dev) in enumerate(
-                zip(results, shards, devices)):
+                zip(results, shards, win_devs)):
             metrics[:, np.asarray(shard.cis, np.int64), :] = out
             per_shard.append(stat)
             costs = []
@@ -1021,10 +1170,28 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
                            bytes=sum(c.get("bytes_accessed", 0.0)
                                      for c in costs),
                            families=_launch_families(shard.spec, n, d, F),
-                           shard=sidx, device=str(dev))
+                           shard=sidx, device=str(dev),
+                           **({"hedged": True} if stat.get("hedged")
+                              else {}))
+        if _hedge.enabled():
+            # winners' measured walls feed the device-health EWMAs that
+            # weight the NEXT partition (telemetry must never kill a sweep)
+            try:
+                _health.tracker().observe_launch([
+                    (stat["device"], float(shard.cost),
+                     float(stat.get("launch_wall_s")
+                           or max(stat.get("wall_s", 0.0)
+                                  - stat.get("compile_s", 0.0), 0.0)))
+                    for (out, stat, records), shard in zip(results, shards)
+                    if stat.get("checkpoint") != "hit"])
+            except Exception:
+                pass
     entry = {"shards": len(shards), "candidates": int(n_candidates),
              "wall_s": round(time.perf_counter() - t_all, 4),
              "per_shard": per_shard}
+    if hedges_fired:
+        entry["hedges_fired"] = hedges_fired
+        entry["hedges"] = hedge_events
     if chain:
         entry["gbt_chain"] = chain
     _sweep_scope.append("launches", entry)
@@ -1132,6 +1299,7 @@ def run_sweep_rowsharded(shards, X, xbs: Tuple, y, train_w, val_w,
                          f"{grid.shape[1]}")
     F = int(train_w.shape[0])
     n_feat = int(X_host.shape[1]) if X_host is not None else int(X.shape[1])
+    n_rows = int(X_host.shape[0]) if X_host is not None else int(X.shape[0])
     tw_host = np.asarray(train_w, np.float32)
     vw_host = np.asarray(val_w, np.float32)
     t_all = time.perf_counter()
@@ -1144,7 +1312,7 @@ def run_sweep_rowsharded(shards, X, xbs: Tuple, y, train_w, val_w,
         _ckpt.data_fingerprint(y_host if y_host is not None else y),
         _ckpt.data_fingerprint(tw_host), _ckpt.data_fingerprint(vw_host))
 
-    def worker(shard, j):
+    def worker(shard, j, ctl=None):
         t0 = time.perf_counter()
         ck_key = None
         if _ck.enabled:
@@ -1153,6 +1321,7 @@ def run_sweep_rowsharded(shards, X, xbs: Tuple, y, train_w, val_w,
                 shard.blob, *ck_data)
             hit = _ck.load("sweep_shard", ck_key)
             if hit is not None:
+                # instant completion: short-circuits any pending hedge
                 _sweep_scope.inc("checkpoint_skips")
                 stat = {"devices": [str(d) for d in grid[:, j]],
                         "candidates": len(shard.cis),
@@ -1182,13 +1351,17 @@ def run_sweep_rowsharded(shards, X, xbs: Tuple, y, train_w, val_w,
                                                  args)
             _lg = _ledger.get()
             _lt0 = _lg.now()
+            if ctl is not None:   # deadline clock starts at dispatch
+                ctl.mark_dispatch()
 
             def _go():
                 _inject.maybe_fail("sweep.dispatch", key=f"rs{j}")
                 with trace.span("sweep.dispatch", column=j):
                     return compiled(*args)
 
-            out = _retry.with_retry("sweep.dispatch", _go)
+            out = _retry.with_retry(
+                "sweep.dispatch", _go,
+                deadline_s=None if ctl is None else ctl.deadline_s)
             # block in THIS thread only: other columns keep
             # dispatching/running
             with trace.span("sweep.gather", column=j) as _gsp:
@@ -1222,8 +1395,69 @@ def run_sweep_rowsharded(shards, X, xbs: Tuple, y, train_w, val_w,
         if chain:
             trace.instant("gbt.chain", steps=chain["steps"],
                           levels=chain["levels"])
-        with ThreadPoolExecutor(max_workers=len(shards)) as pool:
-            results = list(pool.map(worker, shards, range(len(shards))))
+        hedge_events: List[Dict[str, Any]] = []
+        hedges_fired = 0
+        if not _hedge.enabled():
+            # TMOG_HEDGE=0: the original dispatch, bit-identical
+            with ThreadPoolExecutor(max_workers=len(shards)) as pool:
+                results = list(pool.map(worker, shards, range(len(shards))))
+        else:
+            # a column's program only runs on its own submesh, so hedges
+            # are SAME-SLOT redundant dispatches (the duplicate re-enters
+            # the AOT cache; first completion wins)
+            deadlines = []
+            for shard in shards:
+                feat = _shard_feat(shard.spec, n_rows, n_feat, F,
+                                   data_shards=int(n_data))
+                # same unit basis as the health calibration (shard.cost)
+                deadlines.append(
+                    _hedge.shard_deadline(float(shard.cost), feat))
+
+            def _attempt(task, slot, ctl):
+                if ctl.attempt > 0:
+                    with trace.span("sweep.hedge", column=task,
+                                    attempt=ctl.attempt):
+                        return worker(shards[task], task, ctl=ctl)
+                return worker(shards[task], task, ctl=ctl)
+
+            def _on_hedge(task, slot, attempt_no, reason):
+                nonlocal hedges_fired
+                hedges_fired += 1
+                _sweep_scope.inc("hedges_fired")
+                hedge_events.append({"shard": task, "attempt": attempt_no,
+                                     "reason": reason})
+
+            def _on_waste(task, slot, wall, result):
+                _sweep_scope.inc("hedge_wasted_s", wall)
+                stat_l = result[1] if isinstance(result, tuple) else None
+                ev = {"shard": task, "wall_s": round(wall, 4),
+                      "wasted": True}
+                if isinstance(stat_l, dict):
+                    ev["wall_s"] = stat_l.get("wall_s", ev["wall_s"])
+                    if stat_l.get("feat") is not None:
+                        ev["feat"] = stat_l["feat"]
+                hedge_events.append(ev)
+                lg = _ledger.get()
+                if lg.enabled:
+                    lg.launch("sweep.run_rs", wall_s=wall, flops=0.0,
+                              bytes=0.0,
+                              families=_launch_families(
+                                  shards[task].spec, n_rows, n_feat,
+                                  F),
+                              shard=task,
+                              device=",".join(str(dd)
+                                              for dd in grid[:, task]),
+                              wasted=True)
+
+            winners, _hstats = _hedge.run_hedged(
+                len(shards), len(shards), _attempt, deadlines,
+                same_slot=True, on_hedge=_on_hedge, on_waste=_on_waste)
+            results = []
+            for res, _slot, att_no, _w in winners:
+                if att_no > 0 and isinstance(res, tuple):
+                    res[1]["hedged"] = True
+                    res[1]["attempt"] = att_no
+                results.append(res)
 
     M = results[0][0].shape[-1]
     metrics = np.zeros((F, n_candidates, M), np.float32)
@@ -1270,6 +1504,9 @@ def run_sweep_rowsharded(shards, X, xbs: Tuple, y, train_w, val_w,
                  "y": n_pad // n_data * 4,
                  "X_replicated": n_orig * d * 4,
                  "y_replicated": n_orig * 4}}
+    if hedges_fired:
+        entry["hedges_fired"] = hedges_fired
+        entry["hedges"] = hedge_events
     if chain:
         entry["gbt_chain"] = chain
     _sweep_scope.append("launches", entry)

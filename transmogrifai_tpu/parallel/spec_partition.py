@@ -55,6 +55,9 @@ class ShardSpec:
     blob: np.ndarray            #: per-shard re-packed f32 hyperparameter blob
     cis: Tuple[int, ...]        #: global candidate index of each local candidate
     cost: float                 #: predicted cost (cost-model units)
+    #: device slot this shard was balanced FOR (weighted partitions only);
+    #: None = positional (shard i -> devices[i]), the unweighted contract
+    slot: Optional[int] = None
 
     @property
     def n_candidates(self) -> int:
@@ -126,7 +129,9 @@ def _apply_cost_provider(units, provider: Callable, source: str) -> None:
 
 
 def partition_spec(spec, blob: np.ndarray, n_shards: int, n_rows: int,
-                   n_features: int, n_folds: int) -> List[ShardSpec]:
+                   n_features: int, n_folds: int,
+                   device_weights: Optional[List[float]] = None
+                   ) -> List[ShardSpec]:
     """Split ``spec`` into <= ``n_shards`` cost-balanced sub-specs.
 
     Every global candidate lands in exactly one shard; shard-local candidate
@@ -139,12 +144,29 @@ def partition_spec(spec, blob: np.ndarray, n_shards: int, n_rows: int,
     learned model) — with no provider the analytic floats are never
     touched, so the default partition is bit-identical to the pre-costmodel
     behavior.
+
+    ``device_weights`` (one slowdown multiplier per shard slot, from
+    ``resilience.health.partition_weights``) makes LPT balance *effective*
+    walls: an atom lands on the slot minimizing ``(load + cost) * weight``,
+    so a 2x-slow chip gets ~half the work.  ``None`` — or all weights 1.0 —
+    runs the exact unweighted heap path, byte-identical to before; weighted
+    shards carry their slot in ``ShardSpec.slot`` so the launcher maps each
+    shard back to the device it was balanced for even when empty shards
+    drop out.
     """
     from ..impl.sweep_fragments import build_subspec, spec_units
 
+    weights: Optional[List[float]] = None
+    if device_weights is not None:
+        ws = [float(w) for w in device_weights[:n_shards]]
+        ws += [1.0] * (n_shards - len(ws))
+        if any(w != 1.0 for w in ws):
+            weights = [max(w, 1e-6) for w in ws]
+
     provider, source = _resolve_cost_provider()
     with trace.span("sweep.partition", shards=int(n_shards),
-                    rows=int(n_rows), costmodel=source or "") as sp:
+                    rows=int(n_rows), costmodel=source or "",
+                    weighted=weights is not None) as sp:
         units = spec_units(spec, n_rows, n_features, n_folds)
         if provider is not None:
             _apply_cost_provider(units, provider, source)
@@ -157,17 +179,27 @@ def partition_spec(spec, blob: np.ndarray, n_shards: int, n_rows: int,
         atoms = [(u.per_cand, u, p) for u in units
                  for p in range(len(u.cis))]
         atoms.sort(key=lambda a: -a[0])
-        # heap of (load, shard_index); picks[shard][unit.key] -> positions
-        heap = [(0.0, s) for s in range(n_shards)]
-        heapq.heapify(heap)
+        # picks[shard][unit.key] -> positions
         picks: List[Dict[Tuple[int, Optional[int]], List[int]]] = [
             {} for _ in range(n_shards)]
         loads = [0.0] * n_shards
-        for cost, unit, pos in atoms:
-            load, s = heapq.heappop(heap)
-            picks[s].setdefault(unit.key, []).append(pos)
-            loads[s] = load + cost
-            heapq.heappush(heap, (loads[s], s))
+        if weights is None:
+            # heap of (load, shard_index) — the exact historical path
+            heap = [(0.0, s) for s in range(n_shards)]
+            heapq.heapify(heap)
+            for cost, unit, pos in atoms:
+                load, s = heapq.heappop(heap)
+                picks[s].setdefault(unit.key, []).append(pos)
+                loads[s] = load + cost
+                heapq.heappush(heap, (loads[s], s))
+        else:
+            # weighted LPT: argmin effective wall after placement; linear
+            # scan (n_shards is the chip count, single digits)
+            for cost, unit, pos in atoms:
+                s = min(range(n_shards),
+                        key=lambda i: ((loads[i] + cost) * weights[i], i))
+                picks[s].setdefault(unit.key, []).append(pos)
+                loads[s] += cost
 
         shards: List[ShardSpec] = []
         for s in range(n_shards):
@@ -175,6 +207,8 @@ def partition_spec(spec, blob: np.ndarray, n_shards: int, n_rows: int,
                 continue
             sub_spec, sub_blob, cis = build_subspec(spec, blob, picks[s],
                                                     n_folds)
-            shards.append(ShardSpec(sub_spec, sub_blob, cis, loads[s]))
+            shards.append(ShardSpec(
+                sub_spec, sub_blob, cis, loads[s],
+                slot=s if weights is not None else None))
         sp.set(candidates=sum(len(s.cis) for s in shards))
     return shards

@@ -41,6 +41,8 @@ scope = _obs_registry.scope("resilience", defaults=dict(
     circuit_closes=0,
     replica_recoveries=0,
     supervisor_beats=0,
+    hedges_fired=0,
+    device_evictions=0,
     faults=[],
 ))
 
@@ -51,6 +53,11 @@ from .circuit import CLOSED, HALF_OPEN, OPEN, CircuitBreaker  # noqa: E402
 from .inject import (InjectedFault, InjectedFatal, active, add_rule,  # noqa: E402
                      clear_rules, configure, maybe_fail)
 from .retry import RetryPolicy, is_transient, with_retry  # noqa: E402
+from .health import HealthTracker  # noqa: E402
+from .health import reset as reset_health  # noqa: E402
+from .health import tracker as health_tracker  # noqa: E402
+from .hedge import AttemptCtl, run_hedged, shard_deadline  # noqa: E402
+from .hedge import enabled as hedge_enabled  # noqa: E402,F401
 
 __all__ = [
     "scope",
@@ -60,4 +67,6 @@ __all__ = [
     "CircuitBreaker", "CLOSED", "OPEN", "HALF_OPEN",
     "CheckpointStore", "store", "checkpoint_dir", "content_key",
     "data_fingerprint", "checkpointed_gbt_fit",
+    "HealthTracker", "health_tracker", "reset_health",
+    "AttemptCtl", "run_hedged", "shard_deadline",
 ]

@@ -1,0 +1,207 @@
+"""Per-device health scoring for the multi-chip sweep.
+
+Every partitioned launch yields one measured wall per shard plus the
+analytic ``spec_units`` cost the partitioner balanced on.  The tracker
+turns those into two EWMAs:
+
+- a *global* seconds-per-unit rate (``spu``), the live calibration of the
+  analytic cost model on this host — the same steady-state scale the
+  costmodel's ``eval_launches`` computes offline; and
+- a *per-device* slowdown ratio — measured wall over the wall the global
+  rate predicts for that shard.  A healthy chip hovers at 1.0; a sick chip
+  (thermal throttling, a noisy neighbour, a flaky link) drifts upward.
+
+Slowdown feeds back into LPT partitioning as a device weight (a 2x-slow
+chip gets half the work) and, past ``TMOG_DEVICE_EVICT_RATIO``, the device
+is excluded outright with a recorded fallback — the sweep degrades to N-1
+chips instead of running at the sick chip's speed.  Dispatch errors route
+through the existing :class:`CircuitBreaker` state machine, so a device
+that keeps *failing* (not just slowing) is evicted by the breaker and
+re-admitted through its half-open trial after the cooldown.
+
+The tracker is deliberately process-global (like the obs registry): health
+is a property of the host's chips, not of one sweep call.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..obs import registry as obs_registry
+from ..utils import env as _env
+from .circuit import CircuitBreaker
+
+__all__ = ["HealthTracker", "tracker", "reset", "evict_ratio"]
+
+_scope = obs_registry.scope("resilience")
+
+#: slowdown below which a device is treated as healthy (weight 1.0) when
+#: weighting the partitioner.  Measured walls on identical chips jitter a
+#: few percent run to run; without a deadband that noise would flip every
+#: launch into a slightly-different weighted split and churn the AOT cache.
+WEIGHT_DEADBAND = 1.25
+
+
+def evict_ratio() -> float:
+    """Slowdown past which a device is excluded from partitioning."""
+    return max(1.0, _env.env_float("TMOG_DEVICE_EVICT_RATIO", 4.0))
+
+
+class HealthTracker:
+    """EWMA device health from measured-vs-predicted shard walls."""
+
+    def __init__(self, alpha: float = 0.3):
+        self.alpha = float(alpha)
+        self._lock = threading.Lock()
+        self._spu: Optional[float] = None       # global seconds per cost unit
+        self._ratio: Dict[str, float] = {}      # device -> slowdown EWMA
+        self._seen: Dict[str, int] = {}         # device -> observation count
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._evictions = 0
+
+    # -- observation ----------------------------------------------------
+
+    def observe_launch(
+            self, entries: Iterable[Tuple[str, float, float]]) -> None:
+        """Feed one partitioned launch: ``(device, cost_units, steady_s)``
+        per shard.  The per-launch scale normalizes out global speed so a
+        uniformly slow host doesn't read as N sick chips."""
+        rows = [(str(d), float(c), float(w)) for d, c, w in entries
+                if c > 0.0 and w > 0.0]
+        if not rows:
+            return
+        total_c = sum(c for _, c, _ in rows)
+        total_w = sum(w for _, _, w in rows)
+        scale = total_w / total_c
+        if scale <= 0.0:
+            return
+        with self._lock:
+            self._spu = (scale if self._spu is None
+                         else (1 - self.alpha) * self._spu + self.alpha * scale)
+            for dev, c, w in rows:
+                ratio = w / (c * scale)
+                prev = self._ratio.get(dev)
+                self._ratio[dev] = (ratio if prev is None
+                                    else (1 - self.alpha) * prev
+                                    + self.alpha * ratio)
+                self._seen[dev] = self._seen.get(dev, 0) + 1
+
+    def record_straggler(self, device: str, cost_units: float,
+                         wall_s: float) -> None:
+        """A hedged-out attempt: rate the straggler's wall against the
+        current global rate (its launch entry never lands, so this is the
+        only evidence the slow chip leaves behind)."""
+        dev = str(device)
+        with self._lock:
+            if self._spu is None or cost_units <= 0.0 or wall_s <= 0.0:
+                return
+            predicted = cost_units * self._spu
+            if predicted <= 0.0:
+                return
+            ratio = wall_s / predicted
+            prev = self._ratio.get(dev)
+            self._ratio[dev] = (ratio if prev is None
+                                else (1 - self.alpha) * prev
+                                + self.alpha * ratio)
+            self._seen[dev] = self._seen.get(dev, 0) + 1
+
+    def record_error(self, device: str, error: str = "") -> None:
+        self._breaker(device).record_failure(error)
+
+    def record_success(self, device: str) -> None:
+        self._breaker(device).record_success()
+
+    def _breaker(self, device: str) -> CircuitBreaker:
+        dev = str(device)
+        with self._lock:
+            br = self._breakers.get(dev)
+            if br is None:
+                br = CircuitBreaker(name=f"device:{dev}")
+                self._breakers[dev] = br
+            return br
+
+    # -- queries --------------------------------------------------------
+
+    def slowdown(self, device) -> float:
+        with self._lock:
+            return self._ratio.get(str(device), 1.0)
+
+    def predict_wall(self, cost_units: float) -> Optional[float]:
+        """Analytic wall prediction from the live seconds-per-unit EWMA."""
+        with self._lock:
+            if self._spu is None or cost_units <= 0.0:
+                return None
+            return cost_units * self._spu
+
+    def usable(self, device) -> bool:
+        """False when the device is evicted: breaker open (and not due a
+        half-open trial) or slowdown past the evict ratio."""
+        dev = str(device)
+        with self._lock:
+            br = self._breakers.get(dev)
+            ratio = self._ratio.get(dev, 1.0)
+        if br is not None and not br.available:
+            # a cooled-down breaker admits one trial: the device rejoins
+            # the pool for this launch and its outcome decides its fate
+            if not br.try_trial():
+                return False
+        return ratio <= evict_ratio()
+
+    def filter_devices(self, devices: Sequence) -> Tuple[List, List]:
+        """Split ``devices`` into (kept, evicted).  Never evicts all:
+        with zero healthy devices the full list is kept (a wrong health
+        signal must not be able to kill the sweep)."""
+        kept, evicted = [], []
+        for d in devices:
+            # usable() may admit a breaker trial — call exactly once
+            (kept if self.usable(d) else evicted).append(d)
+        if not kept:
+            return list(devices), []
+        if evicted:
+            with self._lock:
+                self._evictions += len(evicted)
+            _scope.inc("device_evictions", len(evicted))
+        return kept, evicted
+
+    def partition_weights(self, devices: Sequence) -> List[float]:
+        """Per-device LPT load multipliers: the slowdown EWMA, but only
+        past :data:`WEIGHT_DEADBAND` — healthy-chip jitter stays on the
+        byte-identical unweighted path."""
+        out = []
+        for d in devices:
+            r = self.slowdown(d)
+            out.append(r if r >= WEIGHT_DEADBAND else 1.0)
+        return out
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {
+                "seconds_per_unit": self._spu,
+                "devices": {
+                    dev: {
+                        "slowdown": round(r, 4),
+                        "observations": self._seen.get(dev, 0),
+                    }
+                    for dev, r in sorted(self._ratio.items())
+                },
+                "evictions": self._evictions,
+            }
+            for dev, br in sorted(self._breakers.items()):
+                out["devices"].setdefault(dev, {})["breaker"] = br.snapshot()
+        return out
+
+
+_tracker = HealthTracker()
+_tracker_lock = threading.Lock()
+
+
+def tracker() -> HealthTracker:
+    return _tracker
+
+
+def reset() -> HealthTracker:
+    """Fresh tracker (tests); returns the new instance."""
+    global _tracker
+    with _tracker_lock:
+        _tracker = HealthTracker()
+    return _tracker

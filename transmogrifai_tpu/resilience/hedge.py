@@ -1,0 +1,253 @@
+"""Deadline-driven hedged dispatch: the tail-at-scale defense.
+
+Every dispatched sweep shard gets a deadline::
+
+    deadline = max(TMOG_HEDGE_FLOOR_S, TMOG_HEDGE_FACTOR x predicted_wall)
+
+where the prediction comes from the learned cost model when
+``TMOG_COSTMODEL=1`` and otherwise from the live seconds-per-unit
+calibration in :mod:`resilience.health`.  The deadline clock starts at
+*dispatch* (after compile/upload, via ``AttemptCtl.mark_dispatch``), so a
+cold AOT compile never reads as a straggler.  A shard that blows its
+deadline is hedged — re-dispatched to the first idle device (or the same
+slot, for single-device paths), first completion wins, and the loser's
+result is discarded without ever being merged.  An attempt that *errors*
+out (after its retry budget, itself clamped to the hedge deadline) also
+triggers a hedge, so a dead chip degrades to N-1 instead of failing the
+sweep.
+
+``TMOG_HEDGE=0`` disarms the whole layer; the sweep paths then run their
+original non-hedged dispatch, bit-identical to a build without this
+module.  With no calibration yet (fresh process, cold tracker) no
+deadline is armed at all — an absolute floor can't know how slow a loaded
+host legitimately is, so the first launch calibrates and deadline hedging
+engages from the second.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ..obs import registry as obs_registry
+from ..utils import env as _env
+from . import health as _health
+
+__all__ = ["enabled", "hedge_factor", "hedge_floor_s", "shard_deadline",
+           "AttemptCtl", "run_hedged"]
+
+_scope = obs_registry.scope("resilience")
+
+_POLL_S = 0.2   # wake cadence while no armed deadline is ticking
+
+
+def enabled() -> bool:
+    return _env.env_flag("TMOG_HEDGE", True)
+
+
+def hedge_factor() -> float:
+    return max(1.0, _env.env_float("TMOG_HEDGE_FACTOR", 3.0))
+
+
+def hedge_floor_s() -> float:
+    return max(0.0, _env.env_float("TMOG_HEDGE_FLOOR_S", 10.0))
+
+
+def shard_deadline(cost_units: float, feat: Optional[dict] = None
+                   ) -> Optional[float]:
+    """Deadline seconds for one shard, or None when hedging is off or no
+    prediction exists yet.
+
+    A deadline without a prediction would be a guess about an unknown
+    machine — on a loaded CI host healthy shards blow any absolute number
+    — so an uncalibrated tracker arms NO deadline: the first launch
+    calibrates, deadline hedging engages from the second.  (Failure-
+    triggered hedges need no deadline and always work.)  The floor only
+    clamps predicted deadlines from below, so jitter on millisecond-scale
+    shards cannot trigger redundant dispatch."""
+    if not enabled():
+        return None
+    predicted: Optional[float] = None
+    if feat is not None:
+        from .. import costmodel as _costmodel   # lazy: avoid import cycle
+        if _costmodel.enabled():
+            model = _costmodel.active_model()
+            if model is not None:
+                try:
+                    predicted = float(model.predict(feat)["wall_s"])
+                except Exception:
+                    predicted = None
+    if predicted is None or predicted <= 0.0:
+        predicted = _health.tracker().predict_wall(cost_units)
+    if predicted is None or predicted <= 0.0:
+        return None
+    return max(hedge_floor_s(), hedge_factor() * predicted)
+
+
+class AttemptCtl:
+    """Handed to each attempt so it can start the deadline clock at true
+    dispatch time and clamp its retry budget to the hedge deadline."""
+
+    __slots__ = ("task", "slot", "attempt", "deadline_s", "dispatch_t0",
+                 "_cond")
+
+    def __init__(self, task: int, slot: int, attempt: int,
+                 deadline_s: Optional[float], cond: threading.Condition):
+        self.task = task
+        self.slot = slot
+        self.attempt = attempt
+        self.deadline_s = deadline_s
+        self.dispatch_t0: Optional[float] = None
+        self._cond = cond
+
+    def mark_dispatch(self) -> None:
+        with self._cond:
+            if self.dispatch_t0 is None:
+                self.dispatch_t0 = time.monotonic()
+            self._cond.notify_all()
+
+
+def run_hedged(
+        n_tasks: int,
+        n_slots: int,
+        attempt_fn: Callable[[int, int, AttemptCtl], object],
+        deadlines: Sequence[Optional[float]],
+        same_slot: bool = False,
+        max_hedges: int = 1,
+        on_hedge: Optional[Callable[[int, int, int, str], None]] = None,
+        on_waste: Optional[Callable[[int, int, float, object], None]] = None,
+        slot_ok: Optional[Callable[[int], bool]] = None,
+) -> Tuple[List[Tuple[object, int, int, float]], dict]:
+    """First-completion-wins hedged execution of ``n_tasks`` attempts.
+
+    ``attempt_fn(task, slot, ctl)`` runs each attempt (primary task *i* on
+    slot *i*); it should call ``ctl.mark_dispatch()`` right before its
+    dispatch so compile time doesn't count against the deadline.  When an
+    attempt outlives ``deadlines[task]`` (or errors out) and the task has
+    hedges left, a duplicate is launched on the first idle slot — the
+    task's own slot when ``same_slot`` — and whichever attempt completes
+    first becomes the task's single winner.  Losers are never returned;
+    their walls are reported through ``on_waste(task, slot, wall, result)``
+    from the loser's own thread, possibly *after* this function returns
+    (waiting for losers would re-introduce the tail being cut).
+
+    Returns ``(winners, stats)`` with ``winners[task] = (result, slot,
+    attempt_no, wall_s)`` and ``stats = {"hedges_fired": int}``.  If every
+    attempt of some task fails, the first error is re-raised.
+    """
+    cond = threading.Condition()
+    winners: List[Optional[Tuple[object, int, int, float]]] = [None] * n_tasks
+    errors: List[List[BaseException]] = [[] for _ in range(n_tasks)]
+    inflight = [0] * n_tasks
+    hedges_used = [0] * n_tasks
+    slot_busy = [False] * n_slots
+    attempt_ctls: List[List[AttemptCtl]] = [[] for _ in range(n_tasks)]
+    hedges_fired = 0
+
+    def _run(task: int, slot: int, attempt_no: int) -> None:
+        ctl = AttemptCtl(task, slot, attempt_no, deadlines[task], cond)
+        with cond:
+            attempt_ctls[task].append(ctl)
+        t_start = time.monotonic()
+        err: Optional[BaseException] = None
+        out = None
+        try:
+            out = attempt_fn(task, slot, ctl)
+        except BaseException as exc:   # noqa: BLE001 - forwarded to caller
+            err = exc
+        wall = time.monotonic() - t_start
+        won = False
+        with cond:
+            if not same_slot:
+                slot_busy[slot] = False
+            inflight[task] -= 1
+            try:
+                attempt_ctls[task].remove(ctl)
+            except ValueError:
+                pass
+            if err is None and winners[task] is None:
+                winners[task] = (out, slot, attempt_no, wall)
+                won = True
+            elif err is not None:
+                errors[task].append(err)
+            cond.notify_all()
+        if err is None and not won and on_waste is not None:
+            try:
+                on_waste(task, slot, wall, out)
+            except Exception:
+                pass
+
+    def _launch(task: int, slot: int, attempt_no: int) -> None:
+        # caller holds cond
+        inflight[task] += 1
+        if not same_slot:
+            slot_busy[slot] = True
+        th = threading.Thread(target=_run, args=(task, slot, attempt_no),
+                              name=f"hedge-t{task}a{attempt_no}", daemon=True)
+        th.start()
+
+    def _idle_slot(task: int) -> Optional[int]:
+        # caller holds cond
+        if same_slot:
+            return task % n_slots
+        for s in range(n_slots):
+            if slot_busy[s]:
+                continue
+            if slot_ok is not None and not slot_ok(s):
+                continue
+            return s
+        return None
+
+    with cond:
+        for i in range(n_tasks):
+            _launch(i, i % n_slots, 0)
+
+        while True:
+            open_tasks = [i for i in range(n_tasks) if winners[i] is None]
+            if not open_tasks:
+                break
+            failed = [i for i in open_tasks
+                      if inflight[i] == 0 and hedges_used[i] >= max_hedges]
+            if failed:
+                raise errors[failed[0]][0]
+
+            now = time.monotonic()
+            wake: Optional[float] = None
+            for i in open_tasks:
+                if hedges_used[i] >= max_hedges:
+                    continue
+                trigger: Optional[float] = None
+                if inflight[i] == 0:
+                    trigger = now   # attempt died: hedge immediately
+                else:
+                    for ctl in attempt_ctls[i]:
+                        if ctl.dispatch_t0 is None or ctl.deadline_s is None:
+                            continue
+                        t = ctl.dispatch_t0 + ctl.deadline_s
+                        if trigger is None or t < trigger:
+                            trigger = t
+                if trigger is None:
+                    continue
+                if trigger <= now:
+                    slot = _idle_slot(i)
+                    if slot is None:
+                        continue   # no idle device yet: re-check on wake
+                    hedges_used[i] += 1
+                    hedges_fired += 1
+                    reason = "error" if inflight[i] == 0 else "deadline"
+                    attempt_no = hedges_used[i]
+                    _launch(i, slot, attempt_no)
+                    if on_hedge is not None:
+                        try:
+                            on_hedge(i, slot, attempt_no, reason)
+                        except Exception:
+                            pass
+                elif wake is None or trigger < wake:
+                    wake = trigger
+            timeout = _POLL_S if wake is None else max(0.01, wake - now)
+            cond.wait(timeout)
+
+    stats = {"hedges_fired": hedges_fired}
+    if hedges_fired:
+        _scope.inc("hedges_fired", hedges_fired)
+    return [w for w in winners if w is not None], stats

@@ -3,6 +3,7 @@
 ``TMOG_FAULTS`` arms a comma-separated list of rules::
 
     site[#key]:kind[:prob[:seed[:after[:fires]]]]
+    site[#key]:delay:seconds[:prob[:seed[:after[:fires]]]]
 
 - ``site`` — a named hook site (``sweep.compile``, ``sweep.dispatch``,
   ``stream.upload``, ``stream.pull``, ``serve.score``, ``serve.warm``,
@@ -11,8 +12,13 @@
   (e.g. ``serve.score#1`` fails only replica slot 1).
 - ``kind`` — ``error`` (raises :class:`InjectedFault`, classified
   transient, so the retry wrapper absorbs it), ``fatal`` (raises
-  :class:`InjectedFatal`, never retried), or ``kill`` (``SIGKILL`` to the
-  current process — a deterministic preemption).
+  :class:`InjectedFatal`, never retried), ``kill`` (``SIGKILL`` to the
+  current process — a deterministic preemption), or ``delay`` (sleeps
+  ``seconds`` at the hook site and then lets the call proceed — a
+  deterministic STRAGGLER, the substrate of the hedged-dispatch chaos
+  tests).  ``delay`` takes one extra leading field, the sleep seconds;
+  ``prob``/``seed``/``after``/``fires`` shift right by one and keep their
+  meaning.
 - ``prob`` — firing probability per eligible invocation (default 1).
 - ``seed`` — seeds the rule's private ``random.Random`` so a chaos run is
   reproducible under a fixed ``TMOG_FAULTS`` string (default 0).
@@ -33,6 +39,7 @@ import os
 import random
 import signal
 import threading
+import time
 from typing import List, Optional
 
 from ..obs import registry as obs_registry
@@ -56,15 +63,16 @@ class InjectedFatal(RuntimeError):
     transient = False
 
 
-_KINDS = ("error", "fatal", "kill")
+_KINDS = ("error", "fatal", "kill", "delay")
 
 
 class _Rule:
     __slots__ = ("site", "key", "kind", "prob", "seed", "after", "fires",
-                 "rng", "count", "fired")
+                 "seconds", "rng", "count", "fired")
 
     def __init__(self, site: str, key: Optional[str], kind: str,
-                 prob: float, seed: int, after: int, fires: int = 0):
+                 prob: float, seed: int, after: int, fires: int = 0,
+                 seconds: float = 0.0):
         self.site = site
         self.key = key
         self.kind = kind
@@ -72,6 +80,7 @@ class _Rule:
         self.seed = seed
         self.after = after
         self.fires = fires   # max injections (0 = unlimited)
+        self.seconds = seconds   # sleep length for kind="delay"
         self.rng = random.Random(seed)
         self.count = 0   # eligible invocations seen
         self.fired = 0   # faults actually injected
@@ -107,11 +116,23 @@ def parse_rules(spec: str) -> List[_Rule]:
         if kind not in _KINDS:
             raise ValueError(f"bad TMOG_FAULTS kind {kind!r} in {part!r}: "
                              f"want one of {_KINDS}")
+        seconds = 0.0
+        if kind == "delay":
+            # delay takes an extra leading field (sleep seconds); the
+            # prob/seed/after/fires tail shifts right by one.
+            if len(fields) < 3 or not fields[2].strip():
+                raise ValueError(f"bad TMOG_FAULTS rule {part!r}: delay "
+                                 "wants site[#key]:delay:seconds[:prob[...]]")
+            seconds = float(fields[2])
+            if seconds <= 0.0:
+                raise ValueError(f"bad TMOG_FAULTS rule {part!r}: delay "
+                                 f"seconds must be positive, got {seconds}")
+            fields = fields[:2] + fields[3:]
         prob = float(fields[2]) if len(fields) > 2 and fields[2].strip() else 1.0
         seed = int(fields[3]) if len(fields) > 3 and fields[3].strip() else 0
         after = int(fields[4]) if len(fields) > 4 and fields[4].strip() else 0
         fires = int(fields[5]) if len(fields) > 5 and fields[5].strip() else 0
-        rules.append(_Rule(site, key, kind, prob, seed, after, fires))
+        rules.append(_Rule(site, key, kind, prob, seed, after, fires, seconds))
     return rules
 
 
@@ -166,10 +187,16 @@ def maybe_fail(site: str, key=None) -> None:
         if not hit:
             continue
         _scope.inc("faults_injected")
-        _scope.append("faults", {
+        record = {
             "event": "injected", "site": site, "key": skey,
             "kind": r.kind, "hit": r.fired, "invocation": r.count,
-        })
+        }
+        if r.kind == "delay":
+            record["seconds"] = r.seconds
+        _scope.append("faults", record)
+        if r.kind == "delay":
+            time.sleep(r.seconds)
+            continue   # a straggler proceeds after the stall
         if r.kind == "kill":
             os.kill(os.getpid(), signal.SIGKILL)
         cls = InjectedFault if r.kind == "error" else InjectedFatal

@@ -60,11 +60,20 @@ class RetryPolicy:
 
 
 def with_retry(site: str, fn: Callable, *args,
-               policy: Optional[RetryPolicy] = None, **kwargs):
+               policy: Optional[RetryPolicy] = None,
+               deadline_s: Optional[float] = None, **kwargs):
     """Call ``fn(*args, **kwargs)``; retry transient failures with
     exponential backoff + jitter until the attempt budget or wall deadline
-    runs out.  Fatal exceptions propagate immediately."""
+    runs out.  Fatal exceptions propagate immediately.
+
+    ``deadline_s`` clamps the policy deadline for this one call — a hedged
+    shard passes its remaining hedge deadline here so a retrying loser
+    cannot outlive the winner's gather.
+    """
     pol = policy or RetryPolicy()
+    deadline = pol.deadline_s
+    if deadline_s is not None:
+        deadline = min(deadline, max(0.0, deadline_s))
     t0 = time.monotonic()
     attempt = 0
     while True:
@@ -76,7 +85,7 @@ def with_retry(site: str, fn: Callable, *args,
         except Exception as exc:
             transient = is_transient(exc)
             exhausted = attempt >= pol.attempts
-            overdue = (time.monotonic() - t0) >= pol.deadline_s
+            overdue = (time.monotonic() - t0) >= deadline
             if not transient or exhausted or overdue:
                 if transient:
                     _scope.inc("gave_up")
@@ -90,7 +99,7 @@ def with_retry(site: str, fn: Callable, *args,
                 "error": repr(exc)})
             delay = min(pol.max_s, pol.base_s * (2.0 ** (attempt - 1)))
             delay *= 0.5 + _jitter.random()  # jitter in [0.5, 1.5)x
-            remaining = pol.deadline_s - (time.monotonic() - t0)
+            remaining = deadline - (time.monotonic() - t0)
             if delay > 0:
                 time.sleep(max(0.0, min(delay, remaining)))
             continue
