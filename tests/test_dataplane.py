@@ -1,0 +1,541 @@
+"""Data-plane hardening (serve/contract.py, resilience/quarantine.py,
+poison injection): input contracts derived from trained models, per-row
+DataFault rejection with clean-row bit parity, batch bisection under
+disabled validation, the TMOG_QUARANTINE row policy on the stream and
+reader paths, the quarantine-rate drift pseudo-feature, and the
+data-vs-system fault classification in retry/hedge.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu import Dataset, FeatureBuilder, OpWorkflow
+from transmogrifai_tpu.columns import NumericColumn
+from transmogrifai_tpu.continual.controller import (ControllerConfig,
+                                                    RetrainController)
+from transmogrifai_tpu.continual.drift import QUARANTINE_KEY, ServeSketch
+from transmogrifai_tpu.impl.classification.logistic import OpLogisticRegression
+from transmogrifai_tpu.impl.feature.vectorizers import (OneHotVectorizer,
+                                                        RealVectorizer,
+                                                        VectorsCombiner)
+from transmogrifai_tpu.obs import registry as obs_registry
+from transmogrifai_tpu.resilience import inject, quarantine
+from transmogrifai_tpu.resilience.hedge import run_hedged
+from transmogrifai_tpu.resilience.quarantine import DataFault
+from transmogrifai_tpu.resilience.retry import is_transient, with_retry
+from transmogrifai_tpu.serve import (InputContract, MicroBatcher,
+                                     ModelRegistry, ModelServer)
+from transmogrifai_tpu.serve.batcher import _Pending
+from transmogrifai_tpu.testkit import TestFeatureBuilder
+from transmogrifai_tpu.workflow import stream
+
+_rscope = obs_registry.scope("resilience")
+
+
+def _train(n=80):
+    ds, (x, cat, y) = TestFeatureBuilder.of(
+        ("x", T.Real, list(np.linspace(-2, 2, n))),
+        ("cat", T.PickList, ["a", "b"] * (n // 2)),
+        ("y", T.RealNN, [float(i % 2) for i in range(n)]), response="y")
+    feats = VectorsCombiner().set_input(
+        RealVectorizer().set_input(x).get_output(),
+        OneHotVectorizer(top_k=3, min_support=1).set_input(cat).get_output(),
+    ).get_output()
+    pred = OpLogisticRegression(reg_param=0.1).set_input(y, feats).get_output()
+    model = OpWorkflow().set_input_dataset(ds).set_result_features(pred).train()
+    return model, pred
+
+
+@pytest.fixture(scope="module")
+def trained():
+    return _train()
+
+
+@pytest.fixture(autouse=True)
+def _clean_dataplane(monkeypatch):
+    """Every test starts with validation defaults, a fresh dead-letter
+    store, and no armed chaos rules (and leaves none behind)."""
+    for k in ("TMOG_QUARANTINE", "TMOG_QUARANTINE_PATH",
+              "TMOG_QUARANTINE_CAP", "TMOG_VALIDATE", "TMOG_FAULTS"):
+        monkeypatch.delenv(k, raising=False)
+    inject.configure("")
+    quarantine.reset_store()
+    yield
+    inject.configure("")
+    quarantine.reset_store()
+
+
+def _mk_batcher(model, max_wait_ms=120.0):
+    registry = ModelRegistry(max_batch=8, replicas=1)
+    registry.deploy(model, version="v1")
+    return MicroBatcher(registry, max_batch=8, max_wait_ms=max_wait_ms,
+                        queue_size=64).start()
+
+
+def _records(n=8):
+    return [{"x": round(0.5 * i - 2.0, 3), "cat": "ab"[i % 2]}
+            for i in range(n)]
+
+
+def _gather(batcher, records):
+    """Submit all records back-to-back (one collected batch) and resolve
+    each future to either its output dict or the raised exception."""
+    futures = [batcher.submit(r) for r in records]
+    outs = []
+    for f in futures:
+        try:
+            outs.append(f.result(30.0).output)
+        except Exception as e:  # noqa: BLE001 — the exception IS the result
+            outs.append(e)
+    return outs
+
+
+def _last_poison_rows(site):
+    events = [e for e in _rscope.get("faults", [])
+              if e.get("kind") == "poison" and e.get("site") == site]
+    assert events, f"no poison event recorded for {site}"
+    return events[-1]["rows"]
+
+
+# ---------------------------------------------------------------------------
+# InputContract: derivation and checks
+# ---------------------------------------------------------------------------
+def test_contract_derived_from_model(trained):
+    model, _ = trained
+    c = InputContract.from_model(model)
+    assert set(c.fields) == {"x", "cat"}
+    assert c.numeric_field_names == ["x"]
+    x = c.fields["x"]
+    assert x.numeric and x.scalar and x.required
+    # envelope from the training bin edges retained by RawFeatureFilter
+    assert x.lo is not None and x.lo <= -2.0 + 1e-6
+    assert x.hi is not None and x.hi >= 2.0 - 1e-6
+    cat = c.fields["cat"]
+    assert not cat.numeric and cat.scalar
+    spec = c.to_json()["fields"]
+    assert any("envelope" in s for s in spec)
+
+
+def test_check_record_classifies_faults(trained):
+    model, _ = trained
+    c = InputContract.from_model(model)
+    for bad, reason in [({"x": float("nan"), "cat": "a"}, "non_finite"),
+                        ({"x": float("inf"), "cat": "a"}, "non_finite"),
+                        ({"x": [1, 2], "cat": "a"}, "non_scalar"),
+                        ({"x": "!!poison!!", "cat": "a"}, "type_mismatch"),
+                        ({"x": 0.0, "cat": ["a"]}, "non_scalar")]:
+        with pytest.raises(DataFault) as e:
+            c.check_record(bad)
+        assert e.value.reason == reason
+        assert e.value.reason in quarantine.REASONS
+    with pytest.raises(DataFault) as e:
+        c.check_record([1, 2], index=3)
+    assert e.value.reason == "not_an_object" and e.value.index == 3
+    # missing required fields and numeric strings COUNT, never reject
+    missing0 = _rscope.get("contract_missing_required")
+    c.check_record({})
+    assert _rscope.get("contract_missing_required") == missing0 + 2
+    c.check_record({"x": "1.5", "cat": "a"})  # parseable string: fine
+
+
+def test_check_batch_vectorized_sweep(trained):
+    model, _ = trained
+    c = InputContract.from_model(model)
+    recs = [{"x": 0.1, "cat": "a"}, {"x": float("nan"), "cat": "b"},
+            {"x": None, "cat": "a"}, {"cat": "b"}]
+    faults = c.check_batch(recs, len(recs))
+    assert faults[0] is None and faults[2] is None and faults[3] is None
+    assert faults[1] is not None and faults[1].reason == "non_finite"
+    assert faults[1].index == 1 and faults[1].field == "x"
+    # out-of-envelope values count but never fault (drift must still score)
+    range0 = _rscope.get("range_violations")
+    faults = c.check_batch([{"x": 1e6, "cat": "a"}], 1)
+    assert faults == [None]
+    assert _rscope.get("range_violations") == range0 + 1
+
+
+# ---------------------------------------------------------------------------
+# MicroBatcher: admission rejection, chaos parity, bisection, fallback
+# ---------------------------------------------------------------------------
+def test_submit_rejects_poison_keeps_serving(trained):
+    model, _ = trained
+    b = _mk_batcher(model, max_wait_ms=5.0)
+    try:
+        with pytest.raises(DataFault) as e:
+            b.submit({"x": float("nan"), "cat": "a"})
+        assert e.value.reason == "non_finite"
+        snap = b.metrics.snapshot()
+        assert snap["data_faults"] == 1 and snap["quarantined"] == 1
+        # NOT an error, NOT shed: the client's fault, not the replica's
+        assert snap["errors"] == 0 and snap["shed"] == 0
+        rows = [r for r in quarantine.store().rows()
+                if r["source"] == "serve"]
+        assert rows and rows[-1]["reason"] == "non_finite"
+        assert rows[-1]["record"]["cat"] == "a"
+        # a clean record still scores on the same batcher
+        out = b.score({"x": 0.5, "cat": "b"})
+        assert isinstance(out, dict)
+        assert b.metrics.snapshot()["responses"] == 1
+    finally:
+        b.stop()
+
+
+def test_mixed_poison_batch_bit_parity_aot(trained):
+    """serve.score:poison corrupts co-batched rows; validation catches them
+    pre-dispatch (non-finite garbage), faulted rows fail alone, and every
+    clean row's score is BIT-IDENTICAL to the no-chaos run."""
+    model, _ = trained
+    b = _mk_batcher(model)
+    recs = _records(8)
+    try:
+        baseline = _gather(b, recs)
+        assert all(isinstance(o, dict) for o in baseline)
+        df0 = b.metrics.snapshot()["data_faults"]
+        inject.configure("serve.score:poison:2:1:0:0:1")  # 2 rows, once
+        outs = _gather(b, recs)
+        rows = _last_poison_rows("serve.score")
+        assert len(rows) == 2
+        for i, out in enumerate(outs):
+            if i in rows:
+                assert isinstance(out, DataFault)
+                assert out.reason == "non_finite"  # nan/inf garbage kinds
+            else:
+                assert out == baseline[i]  # clean co-batched rows: bit-equal
+        snap = b.metrics.snapshot()
+        assert snap["data_faults"] == df0 + 2
+        assert snap["errors"] == 0
+        # a poison record must never trip the breaker
+        assert b.supervisor.breaker(0).snapshot()["opens"] == 0
+    finally:
+        b.stop()
+
+
+def test_bisection_isolates_rows_when_validation_off(trained, monkeypatch):
+    """TMOG_VALIDATE=0: garbage reaches scoring, the batch fails with a
+    data-shaped error, and bisection isolates the offending rows instead of
+    blaming the replica."""
+    monkeypatch.setenv("TMOG_VALIDATE", "0")
+    model, _ = trained
+    b = _mk_batcher(model)
+    recs = _records(8)
+    try:
+        baseline = _gather(b, recs)
+        assert all(isinstance(o, dict) for o in baseline)
+        probes0 = _rscope.get("bisect_probes")
+        # 4 rows -> garbage kinds cycle nan/inf/type/text; the type and
+        # text rows raise in scoring, nan/inf flow through (legacy trust)
+        inject.configure("serve.score:poison:4:1:0:0:1")
+        outs = _gather(b, recs)
+        rows = _last_poison_rows("serve.score")
+        assert len(rows) == 4
+        raising = {rows[2], rows[3]}  # kinds[2]="type", kinds[3]="text"
+        for i, out in enumerate(outs):
+            if i in raising:
+                assert isinstance(out, DataFault)
+                assert out.reason == "score_failure"
+            elif i not in rows:
+                assert out == baseline[i]  # untouched rows: bit-equal
+        assert _rscope.get("bisect_probes") > probes0
+        snap = b.metrics.snapshot()
+        assert snap["errors"] == 0
+        assert b.supervisor.breaker(0).snapshot()["opens"] == 0
+    finally:
+        b.stop()
+
+
+def test_fallback_row_path_isolates_poison(trained):
+    """The degraded host row path scores each record alone: one poisonous
+    record fails by itself, its batchmates keep their exact scores."""
+    model, _ = trained
+    registry = ModelRegistry(max_batch=8, replicas=1)
+    entry = registry.deploy(model, version="v1")
+    b = MicroBatcher(registry, max_batch=8)   # never started: direct call
+    clean = [{"x": -0.5, "cat": "a"}, {"x": 1.25, "cat": "b"}]
+    poisoned = [clean[0], {"x": "!!poison!!", "cat": "a"}, clean[1]]
+    pend = [_Pending(r, Future(), time.monotonic()) for r in poisoned]
+    outs = b._fallback(entry, pend)
+    assert isinstance(outs[1], Exception)
+    assert outs[0] == entry.row(clean[0])
+    assert outs[2] == entry.row(clean[1])
+
+
+# ---------------------------------------------------------------------------
+# HTTP layer: structural 400 vs per-row 422
+# ---------------------------------------------------------------------------
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(url, data=json.dumps(payload).encode(),
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_server_mixed_validity_http(trained):
+    model, _ = trained
+    registry = ModelRegistry(max_batch=8)
+    registry.deploy(model, version="v1")
+    srv = ModelServer(registry, port=0, max_batch=8, max_wait_ms=1.0).start()
+    try:
+        clean = [{"x": 0.25, "cat": "a"}, {"x": -1.0, "cat": "b"}]
+        status, want = _post(srv.url + "/score", {"records": clean})
+        assert status == 200
+        # one NaN row co-submitted with two clean rows: per-row 422, clean
+        # scores still present and identical to the all-clean request
+        mixed = [clean[0], {"x": float("nan"), "cat": "a"}, clean[1]]
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url + "/score", {"records": mixed})
+        assert e.value.code == 422
+        body = json.loads(e.value.read())
+        assert [err["index"] for err in body["errors"]] == [1]
+        assert body["errors"][0]["reason"] == "non_finite"
+        assert body["errors"][0]["field"] == "x"
+        assert body["model_version"] == "v1"
+        assert body["scores"][1] is None
+        assert body["scores"][0] == want["scores"][0]
+        assert body["scores"][2] == want["scores"][1]
+        # single-record poison: 422 without a scores array
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url + "/score", {"x": float("inf"), "cat": "b"})
+        assert e.value.code == 422
+        assert "scores" not in json.loads(e.value.read())
+        # structural garbage (non-dict rows) is a 400 with row indices
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url + "/score", {"records": [clean[0], 42]})
+        assert e.value.code == 400
+        body = json.loads(e.value.read())
+        assert body["errors"] == [{"index": 1, "reason": "not_an_object",
+                                   "detail": "int"}]
+        snap = srv.metrics.snapshot()
+        assert snap["errors"] == 0          # data faults are not errors
+        assert snap["data_faults"] >= 2
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Classification: DataFault is never transient, never hedged
+# ---------------------------------------------------------------------------
+def test_retry_never_retries_data_fault():
+    assert not is_transient(DataFault("non_finite"))
+    assert is_transient(ConnectionError())
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise DataFault("non_finite", index=0)
+
+    retries0 = _rscope.get("retries")
+    with pytest.raises(DataFault):
+        with_retry("serve.score", fn)
+    assert len(calls) == 1                       # first attempt propagates
+    assert _rscope.get("retries") == retries0
+
+
+def test_hedge_short_circuits_data_fault():
+    calls = []
+
+    def attempt(task, slot, ctl):
+        ctl.mark_dispatch()
+        calls.append((task, slot))
+        raise DataFault("score_failure", index=task)
+
+    with pytest.raises(DataFault):
+        # deadline far out and a hedge budget available: a system fault
+        # here would hedge, a data fault must short-circuit instead
+        run_hedged(1, 2, attempt, [5.0], max_hedges=1)
+    assert len(calls) == 1
+
+
+# ---------------------------------------------------------------------------
+# Stream path: TMOG_QUARANTINE over chaos-poisoned chunks
+# ---------------------------------------------------------------------------
+def _stream_setup(n=237, seed=5):
+    rng = np.random.default_rng(seed)
+    cols = {f"x{j}": NumericColumn(T.Real, rng.normal(size=n),
+                                   np.ones(n, bool)) for j in range(4)}
+    ds = Dataset(cols)
+    xs = [FeatureBuilder(f"x{j}", T.Real).extract(field=f"x{j}").as_predictor()
+          for j in range(4)]
+    m1 = RealVectorizer().set_input(*xs[:2]).fit(ds)
+    m2 = RealVectorizer().set_input(*xs[2:]).fit(ds)
+    return ds, [[m1, m2]], [m1.get_output().name, m2.get_output().name]
+
+
+def test_stream_poison_drop_parity(monkeypatch):
+    monkeypatch.setenv("TMOG_TRANSFORM_CHUNK_ROWS", "64")
+    ds, layers, names = _stream_setup()
+    clean = stream.apply_streamed(ds, layers)
+    assert clean is not None
+    assert not [r for r in quarantine.store().rows()
+                if r["source"] == "stream"]     # clean run: nothing audited
+    monkeypatch.setenv("TMOG_QUARANTINE", "drop")
+    inject.configure("stream.upload:poison:3:1")
+    q0 = stream.stream_stats().get("quarantined", 0)
+    out = stream.apply_streamed(ds, layers)
+    inject.configure("")
+    assert out is not None
+    bad = sorted({r["index"] for r in quarantine.store().rows()
+                  if r["source"] == "stream"})
+    assert len(bad) >= 3
+    assert stream.stream_stats()["quarantined"] == q0 + len(bad)
+    keep = np.setdiff1d(np.arange(len(ds)), np.array(bad))
+    for nm in names:
+        a, b = np.asarray(clean[nm].values), np.asarray(out[nm].values)
+        assert (a[keep] == b[keep]).all()       # surviving rows: bit-equal
+        # dropped rows score as all-missing rows: the garbage never leaks
+        assert np.isfinite(b[bad]).all()
+
+
+def test_stream_poison_strict_raises(monkeypatch):
+    monkeypatch.setenv("TMOG_TRANSFORM_CHUNK_ROWS", "64")
+    monkeypatch.setenv("TMOG_QUARANTINE", "strict")
+    ds, layers, _ = _stream_setup()
+    inject.configure("stream.upload:poison:2:1")
+    with pytest.raises(DataFault) as e:
+        stream.apply_streamed(ds, layers)
+    assert e.value.reason == "non_finite"
+    assert "strict" in (e.value.detail or "")
+
+
+def test_stream_unset_policy_never_scans(monkeypatch):
+    """Poison armed but TMOG_QUARANTINE unset: the legacy path — garbage
+    flows into the compute, nothing is audited, nothing raises."""
+    monkeypatch.setenv("TMOG_TRANSFORM_CHUNK_ROWS", "64")
+    ds, layers, names = _stream_setup(n=100)
+    inject.configure("stream.upload:poison:2:1")
+    out = stream.apply_streamed(ds, layers)
+    assert out is not None
+    assert len(quarantine.store()) == 0
+    assert not np.isfinite(np.asarray(out[names[0]].values)).all() or \
+        not np.isfinite(np.asarray(out[names[1]].values)).all()
+
+
+# ---------------------------------------------------------------------------
+# Reader path: TMOG_QUARANTINE at read time
+# ---------------------------------------------------------------------------
+def _read(monkeypatch, policy):
+    import pandas as pd
+
+    from transmogrifai_tpu.readers.base import CustomReader
+
+    if policy:
+        monkeypatch.setenv("TMOG_QUARANTINE", policy)
+    else:
+        monkeypatch.delenv("TMOG_QUARANTINE", raising=False)
+    df = pd.DataFrame({"x": [1.0, "abc", 3.0, float("inf")]})
+    x = FeatureBuilder("x", T.Real).extract(field="x").as_predictor()
+    return CustomReader(df).generate_dataset([x], {})
+
+
+def test_reader_policy_unset_is_legacy_coercion(monkeypatch):
+    ds = _read(monkeypatch, "")
+    assert len(ds) == 4
+    col = ds["x"]
+    # the historical silent path: "abc" coerces to a null, inf flows in
+    assert list(col.mask) == [True, False, True, True]
+    assert len(quarantine.store()) == 0
+
+
+def test_reader_policy_drop(monkeypatch):
+    ds = _read(monkeypatch, "drop")
+    assert len(ds) == 2
+    assert list(np.asarray(ds["x"].values)) == [1.0, 3.0]
+    rows = quarantine.store().rows()
+    assert {(r["index"], r["reason"]) for r in rows} == \
+        {(1, "type_mismatch"), (3, "non_finite")}
+    assert all(r["source"] == "reader" for r in rows)
+
+
+def test_reader_policy_strict_and_fail(monkeypatch):
+    with pytest.raises(DataFault) as e:
+        _read(monkeypatch, "strict")
+    assert e.value.reason == "type_mismatch" and e.value.index == 1
+    assert len(quarantine.store()) == 1
+    quarantine.reset_store()
+    with pytest.raises(DataFault) as e:
+        _read(monkeypatch, "fail")
+    assert "2 bad row(s)" in (e.value.detail or "")
+    assert len(quarantine.store()) == 2          # every bad row audited
+
+
+# ---------------------------------------------------------------------------
+# Drift: the __quarantined__ pseudo-feature can trigger retraining
+# ---------------------------------------------------------------------------
+def test_quarantine_rate_is_drift(trained):
+    sketch = ServeSketch({})
+    sketch.observe([{"x": 0.0}] * 40, (), quarantined=60)
+    dist = sketch.distributions()[(QUARANTINE_KEY, None)]
+    assert dist.count == 100 and dist.nulls == 60
+    scores = sketch.scores()
+    row = scores[QUARANTINE_KEY]
+    # serving fill rate is the clean fraction, so fill_rate_diff vs the
+    # all-clean training baseline IS the quarantine rate
+    assert row["fill_rate_diff"] == pytest.approx(0.6)
+    ctl = RetrainController(ControllerConfig())
+    first = ctl.evaluate(scores)
+    assert not first.triggered and first.reason == "hysteresis"
+    assert QUARANTINE_KEY in first.breached
+    second = ctl.evaluate(scores)
+    assert second.triggered and QUARANTINE_KEY in second.breached
+
+
+def test_clean_traffic_quarantine_rate_zero():
+    sketch = ServeSketch({})
+    sketch.observe([{"x": 0.0}] * 50, ())
+    row = sketch.scores()[QUARANTINE_KEY]
+    assert row["fill_rate_diff"] == pytest.approx(0.0)
+    assert not RetrainController(ControllerConfig()).evaluate(
+        sketch.scores()).breached
+
+
+# ---------------------------------------------------------------------------
+# QuarantineStore + poison grammar
+# ---------------------------------------------------------------------------
+def test_store_bounds_and_jsonl_audit(tmp_path):
+    path = str(tmp_path / "dead_letters.jsonl")
+    s = quarantine.QuarantineStore(cap=3, path=path)
+    for i in range(5):
+        # records carry the very garbage being audited: NaN, Inf, lists
+        s.put("serve", "non_finite", index=i, field="x",
+              record={"x": float("nan"), "v": [float("inf"), 1]})
+    assert len(s) == 3 and s.total == 5          # ring bounded, total not
+    assert [r["seq"] for r in s.rows()] == [3, 4, 5]
+    lines = [json.loads(ln) for ln in open(path)]  # must be valid JSON
+    assert len(lines) == 5
+    assert lines[0]["record"]["x"] == "nan"      # garbage JSON-projected
+    assert s.snapshot() == {"total": 5, "held": 3, "cap": 3, "path": path}
+
+
+def test_policy_parsing(monkeypatch):
+    monkeypatch.delenv("TMOG_QUARANTINE", raising=False)
+    assert quarantine.policy() == ""
+    monkeypatch.setenv("TMOG_QUARANTINE", "DROP")
+    assert quarantine.policy() == "drop"
+    monkeypatch.setenv("TMOG_QUARANTINE", "bogus")
+    assert quarantine.policy() == ""             # typo must not drop rows
+
+
+def test_poison_grammar_and_determinism():
+    with pytest.raises(ValueError):
+        inject.parse_rules("serve.score:poison")      # rows required
+    with pytest.raises(ValueError):
+        inject.parse_rules("serve.score:poison:0")    # rows must be positive
+    inject.configure("serve.score:poison:3:1:7")
+    plan1 = inject.poison_plan("serve.score", 16)
+    assert len(plan1) == 3
+    assert all(k in inject.GARBAGE_KINDS for _, k in plan1)
+    # a poison rule never raises at maybe_fail sites
+    inject.maybe_fail("serve.score", key=0)
+    # same spec -> same rows, same garbage: the parity tests depend on it
+    inject.configure("serve.score:poison:3:1:7")
+    assert inject.poison_plan("serve.score", 16) == plan1
+    # wrong site consumes nothing
+    assert inject.poison_plan("stream.upload", 16) == []
+    inject.configure("")
+    assert inject.poison_plan("serve.score", 16) == []

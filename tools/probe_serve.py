@@ -112,7 +112,14 @@ def main(argv=None) -> int:
                         "third of the duration)")
     p.add_argument("--kill-duration", type=float, default=2.0,
                    help="seconds the injected fault stays armed")
+    p.add_argument("--poison-rate", type=float, default=0.0,
+                   help="fraction of requests sent with a malformed record "
+                        "(NaN / non-scalar / text garbage in a numeric "
+                        "field, cycling); each must come back as a per-row "
+                        "HTTP 422, never a 500 and never a breaker trip")
     args = p.parse_args(argv)
+    if not 0.0 <= args.poison_rate < 1.0:
+        p.error("--poison-rate must be in [0, 1)")
 
     if args.compile_cache:
         os.environ["TMOG_COMPILE_CACHE"] = args.compile_cache
@@ -152,10 +159,24 @@ def main(argv=None) -> int:
                    else v) for k, v in record.items()}
     shifted_payload = json.dumps(shifted).encode()
 
+    # poison corpus: garbage planted in the record's first numeric field
+    # (Python's json emits/accepts the NaN token, so the NaN variant is a
+    # true non-finite float by the time the server parses it)
+    num_keys = [k for k, v in record.items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)]
+    pk = num_keys[0] if num_keys else "__poison__"
+    poison_payloads = [
+        json.dumps({**record, pk: g}).encode()
+        for g in (float("nan"), ["not", "a", "scalar"], "!!poison!!")]
+    poison_every = int(round(1.0 / args.poison_rate)) if args.poison_rate \
+        else 0
+
     latencies_ms: list = []
     shed = [0]
     errors = [0]
     count = [0]
+    poison_sent = [0]
+    poison_422 = [0]
     lock = threading.Lock()
     stop_at = time.monotonic() + args.duration
     drift_at = stop_at - args.duration + (
@@ -164,21 +185,32 @@ def main(argv=None) -> int:
 
     def client():
         local_lat, local_shed, local_err, local_n = [], 0, 0, 0
+        local_psent, local_p422, sent = 0, 0, 0
         while time.monotonic() < stop_at:
             body = shifted_payload if args.drift_shift and \
                 time.monotonic() >= drift_at else payload
+            poisoned = poison_every and sent % poison_every == 0
+            if poisoned:
+                body = poison_payloads[local_psent % len(poison_payloads)]
+                local_psent += 1
+            sent += 1
             t0 = time.perf_counter()
             try:
                 req = urllib.request.Request(url, data=body,
                                              headers={"Content-Type": "application/json"})
                 with urllib.request.urlopen(req, timeout=30) as resp:
                     resp.read()
-                local_lat.append((time.perf_counter() - t0) * 1000.0)
-                local_n += 1
+                if poisoned:
+                    local_err += 1   # poison must NOT score
+                else:
+                    local_lat.append((time.perf_counter() - t0) * 1000.0)
+                    local_n += 1
             except urllib.error.HTTPError as e:
                 if e.code == 429:
                     local_shed += 1
                     time.sleep(0.001)  # back off briefly on shed
+                elif poisoned and e.code == 422:
+                    local_p422 += 1   # the expected per-row rejection
                 else:
                     local_err += 1
             except Exception:
@@ -188,6 +220,8 @@ def main(argv=None) -> int:
             shed[0] += local_shed
             errors[0] += local_err
             count[0] += local_n
+            poison_sent[0] += local_psent
+            poison_422[0] += local_p422
 
     chaos: dict = {}
 
@@ -257,6 +291,22 @@ def main(argv=None) -> int:
         "continual": server_metrics.get("continual", {}),
         "server_metrics": server_metrics["serve"],
     }
+    # data-plane health: ~0 on a clean corpus, so the perf gate's
+    # lower-is-better policy flags an over-rejecting contract
+    srv = server_metrics["serve"]
+    reqs = max(1, srv.get("requests", 0))
+    out["quarantine_rate"] = round(srv.get("quarantined", 0) / reqs, 6)
+    out["data_fault_fraction"] = round(srv.get("data_faults", 0) / reqs, 6)
+    if args.poison_rate:
+        out["poison"] = {
+            "rate": args.poison_rate,
+            "poison_sent": poison_sent[0],
+            "poison_422": poison_422[0],
+            "data_faults": srv.get("data_faults", 0),
+            "quarantined": srv.get("quarantined", 0),
+            "quarantine": server_metrics.get(
+                "resilience", {}).get("quarantined", 0),
+        }
     if args.kill_replica is not None:
         out["chaos"] = {"kill_replica": args.kill_replica,
                         "kill_duration_s": args.kill_duration, **chaos}

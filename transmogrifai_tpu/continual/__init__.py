@@ -15,7 +15,7 @@ Every decision is recorded in the ``"continual"`` obs scope and in the
 per-run JSONL records.
 """
 from .controller import ControllerConfig, Decision, RetrainController, scope
-from .drift import (DEFAULT_BINS, PREDICTION_KEY, ServeSketch,
+from .drift import (DEFAULT_BINS, PREDICTION_KEY, QUARANTINE_KEY, ServeSketch,
                     baselines_from_model, drift_scores, merged_distributions,
                     prediction_distribution)
 from .loop import ContinualLoop, incumbent_summary
@@ -24,7 +24,8 @@ from .promote import (GateConfig, GateResult, decide, evaluate_pair, promote,
 
 __all__ = [
     "ControllerConfig", "Decision", "RetrainController", "scope",
-    "DEFAULT_BINS", "PREDICTION_KEY", "ServeSketch", "baselines_from_model",
+    "DEFAULT_BINS", "PREDICTION_KEY", "QUARANTINE_KEY", "ServeSketch",
+    "baselines_from_model",
     "drift_scores", "merged_distributions", "prediction_distribution",
     "ContinualLoop", "incumbent_summary",
     "GateConfig", "GateResult", "decide", "evaluate_pair", "promote",

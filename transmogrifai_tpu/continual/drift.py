@@ -31,11 +31,31 @@ import numpy as np
 from ..impl.filters.distribution import (
     FeatureDistribution, _hash_token, _tokens_of, compute_feature_stats)
 
-__all__ = ["PREDICTION_KEY", "ServeSketch", "baselines_from_model",
-           "prediction_distribution", "drift_scores", "merged_distributions"]
+__all__ = ["PREDICTION_KEY", "QUARANTINE_KEY", "ServeSketch",
+           "baselines_from_model", "prediction_distribution", "drift_scores",
+           "merged_distributions"]
 
 #: reserved feature name for the prediction-output sketch
 PREDICTION_KEY = "__prediction__"
+
+#: reserved pseudo-feature tracking the QUARANTINE RATE: quarantined rows
+#: are excluded from every per-feature sketch (their garbage would poison
+#: the baseline comparison), but the rate itself is drift — a spike means
+#: the traffic changed shape, and it must be able to trigger the
+#: RetrainController like any other feature.
+QUARANTINE_KEY = "__quarantined__"
+
+
+def _quarantine_baseline() -> "FeatureDistribution":
+    """Synthesized training baseline for the quarantine pseudo-feature:
+    training data is all-clean by construction (the readers crash or drop
+    non-conforming rows), i.e. distribution [clean=1, quarantined=0] on
+    unit edges.  Synthesizing it keeps both ``js`` and ``fill_rate_diff``
+    computable in :func:`drift_scores` — serving nulls are the quarantined
+    rows, so ``fill_rate_diff`` IS the serve-side quarantine rate."""
+    return FeatureDistribution(QUARANTINE_KEY, None, 1, 0,
+                               np.array([1.0, 0.0]), np.array([0.0, 1.0]),
+                               "training")
 
 #: default serving histogram resolution when a baseline doesn't fix it
 DEFAULT_BINS = 20
@@ -87,6 +107,8 @@ class ServeSketch:
     def __init__(self, baselines, bins: int = DEFAULT_BINS,
                  prediction_edges: Optional[np.ndarray] = None):
         self.baselines = _as_baseline_map(baselines)
+        if (QUARANTINE_KEY, None) not in self.baselines:
+            self.baselines[(QUARANTINE_KEY, None)] = _quarantine_baseline()
         self._lock = threading.Lock()
         self._accs: Dict[FeatureKey, _Acc] = {}
         self._numeric: Dict[FeatureKey, Optional[np.ndarray]] = {}
@@ -95,9 +117,15 @@ class ServeSketch:
                 prediction_edges = np.asarray(base.summary_info, float) \
                     if base.is_numeric else prediction_edges
                 continue
+            if fk[0] == QUARANTINE_KEY:
+                continue   # tracked by the dedicated accumulator below
             self._accs[fk] = _Acc(len(base.distribution))
             self._numeric[fk] = np.asarray(base.summary_info, float) \
                 if base.is_numeric else None
+        #: quarantine-rate accumulator: dist[0]=clean rows, dist[1]=
+        #: quarantined rows; nulls=quarantined so fill_rate_diff vs the
+        #: all-clean baseline equals the quarantine rate
+        self._quar = _Acc(2)
         #: prediction sketch: fixed edges (probability scale by default so
         #: classification drift needs no baseline; pass edges for regression)
         self._pred_edges = np.asarray(
@@ -158,13 +186,19 @@ class ServeSketch:
                 acc.dist[_hash_token(t, bins)] += 1.0
 
     def observe(self, records: Sequence[Dict[str, Any]],
-                outputs: Sequence[Any] = ()) -> None:
+                outputs: Sequence[Any] = (), quarantined: int = 0) -> None:
         """Fold one dispatched batch (real, unpadded records) into the sketch.
         ``outputs`` may contain per-record Exceptions — those are skipped for
-        the prediction sketch only."""
+        the prediction sketch only.  ``records`` must already exclude
+        quarantined rows; pass their count as ``quarantined`` so the
+        ``QUARANTINE_KEY`` pseudo-feature tracks the rate."""
         preds = [p for p in (self.prediction_of(o) for o in outputs
                              if not isinstance(o, Exception)) if p is not None]
         with self._lock:
+            self._quar.count += len(records) + quarantined
+            self._quar.nulls += quarantined
+            self._quar.dist[0] += len(records)
+            self._quar.dist[1] += quarantined
             for fk, acc in self._accs.items():
                 edges = self._numeric[fk]
                 if edges is not None:
@@ -197,6 +231,10 @@ class ServeSketch:
             if self._pred.count:
                 out[(PREDICTION_KEY, None)] = self._dist_of(
                     (PREDICTION_KEY, None), self._pred)
+            if self._quar.count:
+                out[(QUARANTINE_KEY, None)] = FeatureDistribution(
+                    QUARANTINE_KEY, None, self._quar.count, self._quar.nulls,
+                    self._quar.dist.copy(), np.array([0.0, 1.0]), "serving")
         return out
 
     def merge_from(self, other: "ServeSketch") -> None:
@@ -208,6 +246,8 @@ class ServeSketch:
                       for fk, acc in other._accs.items()}
             pred = (other._pred.count, other._pred.nulls,
                     other._pred.dist.copy())
+            quar = (other._quar.count, other._quar.nulls,
+                    other._quar.dist.copy())
         with self._lock:
             for fk, (c, nl, dist, tmin, tmax) in theirs.items():
                 acc = self._accs.get(fk)
@@ -222,6 +262,9 @@ class ServeSketch:
                 self._pred.count += pred[0]
                 self._pred.nulls += pred[1]
                 self._pred.dist += pred[2]
+            self._quar.count += quar[0]
+            self._quar.nulls += quar[1]
+            self._quar.dist += quar[2]
 
     def scores(self) -> Dict[str, Dict[str, float]]:
         """Per-feature drift metrics vs the baselines (the /metrics gauge)."""
@@ -232,6 +275,7 @@ class ServeSketch:
             for fk, acc in self._accs.items():
                 self._accs[fk] = _Acc(len(acc.dist))
             self._pred = _Acc(len(self._pred_edges))
+            self._quar = _Acc(2)
 
 
 # ---------------------------------------------------------------------------

@@ -52,6 +52,10 @@ POLICIES: Dict[str, Dict[str, int]] = {
     },
     "serve_replica_qps": {
         "value": +1, "warm_restart_speedup": +1, "p99_ms": -1,
+        # data-plane hardening (PR 14): share of traffic quarantined /
+        # rejected as data faults — on a clean probe corpus both should be
+        # ~zero, so growth means validation is over-rejecting
+        "quarantine_rate": -1, "data_fault_fraction": -1,
     },
     "continual_warm_retrain_speedup": {"value": +1},
 }

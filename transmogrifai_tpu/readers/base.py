@@ -8,6 +8,15 @@ TPU-first redesign: readers produce columnar ``Dataset``s directly.  When a
 raw feature's extractor is a declarative ``FieldExtractor`` the conversion is
 vectorized over the column (no per-row Python); arbitrary ``FnExtractor``s
 fall back to a row loop at read time only — everything downstream is columnar.
+
+Data-plane hardening: the vectorized numeric path historically coerced
+type garbage to NaN *silently* (``pd.to_numeric(errors="coerce")``) — a
+poisoned source column just became nulls.  ``TMOG_QUARANTINE`` now arms a
+read-time row policy (``_apply_row_policy``): rows whose numeric fields
+hold unparseable or infinite values are audited to the shared dead-letter
+store and dropped (``drop``), fail the read at the first bad row
+(``strict``), or are all audited before failing (``fail``).  Unset keeps
+the legacy silent-coercion behavior bit-identical (no scanning at all).
 """
 from __future__ import annotations
 
@@ -20,6 +29,8 @@ from .. import types as T
 from ..columns import Dataset, KEY_FIELD, column_from_scalars, NumericColumn, ObjectColumn
 from ..features.feature import Feature
 from ..features.generator import Event, FeatureGeneratorStage, FieldExtractor
+from ..resilience import quarantine as _quar
+from ..resilience.quarantine import DataFault
 
 
 def _records_from(data: Any) -> List[Dict[str, Any]]:
@@ -63,6 +74,86 @@ def _extract_columns(raw_features: Sequence[Feature], records: List[Dict[str, An
                 continue
         cols[f.name] = column_from_scalars(f.ftype, [stage.extract(r) for r in records])
     return cols
+
+
+def _bad_rows(raw_features: Sequence[Feature], df=None,
+              records: Optional[List[Dict[str, Any]]] = None
+              ) -> List[tuple]:
+    """Rows violating a numeric field's contract: ``(index, field, reason)``.
+
+    A value is bad when it is present but unparseable (``type_mismatch`` —
+    exactly what the legacy path silently coerced to NaN) or parses to an
+    infinity (``non_finite``).  NaN/None stay "missing", as in training.
+    """
+    import pandas as pd
+
+    out: List[tuple] = []
+    for f in raw_features:
+        ex = getattr(f.origin_stage, "extract_fn", None)
+        if not (isinstance(ex, FieldExtractor)
+                and issubclass(f.ftype, T.OPNumeric)):
+            continue
+        if df is not None and ex.field_name in df.columns:
+            series = df[ex.field_name]
+            vals = pd.to_numeric(series, errors="coerce").to_numpy(
+                dtype=np.float64, na_value=np.nan)
+            bad_type = series.notna().to_numpy() & np.isnan(vals)
+            for i in np.nonzero(bad_type)[0]:
+                out.append((int(i), ex.field_name, "type_mismatch"))
+            for i in np.nonzero(np.isinf(vals))[0]:
+                out.append((int(i), ex.field_name, "non_finite"))
+        elif records is not None:
+            for i, r in enumerate(records):
+                v = r.get(ex.field_name) if isinstance(r, dict) else None
+                if v is None or isinstance(v, (bool, int)):
+                    continue
+                if isinstance(v, float) and v != v:
+                    continue   # NaN == missing, exactly as in training
+                try:
+                    fv = float(v)
+                except (TypeError, ValueError):
+                    out.append((i, ex.field_name, "type_mismatch"))
+                    continue
+                if not np.isfinite(fv):
+                    out.append((i, ex.field_name, "non_finite"))
+    return out
+
+
+def _apply_row_policy(raw_features: Sequence[Feature], df,
+                      records: Optional[List[Dict[str, Any]]]):
+    """``TMOG_QUARANTINE`` at read time; returns ``(df, records)`` with bad
+    rows dropped (``drop``), or raises :class:`DataFault` (``strict`` /
+    ``fail``).  Unset policy returns the inputs untouched, unscanned."""
+    pol = _quar.policy()
+    if not pol:
+        return df, records
+    bad = _bad_rows(raw_features, df, records)
+    if not bad:
+        return df, records
+    dls = _quar.store()
+    if pol == "strict":
+        i, name, reason = bad[0]
+        dls.put("reader", reason, index=i, field=name,
+                record=records[i] if records else None,
+                detail="TMOG_QUARANTINE=strict")
+        raise DataFault(reason, index=i, field=name,
+                        detail="TMOG_QUARANTINE=strict")
+    for i, name, reason in bad:
+        dls.put("reader", reason, index=i, field=name,
+                record=records[i] if records and i < len(records) else None)
+    if pol == "fail":
+        i, name, reason = bad[0]
+        raise DataFault(reason, index=i, field=name,
+                        detail=f"{len({b[0] for b in bad})} bad row(s), "
+                               "TMOG_QUARANTINE=fail")
+    drop = {i for i, _, _ in bad}
+    if df is not None:
+        keep = np.ones(len(df), bool)
+        keep[sorted(drop)] = False
+        df = df[keep].reset_index(drop=True)
+    if records is not None:
+        records = [r for i, r in enumerate(records) if i not in drop]
+    return df, records
 
 
 class Reader:
@@ -134,12 +225,14 @@ class DataReader(Reader):
             # no per-row dict materialization — critical at 10M+ rows
             if limit:
                 df = df.head(int(limit))
+            df, _ = _apply_row_policy(raw_features, df, None)
             cols = _extract_columns(raw_features, [], df)
             return Dataset(cols, self._vectorized_keys(df))
         records = _records_from(data)
         if limit:
             records = records[: int(limit)]
             df = df.head(int(limit)) if df is not None else None
+        df, records = _apply_row_policy(raw_features, df, records)
         cols = _extract_columns(raw_features, records, df)
         keys = np.array([self._key_of(r, i) for i, r in enumerate(records)], dtype=object)
         return Dataset(cols, keys)

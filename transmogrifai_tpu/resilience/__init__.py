@@ -43,7 +43,12 @@ scope = _obs_registry.scope("resilience", defaults=dict(
     supervisor_beats=0,
     hedges_fired=0,
     device_evictions=0,
+    data_faults=0,
+    quarantined=0,
+    range_violations=0,
+    contract_missing_required=0,
     faults=[],
+    quarantine=[],
 ))
 
 from .checkpoint import (CheckpointStore, checkpoint_dir,  # noqa: E402
@@ -51,7 +56,11 @@ from .checkpoint import (CheckpointStore, checkpoint_dir,  # noqa: E402
                          store)
 from .circuit import CLOSED, HALF_OPEN, OPEN, CircuitBreaker  # noqa: E402
 from .inject import (InjectedFault, InjectedFatal, active, add_rule,  # noqa: E402
-                     clear_rules, configure, maybe_fail)
+                     clear_rules, configure, maybe_fail, poison_plan)
+from .quarantine import DataFault, QuarantineStore  # noqa: E402
+from .quarantine import policy as quarantine_policy  # noqa: E402
+from .quarantine import reset_store as reset_quarantine_store  # noqa: E402
+from .quarantine import store as quarantine_store  # noqa: E402
 from .retry import RetryPolicy, is_transient, with_retry  # noqa: E402
 from .health import HealthTracker  # noqa: E402
 from .health import reset as reset_health  # noqa: E402
@@ -62,7 +71,9 @@ from .hedge import enabled as hedge_enabled  # noqa: E402,F401
 __all__ = [
     "scope",
     "InjectedFault", "InjectedFatal", "maybe_fail", "configure", "add_rule",
-    "clear_rules", "active",
+    "clear_rules", "active", "poison_plan",
+    "DataFault", "QuarantineStore", "quarantine_store", "quarantine_policy",
+    "reset_quarantine_store",
     "RetryPolicy", "with_retry", "is_transient",
     "CircuitBreaker", "CLOSED", "OPEN", "HALF_OPEN",
     "CheckpointStore", "store", "checkpoint_dir", "content_key",

@@ -32,6 +32,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 from ..obs import registry as obs_registry
 from ..utils import env as _env
 from . import health as _health
+from .quarantine import DataFault
 
 __all__ = ["enabled", "hedge_factor", "hedge_floor_s", "shard_deadline",
            "AttemptCtl", "run_hedged"]
@@ -206,6 +207,13 @@ def run_hedged(
             open_tasks = [i for i in range(n_tasks) if winners[i] is None]
             if not open_tasks:
                 break
+            for i in open_tasks:
+                for e in errors[i]:
+                    if isinstance(e, DataFault):
+                        # A data fault replays identically on any chip:
+                        # hedging it duplicates the failure and double-
+                        # counts wasted wall.  Short-circuit instead.
+                        raise e
             failed = [i for i in open_tasks
                       if inflight[i] == 0 and hedges_used[i] >= max_hedges]
             if failed:

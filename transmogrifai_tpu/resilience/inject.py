@@ -4,6 +4,7 @@
 
     site[#key]:kind[:prob[:seed[:after[:fires]]]]
     site[#key]:delay:seconds[:prob[:seed[:after[:fires]]]]
+    site[#key]:poison:rows[:prob[:seed[:after[:fires]]]]
 
 - ``site`` — a named hook site (``sweep.compile``, ``sweep.dispatch``,
   ``stream.upload``, ``stream.pull``, ``serve.score``, ``serve.warm``,
@@ -16,7 +17,13 @@
   current process — a deterministic preemption), or ``delay`` (sleeps
   ``seconds`` at the hook site and then lets the call proceed — a
   deterministic STRAGGLER, the substrate of the hedged-dispatch chaos
-  tests).  ``delay`` takes one extra leading field, the sleep seconds;
+  tests), or ``poison`` (corrupts ``rows`` records of the batch passing
+  the hook site with NaN/Inf/type-garbage — a deterministic DATA fault,
+  the substrate of the quarantine chaos tests; consumed via
+  :func:`poison_plan` by the batch sites ``serve.score`` and
+  ``stream.upload``, never raised by :func:`maybe_fail`).  ``delay``
+  takes one extra leading field, the sleep seconds, and ``poison`` one
+  extra leading field, the poisoned-row count;
   ``prob``/``seed``/``after``/``fires`` shift right by one and keep their
   meaning.
 - ``prob`` — firing probability per eligible invocation (default 1).
@@ -46,7 +53,8 @@ from ..obs import registry as obs_registry
 from ..utils import env as _env
 
 __all__ = ["InjectedFault", "InjectedFatal", "maybe_fail", "configure",
-           "add_rule", "clear_rules", "active"]
+           "add_rule", "clear_rules", "active", "poison_plan",
+           "garbage_value", "GARBAGE_KINDS"]
 
 _scope = obs_registry.scope("resilience")
 
@@ -63,7 +71,10 @@ class InjectedFatal(RuntimeError):
     transient = False
 
 
-_KINDS = ("error", "fatal", "kill", "delay")
+_KINDS = ("error", "fatal", "kill", "delay", "poison")
+
+#: deterministic garbage cycle for kind="poison" (one per poisoned row)
+GARBAGE_KINDS = ("nan", "inf", "type", "text")
 
 
 class _Rule:
@@ -117,16 +128,17 @@ def parse_rules(spec: str) -> List[_Rule]:
             raise ValueError(f"bad TMOG_FAULTS kind {kind!r} in {part!r}: "
                              f"want one of {_KINDS}")
         seconds = 0.0
-        if kind == "delay":
-            # delay takes an extra leading field (sleep seconds); the
-            # prob/seed/after/fires tail shifts right by one.
+        if kind in ("delay", "poison"):
+            # delay/poison take an extra leading field (sleep seconds /
+            # poisoned-row count); prob/seed/after/fires shift right by one.
+            what = "seconds" if kind == "delay" else "rows"
             if len(fields) < 3 or not fields[2].strip():
-                raise ValueError(f"bad TMOG_FAULTS rule {part!r}: delay "
-                                 "wants site[#key]:delay:seconds[:prob[...]]")
+                raise ValueError(f"bad TMOG_FAULTS rule {part!r}: {kind} "
+                                 f"wants site[#key]:{kind}:{what}[:prob[...]]")
             seconds = float(fields[2])
             if seconds <= 0.0:
-                raise ValueError(f"bad TMOG_FAULTS rule {part!r}: delay "
-                                 f"seconds must be positive, got {seconds}")
+                raise ValueError(f"bad TMOG_FAULTS rule {part!r}: {kind} "
+                                 f"{what} must be positive, got {seconds}")
             fields = fields[:2] + fields[3:]
         prob = float(fields[2]) if len(fields) > 2 and fields[2].strip() else 1.0
         seed = int(fields[3]) if len(fields) > 3 and fields[3].strip() else 0
@@ -177,6 +189,8 @@ def maybe_fail(site: str, key=None) -> None:
     for r in _rules:
         if r.site != site or (r.key is not None and r.key != skey):
             continue
+        if r.kind == "poison":
+            continue   # consumed by poison_plan at batch sites, never raised
         with _lock:
             r.count += 1
             hit = (r.count > r.after
@@ -203,6 +217,59 @@ def maybe_fail(site: str, key=None) -> None:
         where = site if skey is None else f"{site}#{skey}"
         raise cls(f"injected {r.kind} at {where} "
                   f"(hit {r.fired}, invocation {r.count})")
+
+
+def garbage_value(kind: str):
+    """The planted value for one poisoned row (``GARBAGE_KINDS`` member).
+    Numeric-array sites that can't represent type/text garbage map those
+    kinds to NaN."""
+    if kind == "nan":
+        return float("nan")
+    if kind == "inf":
+        return float("inf")
+    if kind == "type":
+        return ["not", "a", "scalar"]
+    return "!!poison!!"
+
+
+def poison_plan(site: str, n: int, key=None):
+    """Data-fault hook for batch sites: the poison rows for this invocation.
+
+    Returns ``[(row_index, garbage_kind), ...]`` (empty when no armed
+    poison rule fires).  Row choice and garbage assignment come from the
+    rule's private RNG, so a fixed ``TMOG_FAULTS`` string poisons the same
+    rows with the same garbage on every run — the clean-row bit-parity
+    chaos assertion depends on that.  ``maybe_fail`` never raises for
+    poison rules; the batch sites apply this plan to their own rows.
+    """
+    if not _active or n <= 0:
+        return []
+    skey = None if key is None else str(key)
+    plan = []
+    for r in _rules:
+        if r.kind != "poison" or r.site != site or \
+                (r.key is not None and r.key != skey):
+            continue
+        with _lock:
+            r.count += 1
+            hit = (r.count > r.after
+                   and (r.fires <= 0 or r.fired < r.fires)
+                   and r.rng.random() < r.prob)
+            if hit:
+                r.fired += 1
+                k = max(1, min(n, int(r.seconds)))
+                rows = sorted(r.rng.sample(range(n), k))
+        if not hit:
+            continue
+        _scope.inc("faults_injected")
+        _scope.append("faults", {
+            "event": "injected", "site": site, "key": skey, "kind": "poison",
+            "rows": rows, "hit": r.fired, "invocation": r.count,
+        })
+        for j, idx in enumerate(rows):
+            plan.append((idx, GARBAGE_KINDS[(r.fired - 1 + j)
+                                            % len(GARBAGE_KINDS)]))
+    return plan
 
 
 # Arm from the environment at import so subprocess chaos runs need no code.

@@ -21,6 +21,7 @@ from typing import Callable, Optional, Tuple, Type
 from ..obs import registry as obs_registry
 from ..obs import trace
 from ..utils import env as _env
+from .quarantine import DataFault
 
 __all__ = ["RetryPolicy", "with_retry", "is_transient"]
 
@@ -35,6 +36,10 @@ _TRANSIENT_DEFAULT: Tuple[Type[BaseException], ...] = (
 
 
 def is_transient(exc: BaseException) -> bool:
+    # A data fault replays identically on every attempt and every machine:
+    # never transient, whatever a subclass says about its flags.
+    if isinstance(exc, DataFault):
+        return False
     flag = getattr(exc, "transient", None)
     if flag is not None:
         return bool(flag)

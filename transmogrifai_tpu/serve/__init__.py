@@ -16,6 +16,8 @@ Layering::
     server.py         HTTP front end (stdlib ThreadingHTTPServer), shedding
     batcher.py        bounded admission queue -> padded bucket batches ->
                       least-outstanding-work replica routing
+    contract.py       per-model input contracts: admission + batch
+                      validation, poison rows quarantined per-row (422)
     registry.py       versioned models, N replica slots, rolling hot-swap
     supervisor.py     self-healing: per-slot circuit breakers + the probe/
                       rebuild daemon (degraded host path when all slots down)
@@ -29,7 +31,9 @@ Entry points: the ``Serve`` run type on ``OpWorkflowRunner``, the
 ``transmogrifai-tpu-serve`` console script, and this module's classes for
 in-process embedding (tests, notebooks).
 """
+from ..resilience.quarantine import DataFault
 from .batcher import MicroBatcher, Scored, ShedError
+from .contract import InputContract, validation_enabled
 from .metrics import LatencyHistogram, ServeMetrics, prometheus_replica_text
 from .registry import (ModelRegistry, Replica, ServingModel, bucket_for,
                        shape_buckets)
@@ -37,8 +41,9 @@ from .server import ModelServer
 from .supervisor import ReplicaSupervisor
 
 __all__ = [
-    "LatencyHistogram", "MicroBatcher", "ModelRegistry", "ModelServer",
-    "Replica", "ReplicaSupervisor", "Scored", "ServeMetrics",
-    "ServingModel", "ShedError",
+    "DataFault", "InputContract", "LatencyHistogram", "MicroBatcher",
+    "ModelRegistry", "ModelServer", "Replica", "ReplicaSupervisor",
+    "Scored", "ServeMetrics", "ServingModel", "ShedError",
     "bucket_for", "prometheus_replica_text", "shape_buckets",
+    "validation_enabled",
 ]

@@ -33,11 +33,48 @@ import time
 from concurrent.futures import Future
 from typing import Any, Dict, List, NamedTuple, Optional
 
+from ..obs import registry as obs_registry
 from ..obs import trace
+from ..resilience import inject as _inject
+from ..resilience import quarantine as _quar
 from ..resilience import retry as _retry
+from ..resilience.quarantine import DataFault
+from . import contract as _contract
 from .metrics import ServeMetrics
 from .registry import ModelRegistry, bucket_for
 from .supervisor import ReplicaSupervisor
+
+_rscope = obs_registry.scope("resilience")
+
+#: exception classes that indicate the MACHINE failed, not the data —
+#: these keep the legacy breaker/fallback path.  Injected faults carry a
+#: ``transient`` attribute and are system faults by construction.
+_SYSTEM_FAULTS = (ConnectionError, TimeoutError, OSError, MemoryError)
+
+
+def _is_system_fault(e: BaseException) -> bool:
+    if isinstance(e, DataFault):
+        return False
+    if getattr(e, "transient", None) is not None:
+        return True
+    return isinstance(e, _SYSTEM_FAULTS)
+
+
+def _poisoned(entry, record: Dict[str, Any], kind: str) -> Dict[str, Any]:
+    """One chaos-poisoned copy of ``record``: garbage planted in a numeric
+    field the model actually reads (contract-guided so the poison cannot
+    be silently ignored by extraction)."""
+    contract = getattr(entry, "contract", None)
+    names = contract.numeric_field_names if contract is not None else []
+    if names:
+        name = names[0]
+    elif record:
+        name = next(iter(record))
+    else:
+        name = "__poison__"
+    out = dict(record)
+    out[name] = _inject.garbage_value(kind)
+    return out
 
 
 class ShedError(RuntimeError):
@@ -145,8 +182,19 @@ class MicroBatcher:
 
     # ---- admission ---------------------------------------------------------
     def submit(self, record: Dict[str, Any]) -> "Future[Scored]":
-        """Enqueue one record; sheds with ``ShedError`` when the queue is full."""
+        """Enqueue one record; sheds with ``ShedError`` when the queue is
+        full, raises :class:`DataFault` when the record violates the active
+        model's input contract (the admission half of validation — cheap
+        per-record shape checks; the vectorized finiteness sweep runs on
+        the assembled batch in ``_dispatch``)."""
         self.metrics.inc("requests")
+        contract = self._active_contract()
+        if contract is not None:
+            try:
+                contract.check_record(record)
+            except DataFault as fault:
+                self._note_data_fault(record, fault)
+                raise
         with self._admit_lock:
             if self._outstanding >= self._capacity:
                 self.metrics.inc("shed")
@@ -161,6 +209,27 @@ class MicroBatcher:
     def _release_admission(self, _future) -> None:
         with self._admit_lock:
             self._outstanding -= 1
+
+    def _active_contract(self):
+        """The active model's InputContract, or None when validation is
+        off, no model is deployed, or the model predates contracts."""
+        if not _contract.validation_enabled():
+            return None
+        try:
+            return getattr(self.registry.active(), "contract", None)
+        except Exception:
+            return None
+
+    def _note_data_fault(self, record, fault: DataFault) -> None:
+        """Count + dead-letter one rejected record.  Deliberately does NOT
+        touch ``errors``, the breaker, the supervisor, or the SLO burn —
+        a poison record is the client's fault, not the replica's."""
+        self.metrics.inc("data_faults")
+        self.metrics.inc("quarantined")
+        _rscope.inc("data_faults")
+        _quar.store().put("serve", fault.reason, index=fault.index,
+                          field=fault.field, record=record,
+                          detail=fault.detail)
 
     def score(self, record: Dict[str, Any],
               timeout_s: Optional[float] = 30.0) -> Dict[str, Any]:
@@ -243,10 +312,34 @@ class MicroBatcher:
             self.metrics.inc("errors", len(batch))
             return
         entry = rep.owner
-        n = len(batch)
-        bucket = bucket_for(n, entry.buckets)
-        records = [p.record for p in batch] + [{} for _ in range(bucket - n)]
         sup = self.supervisor
+        # ---- data-plane pre-pass: chaos poison, then batch validation ------
+        if _inject.active():
+            for idx, kind in _inject.poison_plan("serve.score", len(batch),
+                                                 key=slot):
+                batch[idx] = batch[idx]._replace(
+                    record=_poisoned(entry, batch[idx].record, kind))
+        quarantined = 0
+        contract = getattr(entry, "contract", None)
+        if contract is not None and _contract.validation_enabled():
+            pre = contract.check_batch([p.record for p in batch], len(batch))
+            clean: List[_Pending] = []
+            for p, fault in zip(batch, pre):
+                if fault is None:
+                    clean.append(p)
+                else:
+                    self._note_data_fault(p.record, fault)
+                    p.future.set_exception(fault)
+                    quarantined += 1
+        else:
+            clean = batch
+        if not clean:
+            ctx.__exit__(None, None, None)
+            self.metrics.observe_records([], (), quarantined=quarantined)
+            return
+        n = len(clean)
+        bucket = bucket_for(n, entry.buckets)
+        records = [p.record for p in clean] + [{} for _ in range(bucket - n)]
         brk = sup.breaker(slot)
         t0 = time.monotonic()
         try:
@@ -257,24 +350,46 @@ class MicroBatcher:
                     # replica — degraded mode, host numpy row path (reduced
                     # throughput, zero downtime)
                     self.metrics.inc("degraded_batches")
-                    outputs = self._fallback(entry, batch)
+                    outputs = self._fallback(entry, clean)
                 else:
                     try:
                         outputs = _retry.with_retry(
                             "serve.score", rep.score, records)[:n]
                         sup.note_success(slot)
-                    except Exception as e:  # noqa: BLE001 — breaker decides
-                        sup.note_failure(slot, e)
-                        outputs = self._fallback(entry, batch)
+                    except Exception as e:  # noqa: BLE001 — classified below
+                        if _is_system_fault(e):
+                            # machine fault: the breaker decides, exactly as
+                            # before contracts existed
+                            sup.note_failure(slot, e)
+                            outputs = self._fallback(entry, clean)
+                        else:
+                            # data-shaped batch failure: bisect to isolate
+                            # the offending rows instead of blaming the chip
+                            outputs = self._bisect(rep, entry, clean)
+                            if outputs is None:
+                                # every row failed (or a system fault broke
+                                # the bisection): that's the model/machine,
+                                # not the data — legacy path
+                                sup.note_failure(slot, e)
+                                outputs = self._fallback(entry, clean)
+                            else:
+                                sup.note_success(slot)
         finally:
             ctx.__exit__(None, None, None)
         batch_ms = (time.monotonic() - t0) * 1000.0
         self.metrics.observe_batch(batch_ms, n, bucket, replica=rep.slot,
                                    device=str(rep.device))
-        self.metrics.observe_records([p.record for p in batch], outputs)
+        faulted = {i for i, out in enumerate(outputs)
+                   if isinstance(out, DataFault)}
+        self.metrics.observe_records(
+            [p.record for i, p in enumerate(clean) if i not in faulted],
+            outputs, quarantined=quarantined + len(faulted))
         done = time.monotonic()
-        for p, out in zip(batch, outputs):
-            if isinstance(out, Exception):
+        for i, (p, out) in enumerate(zip(clean, outputs)):
+            if isinstance(out, DataFault):
+                self._note_data_fault(p.record, out)
+                p.future.set_exception(out)
+            elif isinstance(out, Exception):
                 self.metrics.inc("errors")
                 p.future.set_exception(out)
             else:
@@ -285,6 +400,50 @@ class MicroBatcher:
                 trace.complete("serve.request", p.enqueued_at, done,
                                bucket=bucket)
                 p.future.set_result(Scored(entry.version, out))
+
+    def _bisect(self, rep, entry, items: List[_Pending]
+                ) -> Optional[List[Any]]:
+        """Batch scoring failed with a data-shaped error: recursively halve
+        the batch to isolate the offending rows.  Clean sub-batches keep
+        their scores (row-wise scoring makes the bucket size value-
+        irrelevant); a failing single row becomes a :class:`DataFault`.
+        Returns outputs aligned with ``items``, or None when every row
+        fails or a system fault interrupts — those mean the machine or the
+        model is sick and the caller keeps the legacy breaker path."""
+        outputs: List[Any] = [None] * len(items)
+
+        def attempt(idxs: List[int]) -> List[Any]:
+            recs = [items[i].record for i in idxs]
+            b = bucket_for(len(idxs), entry.buckets)
+            return rep.score(recs + [{} for _ in range(b - len(idxs))]
+                             )[:len(idxs)]
+
+        def go(idxs: List[int]) -> None:
+            _rscope.inc("bisect_probes")
+            try:
+                outs = attempt(idxs)
+            except Exception as e:  # noqa: BLE001 — classified here
+                if _is_system_fault(e):
+                    raise
+                if len(idxs) == 1:
+                    outputs[idxs[0]] = DataFault(
+                        "score_failure", index=idxs[0],
+                        detail=repr(e)[:160])
+                    return
+                mid = len(idxs) // 2
+                go(idxs[:mid])
+                go(idxs[mid:])
+                return
+            for i, o in zip(idxs, outs):
+                outputs[i] = o
+
+        try:
+            go(list(range(len(items))))
+        except Exception:  # noqa: BLE001 — system fault mid-bisection
+            return None
+        if all(isinstance(o, DataFault) for o in outputs):
+            return None
+        return outputs
 
     def _fallback(self, entry, batch: List[_Pending]) -> List[Any]:
         """Vectorized path failed: numpy row path, one record at a time."""

@@ -53,6 +53,12 @@ class ServeMetrics:
         self.responses = 0
         self.shed = 0
         self.errors = 0
+        # Data-fault counters: deliberately PARALLEL to ``errors`` — a
+        # poison record is the client's fault, not the replica's, so it
+        # must not burn the SLO error budget (slo_sample excludes these)
+        # or feed the breaker/rollback error rates.
+        self.data_faults = 0
+        self.quarantined = 0
         self.fallback_records = 0
         self.fallback_batches = 0
         self.degraded_batches = 0
@@ -126,16 +132,26 @@ class ServeMetrics:
         with self._lock:
             self._sketch = sketch
 
-    def observe_records(self, records, outputs=()) -> None:
+    def observe_records(self, records, outputs=(), quarantined: int = 0) -> None:
         """Fold scored records (+ outputs, for the prediction sketch) into
-        the attached drift sketch.  Never raises — drift accounting must not
-        take down the serving path."""
+        the attached drift sketch.  ``records`` must already EXCLUDE
+        quarantined rows (their garbage would poison the baselines
+        comparison); ``quarantined`` feeds the ``__quarantined__``
+        pseudo-feature so a quarantine-rate spike registers as drift.
+        Never raises — drift accounting must not take down the serving
+        path."""
         with self._lock:
             sketch = self._sketch
         if sketch is None:
             return
         try:
-            sketch.observe(records, outputs)
+            sketch.observe(records, outputs, quarantined=quarantined)
+        except TypeError:
+            # an older/foreign sketch without the quarantined parameter
+            try:
+                sketch.observe(records, outputs)
+            except Exception:
+                obs_registry.record_fallback("serve", "drift_sketch_failed")
         except Exception:
             obs_registry.record_fallback("serve", "drift_sketch_failed")
 
@@ -145,6 +161,7 @@ class ServeMetrics:
         this instance's lock; the accumulator is provider-local)."""
         with self._lock:
             for k in ("requests", "responses", "shed", "errors",
+                      "data_faults", "quarantined",
                       "fallback_records", "fallback_batches",
                       "degraded_batches", "replica_failures",
                       "replica_rebuilds", "batches",
@@ -183,6 +200,8 @@ class ServeMetrics:
                 "responses": self.responses,
                 "shed": self.shed,
                 "errors": self.errors,
+                "data_faults": self.data_faults,
+                "quarantined": self.quarantined,
                 "fallback_records": self.fallback_records,
                 "fallback_batches": self.fallback_batches,
                 "degraded_batches": self.degraded_batches,
@@ -229,6 +248,7 @@ def merged_snapshot() -> Dict[str, Any]:
     This is ``obs.snapshot()["serve"]``."""
     acc: Dict[str, Any] = {
         k: 0 for k in ("requests", "responses", "shed", "errors",
+                       "data_faults", "quarantined",
                        "fallback_records", "fallback_batches",
                        "degraded_batches", "replica_failures",
                        "replica_rebuilds", "batches",

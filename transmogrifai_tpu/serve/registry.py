@@ -169,6 +169,18 @@ class ServingModel:
         self.batch = BatchScoreFunction(model)
         self._default_batch = self.batch
         self.row = ScoreFunction(model)
+        # Per-version input contract (serve/contract.py), derived once at
+        # deploy time from the model's feature metadata + training stats.
+        # Guarded: a model the contract can't be derived from still serves
+        # (validation simply has nothing to enforce).
+        try:
+            from .contract import InputContract
+
+            self.contract = InputContract.from_model(model)
+        except Exception as e:  # noqa: BLE001 — serving beats validating
+            self.contract = None
+            obs_registry.record_fallback("serve", "contract_derivation_failed",
+                                         version=version, error=repr(e))
         self.buckets = list(buckets)
         if devices is None:
             from ..parallel.mesh import serve_devices
@@ -340,6 +352,9 @@ class ModelRegistry:
                                else active.deployed_at_ms),
             "versions": list(self._history),
             "buckets": list(self.buckets),
+            "contract": (None if active is None
+                         or getattr(active, "contract", None) is None
+                         else {"fields": len(active.contract.fields)}),
             "replicas": len(slots),
             "replica_info": [
                 None if r is None else {

@@ -10,6 +10,10 @@ Endpoints:
 
 - ``POST /score``   — body: one record object, a list of records, or
   ``{"records": [...]}``; response carries the scoring model's version.
+  Records violating the active model's input contract fail PER ROW: the
+  response is HTTP 422 with ``errors`` entries ``{"index", "reason", ...}``
+  and ``scores`` still filled for the valid co-batched rows (a non-list
+  body or non-dict list item is a structural 400, also row-indexed).
 - ``POST /models``  — hot-swap: ``{"path": "<saved model dir>",
   "version": "v2"?}`` loads, warms and atomically swaps via the registry.
 - ``GET /metrics``  — serve metrics snapshot + registry/queue state;
@@ -28,6 +32,7 @@ from typing import Any, Dict, Optional
 from urllib.parse import parse_qs, urlsplit
 
 from .. import obs
+from ..resilience.quarantine import DataFault
 from .batcher import MicroBatcher, ShedError
 from .metrics import ServeMetrics, prometheus_replica_text
 from .registry import ModelRegistry
@@ -197,30 +202,71 @@ def _make_handler(server: "ModelServer"):
             single = isinstance(body, dict) and "records" not in body
             records = [body] if single else \
                 (body["records"] if isinstance(body, dict) else body)
-            if not isinstance(records, list) or \
-                    not all(isinstance(r, dict) for r in records):
+            if not isinstance(records, list):
                 self._reply(400, {"error": "expected a record object, a list "
                                            "of records, or {\"records\": [...]}"})
                 return
+            structural = [
+                {"index": i, "reason": "not_an_object",
+                 "detail": type(r).__name__}
+                for i, r in enumerate(records) if not isinstance(r, dict)]
+            if structural:
+                # malformed request STRUCTURE (not record values): reject
+                # the body with the offending row indices, never a 500
+                self._reply(400, {"error": "expected a record object, a list "
+                                           "of records, or {\"records\": [...]}",
+                                  "errors": structural})
+                return
+            futures: list = [None] * len(records)
+            row_errors: list = []
             try:
-                futures = [server.batcher.submit(r) for r in records]
+                for i, r in enumerate(records):
+                    try:
+                        futures[i] = server.batcher.submit(r)
+                    except DataFault as e:
+                        d = e.to_json()
+                        d["index"] = i
+                        row_errors.append(d)
             except ShedError as e:
                 self._reply(429, {"error": str(e), "shed": True})
                 return
-            try:
-                scored = [f.result(server.request_timeout_s) for f in futures]
-            except (FutureTimeoutError, TimeoutError):
-                self._reply(503, {"error": "scoring timed out"})
-                return
-            except Exception as e:  # noqa: BLE001 — surface scoring errors as 500
-                self._reply(500, {"error": str(e)})
-                return
-            version = scored[-1].version if scored else None
-            if single:
-                self._reply(200, {"score": scored[0].output,
+            outputs: list = [None] * len(records)
+            version = None
+            for i, f in enumerate(futures):
+                if f is None:
+                    continue
+                try:
+                    s = f.result(server.request_timeout_s)
+                    outputs[i] = s.output
+                    version = s.version
+                except (FutureTimeoutError, TimeoutError):
+                    self._reply(503, {"error": "scoring timed out"})
+                    return
+                except DataFault as e:
+                    # per-row data fault (admission/batch validation or
+                    # bisection): fail THIS row, keep its batchmates
+                    d = e.to_json()
+                    d["index"] = i
+                    row_errors.append(d)
+                except Exception as e:  # noqa: BLE001 — system errors stay 500
+                    self._reply(500, {"error": str(e)})
+                    return
+            if version is None:
+                version = server.registry.active_version()
+            if row_errors:
+                row_errors.sort(key=lambda d: d["index"])
+                payload = {"error": f"{len(row_errors)} of {len(records)} "
+                                    "record(s) rejected",
+                           "errors": row_errors,
+                           "model_version": version}
+                if not single:
+                    payload["scores"] = outputs
+                self._reply(422, payload)
+            elif single:
+                self._reply(200, {"score": outputs[0],
                                   "model_version": version})
             else:
-                self._reply(200, {"scores": [s.output for s in scored],
+                self._reply(200, {"scores": outputs,
                                   "model_version": version})
 
         def _deploy(self):

@@ -57,6 +57,7 @@ from ..obs import registry as obs_registry
 from ..obs import trace
 from ..resilience import checkpoint as _ckpt
 from ..resilience import inject as _inject
+from ..resilience import quarantine as _quar
 from ..resilience import retry as _retry
 from ..utils import env
 
@@ -143,7 +144,7 @@ _stream_scope = obs_registry.scope("stream", defaults=dict(
     bytes_in=0.0, bytes_out=0.0, compiles=0,
     device_handoffs=0, handoff_bytes=0.0,
     upload_s=0.0, pull_wait_s=0.0, wall_s=0.0,
-    checkpoint_skips=0,
+    checkpoint_skips=0, quarantined=0,
     autotune={}, fallbacks=[],
 ))
 
@@ -472,6 +473,78 @@ def chunk_args(plan: StreamPlan, ds: Dataset, lo: int, hi: int,
     return _host_chunk_args(plan, ds, lo, hi, C)
 
 
+def _apply_stream_poison(plan: "StreamPlan", host_args: Dict[str, Any],
+                         lo: int, rows: int) -> None:
+    """Chaos hook (site ``stream.upload`` with a ``poison`` rule): corrupt
+    the planted rows of this chunk's upload buffers in place, BEFORE the
+    quarantine scan, so the scan is exercised against real garbage.  A
+    float32 column can't hold type/text garbage, so those kinds map to NaN
+    (``garbage_value`` does the mapping) — the same artifact a reader-side
+    coercion failure produces."""
+    names = plan.base_numeric
+    if not names:
+        return
+    for idx, kind in _inject.poison_plan("stream.upload", rows, key=lo):
+        nm = names[idx % len(names)]
+        g = _inject.garbage_value(kind)
+        bad = np.float32(g) if isinstance(g, float) else np.float32("nan")
+        host_args[f"nv:{nm}"][idx] = bad
+        host_args[f"nm:{nm}"][idx] = True
+
+
+def _quarantine_chunk(plan: "StreamPlan", host_args: Dict[str, Any],
+                      lo: int, rows: int, pol: str) -> int:
+    """``TMOG_QUARANTINE`` row policy over one chunk's upload buffers.
+
+    A row is bad when any present (mask-True) numeric value, or any cell of
+    a vector column, is non-finite.  ``strict`` raises at the first bad
+    row; ``fail`` audits every bad row then raises; ``drop`` audits the
+    row, then zeroes + masks it out of every upload buffer so the fused
+    program treats it exactly like tail padding (numeric outputs masked
+    null, vector outputs zero).  Returns the number of rows dropped."""
+    bad = np.zeros(rows, bool)
+    culprit: Dict[int, str] = {}
+    for nm in plan.base_numeric:
+        hit = host_args[f"nm:{nm}"][:rows] & \
+            ~np.isfinite(host_args[f"nv:{nm}"][:rows])
+        for i in np.nonzero(hit & ~bad)[0]:
+            culprit[int(i)] = nm
+        bad |= hit
+    for nm in plan.base_vector:
+        v = host_args[f"bv:{nm}"][:rows]
+        hit = ~np.isfinite(v).reshape(rows, -1).all(axis=1)
+        for i in np.nonzero(hit & ~bad)[0]:
+            culprit[int(i)] = nm
+        bad |= hit
+    if not bad.any():
+        return 0
+    rows_bad = [int(i) for i in np.nonzero(bad)[0]]
+    dls = _quar.store()
+    if pol == "strict":
+        i = rows_bad[0]
+        dls.put("stream", "non_finite", index=lo + i, field=culprit.get(i),
+                detail=f"chunk@{lo} row {i} (strict)")
+        raise _quar.DataFault("non_finite", index=lo + i,
+                              field=culprit.get(i),
+                              detail=f"TMOG_QUARANTINE=strict, chunk@{lo}")
+    for i in rows_bad:
+        dls.put("stream", "non_finite", index=lo + i, field=culprit.get(i),
+                detail=f"chunk@{lo} row {i}")
+    if pol == "fail":
+        raise _quar.DataFault(
+            "non_finite", index=lo + rows_bad[0],
+            field=culprit.get(rows_bad[0]),
+            detail=f"{len(rows_bad)} bad row(s) in chunk@{lo}, "
+                   "TMOG_QUARANTINE=fail")
+    for nm in plan.base_numeric:
+        host_args[f"nv:{nm}"][rows_bad] = np.float32(0.0)
+        host_args[f"nm:{nm}"][rows_bad] = False
+    for nm in plan.base_vector:
+        host_args[f"bv:{nm}"][rows_bad] = np.float32(0.0)
+    _stream_scope.inc("quarantined", len(rows_bad))
+    return len(rows_bad)
+
+
 # ---------------------------------------------------------------------------
 # Device-view registry (model-selector handoff)
 # ---------------------------------------------------------------------------
@@ -666,6 +739,15 @@ def execute(plan: StreamPlan, ds: Dataset) -> Dict[str, Any]:
                         _stream_scope.inc("checkpoint_skips")
                         restored += 1
                         continue
+                # data-plane hardening: poison injection, then the
+                # TMOG_QUARANTINE row scan.  Both are zero-work when chaos
+                # is off and the policy is unset — the chunk buffers are
+                # untouched, keeping the legacy path bit-identical.
+                if _inject.active():
+                    _apply_stream_poison(plan, host_args, lo, rows)
+                pol = _quar.policy()
+                if pol:
+                    _quarantine_chunk(plan, host_args, lo, rows, pol)
 
                 def _go():
                     _inject.maybe_fail("stream.upload", key=lo)
