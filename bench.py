@@ -457,6 +457,87 @@ def continual_bench():
     obs.write_record("bench", extra={"report": report})
 
 
+def asha_bench():
+    """``bench.py --asha [n_candidates]``: rung-scheduled search vs grid.
+
+    The successive-halving acceptance pair: ASHA over a 500+ candidate
+    superset of the stock binary space must (1) finish within a small
+    multiple of the exhaustive 28-grid wall — that ratio is the perfgate
+    metric (lower-better) — and (2) re-elect the exhaustive winner's
+    family with a best metric inside a pinned tolerance (the parity
+    metric, higher-better).  Both sides get one warmup pass so the timed
+    walls compare steady executions, not compile queues.  CPU-proxy
+    friendly.
+    """
+    from transmogrifai_tpu.impl.selector.defaults import asha_search_space
+    from transmogrifai_tpu.impl.selector.factories import (
+        BinaryClassificationModelSelector)
+
+    platform, fallback = init_backend()
+    n_cands = next((int(a) for a in sys.argv[2:] if a.isdigit()), 500)
+    X, y = titanic_arrays()
+
+    # exhaustive reference: the stock 28-grid (warm pass compiles)
+    make_selector(seed=7).find_best_estimator(X, y)
+    t0 = time.perf_counter()
+    _, _, grid_summary = make_selector(seed=101).find_best_estimator(X, y)
+    grid_s = time.perf_counter() - t0
+    n_grid = len(grid_summary.results)
+
+    def asha_selector(seed):
+        return BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=3, seed=seed,
+            models_and_parameters=asha_search_space(n_cands),
+            search_strategy="asha")
+
+    asha_selector(7).find_best_estimator(X, y)  # warm pass
+    t0 = time.perf_counter()
+    _, _, asha_summary = asha_selector(101).find_best_estimator(X, y)
+    asha_s = time.perf_counter() - t0
+
+    rungs = asha_summary.asha["rungs"]
+    gb, ab = grid_summary.best, asha_summary.best
+    winner_match = gb.model_name == ab.model_name
+    metric_delta = abs(float(ab.metric_value) - float(gb.metric_value))
+    evaluated = sum(r["candidates_in"] for r in rungs)
+
+    wall_report = {
+        "metric": "asha_500_vs_grid28_wall_ratio",
+        "value": round(asha_s / max(grid_s, 1e-9), 3),
+        "unit": f"x wall, {len(asha_summary.results)}-candidate ASHA vs "
+                f"{n_grid}-grid exhaustive",
+        "asha_wall_s": round(asha_s, 3),
+        "grid_wall_s": round(grid_s, 3),
+        "n_candidates": len(asha_summary.results),
+        "n_grid": n_grid,
+        "rungs_run": len(rungs),
+        "reduction": asha_summary.asha["reduction"],
+        "async": asha_summary.asha["async"],
+        "candidate_evals": evaluated,
+        "platform": platform,
+        **({"backend_fallback": fallback} if fallback else {}),
+    }
+    parity_report = {
+        "metric": "asha_best_metric_parity",
+        "value": round(max(0.0, 1.0 - metric_delta), 4),
+        "unit": "1 - |asha best - grid best| (same evaluator)",
+        "winner_match": 1.0 if winner_match else 0.0,
+        "grid_winner": gb.model_name,
+        "grid_best_metric": round(float(gb.metric_value), 4),
+        "asha_winner": ab.model_name,
+        "asha_best_metric": round(float(ab.metric_value), 4),
+        "metric_delta": round(metric_delta, 4),
+        "platform": platform,
+        **({"backend_fallback": fallback} if fallback else {}),
+    }
+    print(json.dumps(wall_report))
+    print(json.dumps(parity_report))
+    from transmogrifai_tpu import obs
+
+    obs.write_record("bench", extra={"report": wall_report})
+    obs.write_record("bench", extra={"report": parity_report})
+
+
 def family_flops_breakdown(sel, X, y, train_w, val_mask):
     """Per-family single-launch XLA flops of the default sweep (LR/RF/XGB).
 
@@ -707,5 +788,7 @@ if __name__ == "__main__":
         serve_bench()
     elif "--continual" in sys.argv:
         continual_bench()
+    elif "--asha" in sys.argv:
+        asha_bench()
     else:
         main()

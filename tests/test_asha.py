@@ -1,0 +1,374 @@
+"""ASHA rung scheduler: schedules, margin resume, async dispatch, parity.
+
+The successive-halving search (transmogrifai_tpu/search/) contracts under
+test:
+
+- rung schedules end at full budget, saturate rows one rung early (the
+  margin-resume precondition) and respect the TMOG_ASHA_* knobs;
+- ``GbtLadder`` segment fits are bit-identical to a cold fit at equal
+  total rounds (rw/fms drawn up-front, margins carried);
+- on a seeded candidate space, ASHA re-elects the exhaustive sweep's
+  winner family with a best metric inside a pinned tolerance, while the
+  default ``search_strategy="grid"`` path stays bit-identical to
+  ``validator.validate``;
+- asynchronous per-family rungs survive an injected family error
+  (hedged re-dispatch) without deadlocking the search;
+- ``RandomParamBuilder.subset(n)`` is deterministic across processes and
+  independent of axis declaration order.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from transmogrifai_tpu.evaluators.classification import \
+    OpBinaryClassificationEvaluator
+from transmogrifai_tpu.impl.classification.logistic import OpLogisticRegression
+from transmogrifai_tpu.impl.classification.trees import OpXGBoostClassifier
+from transmogrifai_tpu.impl.selector.defaults import RandomParamBuilder
+from transmogrifai_tpu.impl.selector.model_selector import ModelSelector
+from transmogrifai_tpu.impl.tuning.validators import (OpCrossValidation,
+                                                      ValidationSummary)
+from transmogrifai_tpu.obs import registry as obs_registry
+from transmogrifai_tpu.ops import trees as Tr
+from transmogrifai_tpu.ops import sweep as sweep_ops
+from transmogrifai_tpu.resilience import GbtLadder, inject
+from transmogrifai_tpu.search import (CandidateLadder, build_schedule,
+                                      promote_count, run_asha, scale_rounds)
+
+
+# ---------------------------------------------------------------------------
+# data + candidates
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(29)
+    n, d = 360, 6
+    X = np.ascontiguousarray(rng.normal(size=(n, d)).astype(np.float32))
+    beta = rng.normal(size=d)
+    z = X @ beta
+    y = (z + 0.25 * rng.normal(size=n) > 0).astype(np.float32)
+    return X, y
+
+
+def _base_space():
+    """A light 10-candidate exhaustive space (the '28-grid' analog)."""
+    return [
+        (OpLogisticRegression(max_iter=30),
+         [{"reg_param": r, "elastic_net_param": e}
+          for r in (0.001, 0.01, 0.1, 0.3) for e in (0.0, 0.5)]),
+        (OpXGBoostClassifier(num_round=8, max_depth=3),
+         [{"eta": 0.1}, {"eta": 0.3}]),
+    ]
+
+
+def _superset_space(n_extra=54):
+    """The base space grown to 64 candidates with seeded random draws."""
+    space = _base_space()
+    lr_n = n_extra - n_extra // 4
+    space[0][1].extend(
+        RandomParamBuilder(5)
+        .exponential("reg_param", 1e-4, 0.5)
+        .uniform("elastic_net_param", 0.0, 1.0)
+        .subset(lr_n))
+    space[1][1].extend(
+        RandomParamBuilder(6)
+        .exponential("eta", 0.02, 0.5)
+        .subset(n_extra - lr_n))
+    return space
+
+
+def _cv(seed=13):
+    return OpCrossValidation(OpBinaryClassificationEvaluator(), num_folds=3,
+                             seed=seed, mesh=None)
+
+
+# ---------------------------------------------------------------------------
+# rung schedules
+
+
+def test_schedule_ends_full_and_saturates_rows_early():
+    sched = build_schedule(96, 10_000, eta=3)
+    assert sched[-1].subsample_frac == 1.0 and sched[-1].rounds_frac == 1.0
+    # rows saturate one rung before the end: the last TWO rungs share the
+    # identical full row set (margin-resume precondition)
+    assert sched[-2].subsample_frac == 1.0
+    assert sched[-2].rounds_frac < 1.0
+    # budgets are monotone
+    fr = [r.subsample_frac for r in sched]
+    rf = [r.rounds_frac for r in sched]
+    assert fr == sorted(fr) and rf == sorted(rf)
+    assert [r.index for r in sched] == list(range(len(sched)))
+
+
+def test_schedule_row_floor_merges_duplicate_rungs():
+    # 60 rows: every sub-saturation fraction clips to the 64-row floor ->
+    # no two rungs may repeat the same (rows, rounds<1) budget
+    sched = build_schedule(500, 60, eta=3)
+    seen = set()
+    for r in sched[:-1]:
+        key = (r.subsample_frac, r.rounds_frac < 1.0 and r.rounds_frac)
+        assert key not in seen
+        seen.add(key)
+    assert sched[-1].is_final
+
+
+def test_schedule_knobs_and_degenerate_cases(monkeypatch):
+    assert build_schedule(1, 1000) == build_schedule(0, 1000)
+    assert len(build_schedule(1, 1000)) == 1
+    assert build_schedule(1, 1000)[0].is_final
+    monkeypatch.setenv("TMOG_ASHA_MAX_RUNGS", "2")
+    sched = build_schedule(729, 10_000)
+    assert len(sched) == 2 and sched[-1].is_final
+    monkeypatch.setenv("TMOG_ASHA_REDUCTION", "4")
+    assert promote_count(16) == 4
+    assert promote_count(1) == 1
+    assert promote_count(0) == 0
+
+
+def test_scale_rounds_targets_the_right_param():
+    xgb = OpXGBoostClassifier(num_round=100)
+    g = scale_rounds(xgb, {"eta": 0.1}, 0.25)
+    assert g["num_round"] == 25 and g["eta"] == 0.1
+    assert scale_rounds(xgb, {"num_round": 40}, 0.1)["num_round"] == 4
+    # frac >= 1 and non-boosted families: untouched copies
+    assert scale_rounds(xgb, {"num_round": 40}, 1.0) == {"num_round": 40}
+    lr = OpLogisticRegression(max_iter=50)
+    assert scale_rounds(lr, {"reg_param": 0.1}, 0.1) == {"reg_param": 0.1}
+
+
+# ---------------------------------------------------------------------------
+# margin-resume bit-parity
+
+
+def test_gbt_ladder_bit_identical_to_cold_fit(data):
+    X, y = data
+    n, d = X.shape
+    total = 8
+    Xb, _ = Tr.quantize(X, 16)
+    ks, kf = Tr.rng_keys(3)
+    rw = Tr.subsample_weights(ks, n, total, 0.8)
+    fms = Tr.feature_masks(kf, d, total, 1.0)
+    kw = dict(loss="logistic", max_depth=3, n_bins=16, frontier=8,
+              eta=0.3, reg_lambda=1.0, gamma=0.0, min_child_weight=1.0,
+              n_classes=2)
+    w = jnp.ones(n, jnp.float32)
+    ladder = GbtLadder(Tr.fit_gbt, jnp.asarray(Xb), jnp.asarray(y), w,
+                       rw, fms, **kw)
+    ladder.advance(3)
+    assert ladder.rounds_done == 3
+    trees_seg, F_seg = ladder.advance(total)
+    cold_trees, F_cold = Tr.fit_gbt(jnp.asarray(Xb), jnp.asarray(y), w,
+                                    rw, fms, n_rounds=total, **kw)
+    np.testing.assert_array_equal(np.asarray(F_seg), np.asarray(F_cold))
+    for a, b in zip(jax.tree_util.tree_leaves(trees_seg),
+                    jax.tree_util.tree_leaves(cold_trees)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # advance is idempotent at the target: no extra device work, same state
+    trees2, F2 = ladder.advance(total)
+    np.testing.assert_array_equal(np.asarray(F2), np.asarray(F_seg))
+
+
+def test_candidate_ladder_matches_cold_sweep_metric(data):
+    """CandidateLadder's staged metric at full rounds == the validator's
+    cold-sweep metric for the same candidate (equal total rounds)."""
+    X, y = data
+    cv = _cv()
+    est = OpXGBoostClassifier(num_round=8, max_depth=3)
+    grid = {"eta": 0.3}
+    train_w, val_mask = cv.make_folds(len(y), None)
+    ladder = CandidateLadder(est, grid, X, y, train_w)
+    ladder.metrics_at(0.375, cv.evaluator, y, val_mask)      # rung hop 1
+    fm_staged = ladder.metrics_at(1.0, cv.evaluator, y, val_mask)
+    s = ValidationSummary(validation_type="t", evaluator_name="e",
+                          metric_name=cv.evaluator.default_metric,
+                          is_larger_better=True)
+    cv._sweep([(est, [grid])], X, y, train_w, val_mask, s)
+    assert s.results[0].error is None
+    # same model bit-for-bit; the metric may differ at float32 kernel
+    # noise between the device sweep and the host margin scorer
+    np.testing.assert_allclose(fm_staged, s.results[0].fold_metrics,
+                               rtol=2e-3, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# election parity + grid-path identity
+
+
+@pytest.mark.slow
+def test_asha_reelects_exhaustive_winner(data, monkeypatch):
+    """Integration-scale parity (both dispatch modes, 64 candidates).
+
+    Marked slow to keep the tier-1 wall down; the tier1.yml ASHA matrix
+    entry re-runs the same contract at 96 candidates on every CI push.
+    """
+    X, y = data
+    exhaustive = _cv(seed=13).validate(_base_space(), X, y)
+    for async_mode in ("0", "1"):
+        monkeypatch.setenv("TMOG_ASHA_ASYNC", async_mode)
+        summary = run_asha(_superset_space(), _cv(seed=13), X, y)
+        assert len(summary.results) == 64
+        assert summary.best.model_name == exhaustive.best.model_name, \
+            f"async={async_mode}"
+        assert abs(summary.best.metric_value
+                   - exhaustive.best.metric_value) < 0.02
+        # the schedule really ran >= 2 rungs with shrinking survivors
+        rungs = summary.asha["rungs"]
+        by_fam = {}
+        for r in rungs:
+            by_fam.setdefault(r["family"], []).append(r["candidates_in"])
+        for fam, counts in by_fam.items():
+            assert len(counts) >= 2
+            assert counts == sorted(counts, reverse=True)
+            assert counts[-1] < counts[0]
+        # the completed rung rows are stamped into the run-stats scope for
+        # downstream telemetry (write_record snapshots)
+        stats = sweep_ops.run_stats()
+        assert stats["asha_rungs"] == rungs
+        assert len(stats["asha_rungs"]) >= 4
+
+
+def test_grid_strategy_bit_identical_to_validate(data):
+    X, y = data
+    sel = ModelSelector(validator=_cv(seed=13), splitter=None,
+                        models=_base_space())
+    assert sel.search_strategy == "grid"
+    est, grid, summary = sel.find_best_estimator(X, y)
+    direct = _cv(seed=13).validate(sel.models, X, y)
+    assert summary.best_index == direct.best_index
+    assert [r.metric_value for r in summary.results] == \
+        [r.metric_value for r in direct.results]
+    with pytest.raises(ValueError):
+        ModelSelector(validator=_cv(), splitter=None, models=_base_space(),
+                      search_strategy="hyperband")
+
+
+# ---------------------------------------------------------------------------
+# async fault tolerance
+
+
+@pytest.mark.slow
+def test_async_rungs_survive_injected_family_error(data, monkeypatch):
+    """A family whose first async attempt dies (TMOG_FAULTS at the
+    search.rung site) is re-dispatched by the hedge layer; the search
+    terminates with a winner instead of deadlocking.
+
+    Marked slow alongside the parity test above — the CI ASHA matrix
+    entry exercises the async dispatch path end-to-end every push.
+    """
+    X, y = data
+    monkeypatch.setenv("TMOG_ASHA_ASYNC", "1")
+    inject.configure("search.rung:error:1:0:0:1")
+    try:
+        summary = run_asha(_superset_space(n_extra=14), _cv(seed=13), X, y)
+    finally:
+        inject.configure("")
+    assert summary.best_index >= 0
+    assert summary.best.error is None
+    faults = [f for f in obs_registry.scope("resilience").list("faults")
+              if f.get("site") == "search.rung"]
+    assert faults, "the injected fault never fired"
+
+
+def test_asha_raises_when_every_family_fails(data, monkeypatch):
+    X, y = data
+    monkeypatch.setenv("TMOG_ASHA_ASYNC", "0")
+    cv = _cv()
+
+    def boom(*a, **k):
+        raise RuntimeError("boom")
+
+    monkeypatch.setattr(cv, "_sweep", boom)
+    models = [(OpLogisticRegression(max_iter=5), [{"reg_param": 0.1}])]
+    with pytest.raises(RuntimeError, match="no candidate survived"):
+        run_asha(models, cv, X, y)
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+
+
+def test_rung_telemetry_gated_and_schema(data, monkeypatch, tmp_path):
+    X, y = data
+    monkeypatch.setenv("TMOG_ASHA_ASYNC", "0")
+    # gated OFF: no telemetry file materializes in cwd
+    monkeypatch.delenv("TMOG_TELEMETRY", raising=False)
+    monkeypatch.chdir(tmp_path)
+    run_asha(_base_space(), _cv(), X, y)
+    assert not (tmp_path / "telemetry.jsonl").exists()
+    # gated ON: one asha_rung row per completed rung, feat carries the
+    # appended FEATURE_NAMES tail
+    rec = tmp_path / "rungs.jsonl"
+    monkeypatch.setenv("TMOG_TELEMETRY", str(rec))
+    summary = run_asha(_base_space(), _cv(), X, y)
+    rows = [json.loads(l) for l in rec.read_text().splitlines() if l.strip()]
+    rung_rows = [r for r in rows if r.get("kind") == "asha_rung"]
+    assert len(rung_rows) == len(summary.asha["rungs"])
+    row = rung_rows[-1]
+    for key in ("rung", "subsample_frac", "rounds_frac", "candidates_in",
+                "candidates_out", "wall_s", "predicted_wall_s"):
+        assert key in row["asha_rung"]
+    assert set(("subsample_frac", "rung_index", "is_resumed")) \
+        <= set(row["feat"])
+    from transmogrifai_tpu.costmodel.features import (feature_vector,
+                                                      rung_samples)
+    # sub-millisecond rungs (pure metric reuse) round to wall_s=0 and are
+    # not usable as cost-model samples — at least the fit rungs survive
+    samples = rung_samples(rows)
+    assert 1 <= len(samples) <= len(rung_rows)
+    assert feature_vector(samples[0]["feat"]).shape[0] >= 24
+
+
+# ---------------------------------------------------------------------------
+# RandomParamBuilder determinism (satellite)
+
+
+def _builder(seed=11):
+    return (RandomParamBuilder(seed)
+            .uniform("u", 0.0, 1.0)
+            .exponential("e", 1e-3, 1.0)
+            .choice("c", ["a", "b", "c"])
+            .int_uniform("i", 1, 9))
+
+
+def test_random_builder_idempotent_and_prefix():
+    b = _builder()
+    first = b.subset(8)
+    assert b.subset(8) == first            # no shared mutable rng state
+    assert b.subset(3) == first[:3]        # growing n keeps the prefix
+    assert _builder().subset(8) == first   # same seed, fresh builder
+    assert _builder(seed=12).subset(8) != first
+
+
+def test_random_builder_axis_order_independent():
+    a = (RandomParamBuilder(11).uniform("u", 0.0, 1.0)
+         .choice("c", ["a", "b", "c"])).subset(6)
+    b = (RandomParamBuilder(11).choice("c", ["a", "b", "c"])
+         .uniform("u", 0.0, 1.0)).subset(6)
+    assert a == b
+
+
+def test_random_builder_deterministic_across_processes():
+    code = (
+        "import json;"
+        "from transmogrifai_tpu.impl.selector.defaults import "
+        "RandomParamBuilder;"
+        "b = RandomParamBuilder(11).uniform('u', 0.0, 1.0)"
+        ".exponential('e', 1e-3, 1.0).choice('c', ['a', 'b', 'c'])"
+        ".int_uniform('i', 1, 9);"
+        "print(json.dumps(b.subset(8)))"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120,
+                         cwd=os.path.dirname(os.path.dirname(
+                             os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout.strip()) == _builder().subset(8)

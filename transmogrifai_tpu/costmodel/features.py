@@ -26,8 +26,9 @@ import numpy as np
 
 __all__ = [
     "FEATURE_NAMES", "FAMILIES", "unit_family", "shard_feature_dict",
-    "feature_vector", "family_units", "cost_feature_dict", "iter_records",
-    "shard_samples",
+    "feature_vector", "family_units", "cost_feature_dict",
+    "rung_feature_dict", "iter_records",
+    "shard_samples", "rung_samples",
     "stream_samples", "synthetic_samples",
 ]
 
@@ -52,6 +53,10 @@ FEATURE_NAMES = (
     # cost_analysis FLOPs + bytes accessed per launch.  Old rows without
     # them vectorize with 0.0 in these slots (missing -> 0.0 contract).
     "log_flops", "log_bytes_accessed", "arith_intensity",
+    # ASHA rung context (search/asha telemetry).  subsample_frac is 0.0 for
+    # pre-ASHA rows (missing -> 0.0), which correctly reads as "not a rung
+    # launch" — full-budget sweep launches carry no rung features at all.
+    "subsample_frac", "rung_index", "is_resumed",
 )
 
 
@@ -135,6 +140,41 @@ def family_units(feat: Dict[str, Any]) -> Dict[str, float]:
     """Raw (de-logged) analytic units per family — the calibration basis."""
     return {f: max(math.expm1(_finite(feat.get(f"log_units_{f}"))), 0.0)
             for f in FAMILIES}
+
+
+def rung_feature_dict(subsample_frac: float, rung_index: int,
+                      is_resumed: bool) -> Dict[str, float]:
+    """ASHA rung-context features (the FEATURE_NAMES tail) stamped into
+    ``asha_rung`` telemetry rows by ``search/asha`` — a resumed rung fits
+    only the margin-delta rounds, so its wall is far below what the static
+    fragment shape alone predicts."""
+    return {
+        "subsample_frac": min(max(_finite(subsample_frac), 0.0), 1.0),
+        "rung_index": max(_finite(rung_index), 0.0),
+        "is_resumed": 1.0 if is_resumed else 0.0,
+    }
+
+
+def rung_samples(rows) -> List[Dict[str, Any]]:
+    """Training samples from recorded ``asha_rung`` rows: one per rung
+    completion carrying a ``feat`` dict and a positive measured wall."""
+    out: List[Dict[str, Any]] = []
+    for row in rows:
+        if not isinstance(row, dict) or row.get("kind") != "asha_rung":
+            continue
+        feat = row.get("feat")
+        rung = row.get("asha_rung")
+        if not isinstance(feat, dict) or not isinstance(rung, dict):
+            continue
+        wall = _finite(rung.get("wall_s"))
+        if wall <= 0:
+            continue
+        merged = dict(feat)
+        for k, v in _row_context(row).items():
+            merged.setdefault(k, v)
+        out.append({"feat": merged, "wall_s": wall, "compile_s": 0.0,
+                    "steady_s": max(wall, 1e-4)})
+    return out
 
 
 def cost_feature_dict(flops: float, bytes_accessed: float) -> Dict[str, float]:

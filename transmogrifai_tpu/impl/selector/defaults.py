@@ -85,13 +85,85 @@ def decision_tree_grid() -> List[Dict[str, Any]]:
                 min_instances_per_node=MIN_INSTANCES_PER_NODE)
 
 
+def default_binary_space() -> List[Tuple[Any, List[Dict[str, Any]]]]:
+    """The stock binary 28-candidate space (LR 8 + RF 18 + XGB 2) — the
+    same models/grids ``BinaryClassificationModelSelector`` defaults to."""
+    from ..classification.logistic import OpLogisticRegression
+    from ..classification.trees import (OpRandomForestClassifier,
+                                        OpXGBoostClassifier)
+
+    return [
+        (OpLogisticRegression(max_iter=50), logistic_regression_grid()),
+        (OpRandomForestClassifier(), random_forest_grid()),
+        (OpXGBoostClassifier(), xgboost_grid()),
+    ]
+
+
+def asha_search_space(n_candidates: int = 500, seed: int = 7
+                      ) -> List[Tuple[Any, List[Dict[str, Any]]]]:
+    """A ``n_candidates``-point binary space for the ASHA scheduler: the
+    stock 28-grid PLUS RandomParamBuilder draws over the same three
+    families — a strict superset of the default space, so exhaustive-grid
+    vs ASHA winner parity is well-defined.
+
+    Random draws vary only non-shape axes (regularization, info gain,
+    child weight, eta) and pick shape params (depth, rounds) from the
+    stock values, so the fused sweep compiles the same static fragment
+    groups as the 28-grid instead of one program per unique depth."""
+    space = default_binary_space()
+    extra = max(0, int(n_candidates)
+                - sum(len(g) for _, g in space))
+    n_lr = extra // 3
+    n_rf = extra // 3
+    n_xgb = extra - n_lr - n_rf
+    if n_lr:
+        space[0][1].extend(
+            RandomParamBuilder(seed)
+            .exponential("reg_param", 1e-4, 0.5)
+            .uniform("elastic_net_param", 0.0, 1.0)
+            .subset(n_lr))
+    if n_rf:
+        space[1][1].extend(
+            RandomParamBuilder(seed + 1)
+            .choice("max_depth", MAX_DEPTH)
+            .exponential("min_info_gain", 1e-4, 0.2)
+            .choice("min_instances_per_node", [10, 25, 100])
+            .choice("num_trees", MAX_TREES)
+            .subset(n_rf))
+    if n_xgb:
+        space[2][1].extend(
+            RandomParamBuilder(seed + 2)
+            .choice("max_depth", XGB_MAX_DEPTH)
+            .exponential("eta", 0.01, 0.3)
+            .uniform("min_child_weight", 1.0, 10.0)
+            .choice("num_round", NUM_ROUND)
+            .choice("gamma", XGB_GAMMA)
+            .subset(n_xgb))
+    return space
+
+
 class RandomParamBuilder:
     """Random hyperparameter search (RandomParamBuilder.scala:52):
-    ``subset(n)`` draws n param dicts from declared distributions."""
+    ``subset(n)`` draws n param dicts from declared distributions.
+
+    Determinism contract: each axis draws from its OWN stream seeded by
+    ``(seed, crc32(axis name))``, so the same seed yields the identical
+    ``subset(n)`` in every process (no dependence on dict hash order or
+    on the order axes were declared), ``subset(n)`` is idempotent (no
+    shared mutable rng state between calls), and ``subset(m)`` for m < n
+    is a prefix of ``subset(n)`` (growing a search space keeps the
+    already-evaluated candidates).
+    """
 
     def __init__(self, seed: int = 42):
         self._axes: List[Tuple[str, Any]] = []
-        self._rng = np.random.default_rng(seed)
+        self._seed = int(seed)
+
+    def _axis_rng(self, name: str) -> np.random.Generator:
+        import zlib
+
+        return np.random.default_rng(
+            [self._seed, zlib.crc32(name.encode("utf-8"))])
 
     def uniform(self, name: str, low: float, high: float) -> "RandomParamBuilder":
         self._axes.append((name, ("uniform", low, high)))
@@ -113,19 +185,23 @@ class RandomParamBuilder:
         return self
 
     def subset(self, n: int) -> List[Dict[str, Any]]:
-        out = []
-        for _ in range(n):
-            d: Dict[str, Any] = {}
-            for name, spec in self._axes:
-                kind = spec[0]
-                if kind == "uniform":
-                    d[name] = float(self._rng.uniform(spec[1], spec[2]))
-                elif kind == "exponential":
-                    d[name] = float(np.exp(self._rng.uniform(np.log(spec[1]),
-                                                             np.log(spec[2]))))
-                elif kind == "choice":
-                    d[name] = spec[1][self._rng.integers(len(spec[1]))]
-                elif kind == "int":
-                    d[name] = int(self._rng.integers(spec[1], spec[2] + 1))
-            out.append(d)
-        return out
+        cols: List[Tuple[str, List[Any]]] = []
+        for name, spec in self._axes:
+            rng = self._axis_rng(name)
+            kind = spec[0]
+            if kind == "uniform":
+                vals = [float(v) for v in rng.uniform(spec[1], spec[2], n)]
+            elif kind == "exponential":
+                vals = [float(v) for v in
+                        np.exp(rng.uniform(np.log(spec[1]), np.log(spec[2]),
+                                           n))]
+            elif kind == "choice":
+                vals = [spec[1][i] for i in rng.integers(len(spec[1]),
+                                                         size=n)]
+            elif kind == "int":
+                vals = [int(v) for v in rng.integers(spec[1], spec[2] + 1,
+                                                     size=n)]
+            else:  # pragma: no cover - axes only come from the methods above
+                raise ValueError(f"unknown axis kind {kind!r}")
+            cols.append((name, vals))
+        return [{name: vals[i] for name, vals in cols} for i in range(n)]

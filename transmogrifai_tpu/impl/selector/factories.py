@@ -68,11 +68,11 @@ class _SelectorFactory:
 
     @classmethod
     def _build(cls, validator, splitter, model_types, models_and_params,
-               evaluators) -> ModelSelector:
+               evaluators, search_strategy: str = "grid") -> ModelSelector:
         sel = ModelSelector(
             validator=validator, splitter=splitter,
             models=cls._models_for(model_types, models_and_params),
-            evaluators=evaluators)
+            evaluators=evaluators, search_strategy=search_strategy)
         sel.problem_type = cls.problem_type
         return sel
 
@@ -84,14 +84,16 @@ class _SelectorFactory:
                               seed: int = 42, stratify: bool = False,
                               parallelism: int = 8,
                               model_types: Optional[Sequence[str]] = None,
-                              models_and_parameters: Optional[Candidates] = None
+                              models_and_parameters: Optional[Candidates] = None,
+                              search_strategy: str = "grid"
                               ) -> ModelSelector:
         ev = validation_metric or cls._default_evaluator()
         return cls._build(
             OpCrossValidation(ev, num_folds=num_folds, seed=seed, stratify=stratify,
                               parallelism=parallelism),
             splitter if splitter is not None else cls._default_splitter(),
-            model_types, models_and_parameters, list(trained_model_evaluators))
+            model_types, models_and_parameters, list(trained_model_evaluators),
+            search_strategy=search_strategy)
 
     @classmethod
     def with_train_validation_split(cls, splitter: Optional[Splitter] = None,
@@ -101,14 +103,16 @@ class _SelectorFactory:
                                     seed: int = 42, stratify: bool = False,
                                     parallelism: int = 8,
                                     model_types: Optional[Sequence[str]] = None,
-                                    models_and_parameters: Optional[Candidates] = None
+                                    models_and_parameters: Optional[Candidates] = None,
+                                    search_strategy: str = "grid"
                                     ) -> ModelSelector:
         ev = validation_metric or cls._default_evaluator()
         return cls._build(
             OpTrainValidationSplit(ev, train_ratio=train_ratio, seed=seed,
                                    stratify=stratify, parallelism=parallelism),
             splitter if splitter is not None else cls._default_splitter(),
-            model_types, models_and_parameters, list(trained_model_evaluators))
+            model_types, models_and_parameters, list(trained_model_evaluators),
+            search_strategy=search_strategy)
 
     @classmethod
     def apply(cls) -> ModelSelector:

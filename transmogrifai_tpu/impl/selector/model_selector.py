@@ -169,6 +169,7 @@ class ModelSelector(BinaryEstimator, AllowLabelAsInput):
     def __init__(self, validator: OpValidator, splitter: Optional[Splitter],
                  models: Sequence[Tuple[PredictorEstimator, Sequence[Dict[str, Any]]]],
                  evaluators: Sequence[OpEvaluatorBase] = (),
+                 search_strategy: str = "grid",
                  uid: Optional[str] = None):
         super().__init__(operation_name="modelSelector", output_type=T.Prediction,
                          uid=uid)
@@ -177,6 +178,13 @@ class ModelSelector(BinaryEstimator, AllowLabelAsInput):
         self.models = [(est, list(grids) or [{}]) for est, grids in models]
         if not self.models:
             raise ValueError("ModelSelector needs at least one candidate model")
+        if search_strategy not in ("grid", "asha"):
+            raise ValueError(f"unknown search_strategy {search_strategy!r} "
+                             "(expected 'grid' or 'asha')")
+        #: "grid" = exhaustive sweep (bit-identical to the pre-search code);
+        #: "asha" = successive-halving rung scheduler (search/asha) for
+        #: candidate spaces too large to fit at full budget
+        self.search_strategy = search_strategy
         self.evaluators = list(evaluators)
         self.validation_summary: Optional[ValidationSummary] = None
         #: pre-selected (estimator, grid, summary) from workflow-level CV —
@@ -200,7 +208,12 @@ class ModelSelector(BinaryEstimator, AllowLabelAsInput):
                             prep_w: Optional[np.ndarray] = None
                             ) -> Tuple[PredictorEstimator, Dict[str, Any],
                                        ValidationSummary]:
-        summary = self.validator.validate(self.models, X, y, prep_w)
+        if self.search_strategy == "asha":
+            from ...search import run_asha
+
+            summary = run_asha(self.models, self.validator, X, y, prep_w)
+        else:
+            summary = self.validator.validate(self.models, X, y, prep_w)
         best = summary.best
         est = next(e for e, _ in self.models if e.uid == best.model_uid)
         return est, best.grid, summary

@@ -58,6 +58,17 @@ POLICIES: Dict[str, Dict[str, int]] = {
         "quarantine_rate": -1, "data_fault_fraction": -1,
     },
     "continual_warm_retrain_speedup": {"value": +1},
+    # ASHA search (PR 16): 500+-candidate rung-scheduled search wall over
+    # the exhaustive 28-grid wall — the whole point is fitting ~18x the
+    # candidates within ~2x the wall, so the ratio must not creep up
+    "asha_500_vs_grid28_wall_ratio": {
+        "value": -1, "asha_wall_s": -1, "grid_wall_s": -1,
+        "rungs_run": +1,
+    },
+    # and it must not trade quality away: |asha best metric - exhaustive
+    # best metric| on the shared 28-grid portion (reported as the parity
+    # score 1 - |delta|, higher is better)
+    "asha_best_metric_parity": {"value": +1, "winner_match": +1},
 }
 _DEFAULT_POLICY = {"value": +1}
 

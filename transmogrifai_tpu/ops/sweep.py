@@ -676,7 +676,7 @@ def run_sweep(spec, X, xbs: Tuple, y, train_w, val_w, blob):
 _sweep_scope = obs_registry.scope("sweep", defaults={
     "launches": [], "fallbacks": [], "compiles": 0, "compile_s": 0.0,
     "pruned_candidates": 0, "full_candidates": 0, "checkpoint_skips": 0,
-    "hedges_fired": 0, "hedge_wasted_s": 0.0})
+    "hedges_fired": 0, "hedge_wasted_s": 0.0, "asha_rungs": []})
 obs_registry.register_provider("sweep", lambda: run_stats())
 
 #: per-(name, spec, device, arg-signature) AOT executables.  jit's own cache
@@ -757,6 +757,8 @@ def run_stats() -> Dict[str, Any]:
             # deadline, and the losers' discarded wall (resilience/hedge)
             "hedges_fired": _sweep_scope.get("hedges_fired"),
             "hedge_wasted_s": _sweep_scope.get("hedge_wasted_s"),
+            # ASHA search: one record per completed rung (search/asha)
+            "asha_rungs": _sweep_scope.list("asha_rungs"),
             "fallbacks": _sweep_scope.list("fallbacks")}
 
 
@@ -766,6 +768,14 @@ def record_warm_start(pruned: int, full: int) -> None:
     wipe them)."""
     _sweep_scope.set("pruned_candidates", int(pruned))
     _sweep_scope.set("full_candidates", int(full))
+
+
+def record_rungs(rows) -> None:
+    """Stamp the ASHA scheduler's per-rung records after the search (same
+    post-sweep stamping contract as :func:`record_warm_start`: the fused
+    path resets this scope on entry, so the scheduler accumulates rung
+    rows locally and stamps them once at the end)."""
+    _sweep_scope.set("asha_rungs", [dict(r) for r in rows])
 
 
 def _aot(name: str, fn, spec, device, dyn_args) -> Tuple[Any, float, Tuple]:

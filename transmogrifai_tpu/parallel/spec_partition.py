@@ -212,3 +212,22 @@ def partition_spec(spec, blob: np.ndarray, n_shards: int, n_rows: int,
                 slot=s if weights is not None else None))
         sp.set(candidates=sum(len(s.cis) for s in shards))
     return shards
+
+
+def rung_packs(spec, blob: np.ndarray, n_rows: int, n_features: int,
+               n_folds: int, max_cands: int) -> List[ShardSpec]:
+    """Cost-balanced LAUNCH packs for one ASHA rung on a single device.
+
+    The rung scheduler bounds each fused launch by the HBM score-block
+    budget (``max_cands`` candidates per launch); this splits the rung's
+    spec into ``ceil(C / max_cands)`` LPT-balanced sub-specs the same way
+    device shards are built — including learned-cost-model pricing when
+    ``TMOG_COSTMODEL=1`` — so successive launches on the one device have
+    near-equal predicted walls (the wall prediction the rung records is
+    then just their sum)."""
+    from ..impl.sweep_fragments import spec_units
+
+    n_cands = sum(len(u.cis)
+                  for u in spec_units(spec, n_rows, n_features, n_folds))
+    n_packs = max(1, -(-n_cands // max(int(max_cands), 1)))
+    return partition_spec(spec, blob, n_packs, n_rows, n_features, n_folds)

@@ -51,7 +51,8 @@ scope = _obs_registry.scope("resilience", defaults=dict(
     quarantine=[],
 ))
 
-from .checkpoint import (CheckpointStore, checkpoint_dir,  # noqa: E402
+from .checkpoint import (CheckpointStore, GbtLadder,  # noqa: E402
+                         checkpoint_dir,
                          checkpointed_gbt_fit, content_key, data_fingerprint,
                          store)
 from .circuit import CLOSED, HALF_OPEN, OPEN, CircuitBreaker  # noqa: E402
@@ -77,7 +78,7 @@ __all__ = [
     "RetryPolicy", "with_retry", "is_transient",
     "CircuitBreaker", "CLOSED", "OPEN", "HALF_OPEN",
     "CheckpointStore", "store", "checkpoint_dir", "content_key",
-    "data_fingerprint", "checkpointed_gbt_fit",
+    "data_fingerprint", "checkpointed_gbt_fit", "GbtLadder",
     "HealthTracker", "health_tracker", "reset_health",
     "AttemptCtl", "run_hedged", "shard_deadline",
 ]

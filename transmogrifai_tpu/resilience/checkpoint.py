@@ -35,7 +35,7 @@ from ..obs import trace
 from ..utils import env as _env
 
 __all__ = ["CheckpointStore", "store", "checkpoint_dir", "content_key",
-           "data_fingerprint", "checkpointed_gbt_fit"]
+           "data_fingerprint", "checkpointed_gbt_fit", "GbtLadder"]
 
 _scope = obs_registry.scope("resilience")
 
@@ -172,6 +172,81 @@ def gbt_cadence(trees_per_round: int = 1) -> int:
     return max(k, (cadence // k) * k)
 
 
+def _merge_leaves(tree_parts):
+    """Concatenate per-segment tree leaf lists along the stacked tree axis
+    (host-side; each element of ``tree_parts`` is one segment's leaf list)."""
+    return [np.concatenate(parts, axis=0) if len(tree_parts) > 1
+            else parts[0] for parts in zip(*tree_parts)]
+
+
+class GbtLadder:
+    """Resumable segmented boosting fit: the margin-carry state of
+    :func:`checkpointed_gbt_fit` exposed ACROSS calls, for callers that
+    decide segment boundaries externally (the ASHA rung scheduler: each
+    promotion grows a survivor's rounds on the identical row set).
+
+    The caller draws ``rw``/``fms`` up-front at the FULL round budget —
+    boosting's only state besides the margins F — so
+    ``advance(n1); advance(n2)`` is bit-identical to one cold
+    ``fit_fn(..., n_rounds=n2)`` (the :func:`checkpointed_gbt_fit`
+    contract, same slicing).  ``advance`` is monotone and idempotent:
+    a target at or below ``rounds_done`` returns the current state
+    without touching the device.
+    """
+
+    def __init__(self, fit_fn, Xb, y, w, rw, fms, *,
+                 trees_per_round: int = 1, **kw):
+        self._fit_fn = fit_fn
+        self._args = (Xb, y, w)
+        self._rw = rw
+        self._fms = fms
+        self._kw = dict(kw)
+        self.trees_per_round = max(1, int(trees_per_round))
+        self.n_rounds_total = int(rw.shape[0])
+        self.rounds_done = 0
+        self.margins = None
+        self._tree_parts = []   # list of per-segment leaf lists
+        self._treedef = None
+
+    def _align(self, rounds: int) -> int:
+        """Segment boundaries must land on a round-collapse scan step."""
+        k = self.trees_per_round
+        return max(0, (int(rounds) // k) * k)
+
+    def advance(self, to_rounds: int):
+        """Fit rounds ``[rounds_done, to_rounds)`` resuming from the
+        current margins; returns ``(trees, margins)`` with the stacked
+        tree axis concatenated across every segment so far."""
+        to = self._align(min(int(to_rounds), self.n_rounds_total))
+        if to > self.rounds_done:
+            import jax
+
+            from .inject import maybe_fail
+
+            maybe_fail("trees.gbt_segment")
+            lo, hi = self.rounds_done, to
+            with trace.span("resilience.gbt_ladder", lo=lo, hi=hi):
+                seg_trees, self.margins = self._fit_fn(
+                    *self._args, self._rw[lo:hi], self._fms[lo:hi],
+                    n_rounds=hi - lo, trees_per_round=self.trees_per_round,
+                    init_margins=self.margins, **self._kw)
+            self._treedef = jax.tree_util.tree_structure(seg_trees)
+            self._tree_parts.append(
+                [np.asarray(a) for a in
+                 jax.tree_util.tree_leaves(seg_trees)])
+            self.rounds_done = to
+        return self.trees, self.margins
+
+    @property
+    def trees(self):
+        if self._treedef is None:
+            return None
+        import jax
+
+        return jax.tree_util.tree_unflatten(self._treedef,
+                                            _merge_leaves(self._tree_parts))
+
+
 def checkpointed_gbt_fit(fit_fn, Xb, y, w, rw, fms, *, n_rounds: int,
                          trees_per_round: int = 1, key_extra=(), **kw):
     """Run ``fit_fn`` (a ``fit_gbt``-shaped callable) in checkpointed
@@ -232,8 +307,7 @@ def checkpointed_gbt_fit(fit_fn, Xb, y, w, rw, fms, *, n_rounds: int,
         n_leaves = len(leaves)
         tree_parts.append(leaves)
         if hi < n_rounds:  # the final segment never needs a checkpoint
-            acc = [np.concatenate(parts, axis=0) if len(tree_parts) > 1
-                   else parts[0] for parts in zip(*tree_parts)]
+            acc = _merge_leaves(tree_parts)
             tree_parts = [acc]
             st.save("gbt", key,
                     {**{f"t{i}": a for i, a in enumerate(acc)},
@@ -241,7 +315,5 @@ def checkpointed_gbt_fit(fit_fn, Xb, y, w, rw, fms, *, n_rounds: int,
                     meta={"rounds": hi, "n_leaves": n_leaves,
                           "n_rounds": n_rounds})
 
-    merged = [np.concatenate(parts, axis=0) if len(tree_parts) > 1
-              else parts[0] for parts in zip(*tree_parts)]
-    trees = jax.tree_util.tree_unflatten(treedef, merged)
+    trees = jax.tree_util.tree_unflatten(treedef, _merge_leaves(tree_parts))
     return trees, margins

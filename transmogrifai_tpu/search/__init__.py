@@ -1,0 +1,38 @@
+"""Early-stopping hyperparameter search over the fused sweep substrate.
+
+The reference system's ModelSelector sweeps its whole candidate grid at
+full budget — fine for the stock 28-candidate default, hopeless for the
+500+ candidate spaces :class:`RandomParamBuilder` can emit.  This package
+adds an ASHA-style successive-halving scheduler on top of the existing
+machinery instead of beside it:
+
+- :mod:`.rungs` — static rung schedules: budget levels over (row
+  subsample fraction, boosted-rounds fraction) with an ``eta`` reduction
+  per rung, rows saturating one rung before the end.
+- :mod:`.resume` — margin-resume fits for promoted GBT/XGB survivors
+  (:class:`~transmogrifai_tpu.resilience.GbtLadder` per fold: each
+  promotion fits only the additional rounds, bit-identical to a cold fit
+  at equal total rounds).
+- :mod:`.asha` — the scheduler: per-family asynchronous ladders dispatched
+  through the hedged-execution layer, rung launches LPT-packed and priced
+  by the learned cost model, one ``asha_rung`` telemetry row per rung.
+
+Entry points: ``ModelSelector(search_strategy="asha")`` (the default
+``"grid"`` path is bit-identical to the pre-search code) and
+``bench.py --asha``.  Knobs: ``TMOG_ASHA_REDUCTION`` /
+``TMOG_ASHA_MIN_ROWS`` / ``TMOG_ASHA_MAX_RUNGS`` / ``TMOG_ASHA_ASYNC``.
+"""
+from __future__ import annotations
+
+from .asha import AshaScheduler, run_asha
+from .resume import (CandidateLadder, full_rounds, rounds_param_name,
+                     scale_rounds)
+from .rungs import (Rung, async_enabled, build_schedule, max_rungs,
+                    min_rung_rows, promote_count, reduction)
+
+__all__ = [
+    "run_asha", "AshaScheduler",
+    "Rung", "build_schedule", "promote_count",
+    "reduction", "min_rung_rows", "max_rungs", "async_enabled",
+    "CandidateLadder", "rounds_param_name", "full_rounds", "scale_rounds",
+]

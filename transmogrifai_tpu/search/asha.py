@@ -1,0 +1,531 @@
+"""ASHA-style successive-halving scheduler over the fused sweep substrate.
+
+``run_asha(models, validator, X, y, prep_w)`` is the drop-in counterpart of
+``OpValidator.validate`` for large candidate spaces: instead of fitting
+every candidate at full budget it climbs the rung ladder of
+:mod:`.rungs` — rung 0 fits ALL candidates on a small deterministic
+stratified row subsample (and, for boosted families, a matching fraction
+of their boosting rounds), each rung promotes the top ``1/eta`` survivors
+by validation metric, and the ladder ends with a handful of finalists at
+full budget whose metrics are directly comparable to the exhaustive
+sweep's (same rows, same seeded folds).
+
+Scheduling facts worth knowing:
+
+- **Per-family ladders, asynchronous.**  Promotion is within-family (top
+  ``ceil(k/eta)`` of each family's own rung), so families never wait for
+  each other: with ``TMOG_ASHA_ASYNC=1`` (default) every family's ladder
+  runs as one task under :func:`~transmogrifai_tpu.resilience.run_hedged`,
+  pinned to its own device — a fast family's rung 2 overlaps a slow
+  family's rung 1, and a family whose attempt errors out is re-dispatched
+  once to an idle device instead of deadlocking the search.  The final
+  cross-family election happens after every ladder returns.
+- **Margin resume.**  Boosted survivors at full-row rungs fit through
+  :class:`~transmogrifai_tpu.search.resume.CandidateLadder`: promotion
+  fits only the additional rounds from the prior rung's margins
+  (bit-identical to a cold fit at equal total rounds).  Non-boosted
+  survivors whose configuration is budget-invariant between two full-row
+  rungs REUSE their metric without refitting.
+- **Cost-model pricing.**  Each rung's launch is LPT-packed
+  (:func:`~transmogrifai_tpu.parallel.spec_partition.rung_packs`, which
+  consumes the learned cost model when ``TMOG_COSTMODEL=1``), the rung's
+  predicted wall is recorded next to the measured wall in a
+  schema-versioned ``asha_rung`` telemetry row — new training data for
+  the same cost model — and family deadlines for hedged dispatch come
+  from the calibrated seconds-per-unit tracker.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..impl.tuning.validators import (ModelEvaluation, OpValidator,
+                                      ValidationSummary, _chunk_candidates)
+from ..obs import registry as obs_registry
+from . import rungs as _rungs
+from .resume import CandidateLadder, full_rounds, scale_rounds
+
+log = logging.getLogger(__name__)
+
+__all__ = ["run_asha", "AshaScheduler"]
+
+_scope = obs_registry.scope("search", defaults={
+    "rungs_completed": 0, "candidates_evaluated": 0, "promotions": 0,
+    "margin_resumes": 0, "metric_reuses": 0, "families": 0})
+
+
+def _bad(is_larger_better: bool) -> float:
+    return -np.inf if is_larger_better else np.inf
+
+
+class _FamilyState:
+    """One family's ladder bookkeeping (attempt-local: a hedged retry gets
+    a fresh state so two attempts never share mutable fit state)."""
+
+    def __init__(self, fi: int, est, grids: List[Dict[str, Any]]):
+        self.fi = fi
+        self.est = est
+        self.grids = grids
+        self.survivors = list(range(len(grids)))
+        #: ci -> (metric_value, fold_metrics, err, rung_index)
+        self.last: Dict[int, Tuple[float, List[float], Optional[str], int]] = {}
+        self.ladders: Dict[int, CandidateLadder] = {}
+        self.rung_rows: List[Dict[str, Any]] = []
+
+
+class AshaScheduler:
+    """See module docstring; use :func:`run_asha`."""
+
+    def __init__(self, models, validator: OpValidator, X: np.ndarray,
+                 y: np.ndarray, prep_w: Optional[np.ndarray] = None):
+        self.families = [(est, list(grids) or [{}]) for est, grids in models]
+        self.validator = validator
+        self.evaluator = validator.evaluator
+        self.X = np.ascontiguousarray(np.asarray(X, np.float32))
+        self.y = np.asarray(y)
+        self.prep_w = prep_w
+        n_candidates = sum(len(g) for _, g in self.families)
+        self.schedule = _rungs.build_schedule(n_candidates, len(self.y))
+        self.eta = _rungs.reduction()
+        self._order = self._subsample_order()
+        self._rung_cache: Dict[int, Tuple] = {}
+        self._cache_lock = threading.Lock()
+        self.rung_rows: List[Dict[str, Any]] = []
+
+    # ---- deterministic stratified row subsampling --------------------------
+    def _subsample_order(self) -> np.ndarray:
+        """A fixed row order whose every prefix is ~class-proportional, so
+        all rungs (and both async attempts of a hedged family) see the same
+        rows for the same fraction."""
+        rng = np.random.default_rng([int(self.validator.seed), 0x0A5A])
+        yv = np.asarray(self.y)
+        vals = np.unique(yv)
+        if (yv.dtype.kind in "iuf" and 2 <= len(vals) <= 50
+                and np.all(vals == np.round(vals))):
+            pools = [rng.permutation(np.flatnonzero(yv == v)) for v in vals]
+            keys = np.concatenate([
+                (np.arange(len(p)) + rng.random()) / max(len(p), 1)
+                for p in pools])
+            return np.concatenate(pools)[np.argsort(keys, kind="stable")]
+        return rng.permutation(len(yv))
+
+    def _rung_data(self, r: int) -> Tuple:
+        """(rows, Xr, yr, train_w, val_mask) for rung ``r`` — built once,
+        shared by every family (metrics across families stay comparable)."""
+        with self._cache_lock:
+            hit = self._rung_cache.get(r)
+            if hit is not None:
+                return hit
+            frac = self.schedule[r].subsample_frac
+            n = len(self.y)
+            if frac >= 1.0:
+                rows = np.arange(n)
+            else:
+                k = min(n, max(_rungs.min_rung_rows(),
+                               int(math.ceil(frac * n))))
+                rows = np.sort(self._order[:k])
+            Xr = self.X if frac >= 1.0 else self.X[rows]
+            yr = self.y[rows]
+            v = self.validator
+            train_w, val_mask = v.make_folds(
+                len(rows), yr if v.stratify else None)
+            if self.prep_w is not None:
+                pw = np.asarray(self.prep_w)[rows].astype(np.float32)
+                train_w = train_w * pw[None, :]
+                val_mask = val_mask & (pw > 0)[None, :]
+            out = (rows, Xr, yr, train_w, val_mask)
+            self._rung_cache[r] = out
+            return out
+
+    # ---- one family's whole ladder -----------------------------------------
+    def _run_family(self, fi: int, runner) -> _FamilyState:
+        est, grids = self.families[fi]
+        st = _FamilyState(fi, est, grids)
+        larger = self.evaluator.is_larger_better
+        for r, rung in enumerate(self.schedule):
+            if not st.survivors:
+                break
+            self._eval_rung(st, r, runner)
+            if r < len(self.schedule) - 1:
+                keep = _rungs.promote_count(len(st.survivors), self.eta)
+                ranked = sorted(
+                    st.survivors,
+                    key=lambda ci: ((-st.last[ci][0] if larger
+                                     else st.last[ci][0]), ci))
+                st.survivors = sorted(ranked[:keep])
+                _scope.inc("promotions", keep)
+        return st
+
+    def _eval_rung(self, st: _FamilyState, r: int, runner) -> None:
+        rung = self.schedule[r]
+        rows, Xr, yr, train_w, val_mask = self._rung_data(r)
+        bad = _bad(self.evaluator.is_larger_better)
+        full_row = rung.subsample_frac >= 1.0
+        prev_full = r > 0 and self.schedule[r - 1].subsample_frac >= 1.0
+        t0 = time.perf_counter()
+
+        ladder_cis: List[int] = []
+        reuse_cis: List[int] = []
+        sweep_cis: List[int] = []
+        for ci in st.survivors:
+            grid = st.grids[ci]
+            if full_row and full_rounds(st.est, grid) is not None:
+                ladder_cis.append(ci)
+            elif (full_row and prev_full and ci in st.last
+                  and st.last[ci][2] is None):
+                # budget-invariant config on the identical rows + folds:
+                # the refit would reproduce the same metric bit-identically
+                reuse_cis.append(ci)
+            else:
+                sweep_cis.append(ci)
+
+        predicted_wall: Optional[float] = None
+        feat: Optional[Dict[str, float]] = None
+        n_resumed = 0
+        if sweep_cis:
+            cands = [(st.est, [scale_rounds(st.est, st.grids[ci],
+                                            rung.rounds_frac)
+                               for ci in sweep_cis])]
+            results, predicted_wall, feat = runner(cands, Xr, yr, train_w,
+                                                   val_mask, rung)
+            for ci, res in zip(sweep_cis, results):
+                st.last[ci] = (res[0], res[1], res[2], r)
+        for ci in reuse_cis:
+            v, fm, err, _ = st.last[ci]
+            st.last[ci] = (v, fm, err, r)
+            _scope.inc("metric_reuses")
+        for ci in ladder_cis:
+            err: Optional[str] = None
+            try:
+                ladder = st.ladders.get(ci)
+                if ladder is None:
+                    ladder = CandidateLadder(st.est, st.grids[ci], Xr, yr,
+                                             train_w)
+                    st.ladders[ci] = ladder
+                else:
+                    n_resumed += 1
+                    _scope.inc("margin_resumes")
+                fm = ladder.metrics_at(rung.rounds_frac, self.evaluator,
+                                       yr, val_mask)
+                value = float(np.mean(fm))
+                if not np.isfinite(value):
+                    value, err = bad, "non-finite metric from margins"
+            except Exception as e:  # tolerated like any sweep candidate
+                log.warning("ASHA ladder candidate %s%s failed: %s",
+                            type(st.est).__name__, st.grids[ci], e)
+                fm, value, err = [], bad, f"{type(e).__name__}: {e}"
+            st.last[ci] = (value, fm, err, r)
+
+        wall = time.perf_counter() - t0
+        n_out = (_rungs.promote_count(len(st.survivors), self.eta)
+                 if r < len(self.schedule) - 1 else len(st.survivors))
+        row = {"rung": r, "family": type(st.est).__name__,
+               "subsample_frac": round(rung.subsample_frac, 6),
+               "rounds_frac": round(rung.rounds_frac, 6),
+               "rows": int(len(rows)),
+               "candidates_in": len(st.survivors),
+               "candidates_out": int(min(n_out, len(st.survivors))),
+               "resumed": n_resumed, "reused": len(reuse_cis),
+               "predicted_wall_s": predicted_wall,
+               "wall_s": round(wall, 4)}
+        st.rung_rows.append(row)
+        _scope.inc("rungs_completed")
+        _scope.inc("candidates_evaluated", len(st.survivors))
+        self._emit_rung_record(row, feat, resumed=n_resumed > 0)
+
+    def _emit_rung_record(self, row: Dict[str, Any],
+                          feat: Optional[Dict[str, float]],
+                          resumed: bool) -> None:
+        """One schema-versioned telemetry row per rung completion — the
+        cost model's training data.  Only when TMOG_TELEMETRY names a path
+        (the default cwd file would dirty the repo during tests)."""
+        if not os.environ.get("TMOG_TELEMETRY", "").strip():
+            return
+        try:
+            from ..costmodel.features import rung_feature_dict
+            from ..obs import write_record
+
+            merged = dict(feat or {})
+            merged.update(rung_feature_dict(row["subsample_frac"],
+                                            row["rung"], resumed))
+            write_record("asha_rung", extra={"asha_rung": dict(row),
+                                             "feat": merged})
+        except Exception:
+            pass  # telemetry must never fail the search
+
+    # ---- rung launch runners ----------------------------------------------
+    def _predict_wall(self, plan, n_folds: int, rung
+                      ) -> Tuple[Optional[float], Optional[Dict[str, float]]]:
+        """(predicted wall, feature dict) for one rung launch — learned
+        model when armed, calibrated seconds-per-unit otherwise, (None,
+        feat) when nothing is calibrated yet."""
+        feat: Optional[Dict[str, float]] = None
+        try:
+            from ..costmodel.features import (rung_feature_dict,
+                                              shard_feature_dict)
+
+            feat = shard_feature_dict(plan.spec, plan.n_rows,
+                                      plan.n_features, n_folds)
+            feat.update(rung_feature_dict(rung.subsample_frac, rung.index,
+                                          False))
+        except Exception:
+            feat = None
+        try:
+            from .. import costmodel
+
+            if feat is not None and costmodel.enabled():
+                model = costmodel.active_model()
+                if model is not None:
+                    return float(model.predict(feat)["wall_s"]), feat
+            from ..resilience import health as _health
+
+            total = sum(u.cost for u in plan.units(n_folds))
+            return _health.tracker().predict_wall(total), feat
+        except Exception:
+            return None, feat
+
+    def _device_runner(self, device):
+        """Rung launcher pinned to one device (the async per-family path):
+        fused plan per HBM-budget chunk, LPT launch packs per chunk, no
+        mesh.  Falls back to the validator's per-candidate loop for
+        unfusable candidates."""
+        import jax
+
+        def run(cands, Xr, yr, train_w, val_mask, rung):
+            with jax.default_device(device):
+                try:
+                    return self._fused_rung(cands, Xr, yr, train_w,
+                                            val_mask, rung)
+                except Exception as e:
+                    log.warning("ASHA fused rung failed (%s); "
+                                "per-candidate path", e)
+                    return (self._loop_rung(cands, Xr, yr, train_w,
+                                            val_mask), None, None)
+        return run
+
+    def _mesh_runner(self):
+        """Rung launcher through the validator's own sweep (the sync path):
+        full mesh sharding, row sharding, hedged shards — everything
+        ``validate()`` would do for this candidate subset."""
+        def run(cands, Xr, yr, train_w, val_mask, rung):
+            return (self._loop_rung(cands, Xr, yr, train_w, val_mask),
+                    None, None)
+        return run
+
+    def _loop_rung(self, cands, Xr, yr, train_w, val_mask):
+        s = ValidationSummary(
+            validation_type="asha-rung",
+            evaluator_name=self.evaluator.name,
+            metric_name=self.evaluator.default_metric,
+            is_larger_better=self.evaluator.is_larger_better)
+        self.validator._sweep(cands, Xr, yr, train_w, val_mask, s)
+        return [(m.metric_value, m.fold_metrics, m.error) for m in s.results]
+
+    def _fused_rung(self, cands, Xr, yr, train_w, val_mask, rung):
+        """One fused launch per LPT pack (single device, no mesh)."""
+        from ..impl.sweep_fragments import build_sweep_plan
+        from ..ops.sweep import run_sweep
+        from ..parallel.spec_partition import rung_packs
+        from ..utils.env import env_float
+
+        n_folds = int(train_w.shape[0])
+        budget = env_float("TMOG_FUSED_SCORES_BYTES", 3e8)
+        per_cand = n_folds * len(yr) * 4.0
+        inner_ev = getattr(self.evaluator, "inner", self.evaluator)
+        if "Multi" in type(inner_ev).__name__:
+            per_cand *= max(int(np.max(np.asarray(yr))) + 1, 2)
+        chunks = _chunk_candidates(
+            cands, max(int(budget // max(per_cand, 1.0)), 1))
+        metrics_parts: List[np.ndarray] = []
+        predicted: Optional[float] = None
+        feat: Optional[Dict[str, float]] = None
+        for chunk in chunks:
+            plan = build_sweep_plan(chunk, Xr, yr, train_w, self.evaluator)
+            if plan is None:
+                raise RuntimeError("unfusable candidates in ASHA rung")
+            p, f = self._predict_wall(plan, n_folds, rung)
+            if feat is None:
+                feat = f
+            if p is not None:
+                predicted = (predicted or 0.0) + p
+            packs = rung_packs(plan.spec, plan.blob, plan.n_rows,
+                               plan.n_features, n_folds,
+                               max_cands=max(int(budget // per_cand), 1))
+            C = sum(len(s.cis) for s in packs)
+            out = np.empty((n_folds, C, len(plan.metric_names)), np.float32)
+            for shard in packs:
+                m = np.asarray(run_sweep(
+                    shard.spec, plan.X, plan.xbs, plan.y,
+                    np.asarray(train_w, np.float32),
+                    np.asarray(val_mask, np.float32), shard.blob))
+                out[:, list(shard.cis), :] = m
+            metrics_parts.append(out)
+        metrics = np.concatenate(metrics_parts, axis=1)
+        # metric index is identical across chunks (same evaluator)
+        mi = plan.metric_names.index(self.evaluator.default_metric)
+        bad = _bad(self.evaluator.is_larger_better)
+        results = []
+        ci = 0
+        for _est, grids in cands:
+            for _grid in grids:
+                fm = [float(v) for v in metrics[:, ci, mi]]
+                value = float(np.mean(fm))
+                err = None
+                if not np.isfinite(value):
+                    value, err = bad, ("non-finite "
+                                       f"{self.evaluator.default_metric}"
+                                       " on device")
+                results.append((value, fm, err))
+                ci += 1
+        return results, predicted, feat
+
+    # ---- dispatch ----------------------------------------------------------
+    def _family_deadline(self, fi: int) -> Optional[float]:
+        """Whole-ladder deadline from calibrated seconds-per-unit (the
+        rung budgets sum to ~eta/(eta-1) of one full-budget family pass)."""
+        try:
+            from ..impl.sweep_fragments import build_sweep_plan
+            from ..resilience import hedge as _hedge
+
+            est, grids = self.families[fi]
+            _, _, yr, train_w, _ = self._rung_data(len(self.schedule) - 1)
+            plan = build_sweep_plan([(est, grids)], self.X, yr, train_w,
+                                    self.evaluator)
+            if plan is None:
+                return None
+            total = sum(u.cost for u in plan.units(int(train_w.shape[0])))
+            total *= self.eta / max(self.eta - 1.0, 1.0)
+            return _hedge.shard_deadline(total)
+        except Exception:
+            return None
+
+    def run(self) -> ValidationSummary:
+        from ..ops import sweep as sweep_ops
+
+        n_fam = len(self.families)
+        _scope.set("families", n_fam)
+        use_async = _rungs.async_enabled() and n_fam > 1
+        states: List[Optional[_FamilyState]] = [None] * n_fam
+        if use_async:
+            states = self._run_async()
+        else:
+            from ..parallel.mesh import use_mesh
+
+            with use_mesh(self.validator._resolve_mesh()):
+                runner = self._mesh_runner()
+                for fi in range(n_fam):
+                    try:
+                        states[fi] = self._run_family(fi, runner)
+                    except Exception as e:
+                        log.warning("ASHA family %s failed: %s",
+                                    type(self.families[fi][0]).__name__, e)
+                        states[fi] = None
+        self.rung_rows = [row for st in states if st is not None
+                          for row in st.rung_rows]
+        sweep_ops.record_rungs(self.rung_rows)
+        return self._elect(states)
+
+    def _run_async(self) -> List[Optional["_FamilyState"]]:
+        import jax
+
+        from ..resilience import inject as _inject
+        from ..resilience.hedge import run_hedged
+
+        devs = list(jax.devices())
+        n_fam = len(self.families)
+        deadlines = [self._family_deadline(fi) for fi in range(n_fam)]
+
+        def attempt(task: int, slot: int, ctl):
+            ctl.mark_dispatch()
+            _inject.maybe_fail("search.rung", key=str(task))
+            dev = devs[slot % len(devs)]
+            try:
+                return self._run_family(task, self._device_runner(dev))
+            except Exception:
+                if ctl.attempt > 0:
+                    # the hedged retry is the last line: degrade to a
+                    # failed family instead of failing the whole search
+                    log.warning("ASHA family %d failed twice; dropped",
+                                task, exc_info=True)
+                    return None
+                raise
+
+        winners, _stats = run_hedged(
+            n_fam, max(len(devs), 1), attempt, deadlines, max_hedges=1,
+            on_hedge=lambda t, s, a, why: obs_registry.record_fallback(
+                "search", "family_hedged", family=t, slot=s, reason=why))
+        out: List[Optional[_FamilyState]] = [None] * n_fam
+        for result, _slot, _attempt, _wall in winners:
+            if result is not None:
+                out[result.fi] = result
+        return out
+
+    # ---- final cross-family election ---------------------------------------
+    def _elect(self, states: Sequence[Optional["_FamilyState"]]
+               ) -> ValidationSummary:
+        larger = self.evaluator.is_larger_better
+        bad = _bad(larger)
+        summary = ValidationSummary(
+            validation_type=f"asha-{self.validator.validation_type}",
+            evaluator_name=self.evaluator.name,
+            metric_name=self.evaluator.default_metric,
+            is_larger_better=larger)
+        final_r = len(self.schedule) - 1
+        finalists: List[Tuple[float, int]] = []  # (value, global index)
+        gi = 0
+        for fi, (est, grids) in enumerate(self.families):
+            st = states[fi]
+            for ci, grid in enumerate(grids):
+                if st is None:
+                    value, fm, err, r = bad, [], "family ladder failed", -1
+                elif ci in st.last:
+                    value, fm, err, r = st.last[ci]
+                else:
+                    value, fm, err, r = bad, [], None, -1
+                summary.results.append(ModelEvaluation(
+                    model_uid=est.uid, model_name=type(est).__name__,
+                    model_type=type(est).__name__, grid=dict(grid),
+                    metric_name=self.evaluator.default_metric,
+                    fold_metrics=list(fm), metric_value=value, error=err))
+                if (st is not None and err is None and r == final_r
+                        and np.isfinite(value)):
+                    finalists.append((value, gi))
+                gi += 1
+        if not finalists:
+            raise RuntimeError(
+                "ASHA search: no candidate survived to the final rung")
+        finalists.sort(key=lambda t: ((-t[0] if larger else t[0]), t[1]))
+        summary.best_index = finalists[0][1]
+        summary.asha = {
+            "schedule": [{"rung": ru.index,
+                          "subsample_frac": ru.subsample_frac,
+                          "rounds_frac": ru.rounds_frac}
+                         for ru in self.schedule],
+            "reduction": self.eta,
+            "async": _rungs.async_enabled(),
+            "n_candidates": len(summary.results),
+            "n_finalists": len(finalists),
+            "rungs": list(self.rung_rows),
+        }
+        return summary
+
+
+def run_asha(models, validator: OpValidator, X: np.ndarray, y: np.ndarray,
+             prep_w: Optional[np.ndarray] = None) -> ValidationSummary:
+    """Successive-halving search over ``models``; same contract as
+    ``validator.validate`` (tolerated per-candidate failures, raises only
+    when nothing survives), plus a ``summary.asha`` dict with the schedule
+    and per-rung telemetry."""
+    summary = AshaScheduler(models, validator, X, y, prep_w).run()
+    wc = getattr(validator, "warm_start_counts", None)
+    if wc:
+        from ..ops import sweep as sweep_ops
+
+        sweep_ops.record_warm_start(*wc)
+    return summary

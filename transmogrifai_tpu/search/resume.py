@@ -1,0 +1,159 @@
+"""Margin-resume fits for promoted boosted candidates (GBT / XGBoost).
+
+A boosted candidate promoted between two SAME-ROW rungs does not refit
+from round 0: its per-fold :class:`~transmogrifai_tpu.resilience.GbtLadder`
+carries (trees-so-far + margins F) and each promotion fits only the
+additional rounds via ``fit_gbt(init_margins=F)``.  The rw/fms draws are
+made once at the candidate's FULL round budget (the
+``checkpointed_gbt_fit`` slicing contract), so a ladder that reaches the
+top rung holds the bit-identical model a cold full-round fit would have
+produced — promotion changes where the wall-clock is spent, never the
+model.
+
+Validation metrics come straight off the margins: ``fit_gbt`` carries F
+over ALL resident rows while the fold's training weights zero the held-out
+rows, so ``F[val_mask]`` IS the out-of-fold prediction — no separate
+predict pass per rung.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["rounds_param_name", "scale_rounds", "full_rounds",
+           "CandidateLadder"]
+
+#: boosted round-budget params in precedence order (XGB's num_round wins
+#: over the shared max_iter so OpXGBoost* grids scale the right axis)
+_ROUNDS_PARAMS = ("num_round", "max_iter")
+
+
+def rounds_param_name(est, grid: Optional[Dict[str, Any]] = None
+                      ) -> Optional[str]:
+    """The param naming this boosted family's round budget, or None for
+    non-boosted families (whose budget axis is rows only)."""
+    if not hasattr(est, "_boost_params"):
+        return None
+    for name in _ROUNDS_PARAMS:
+        if (grid is not None and name in grid) \
+                or est.get_param(name) is not None:
+            return name
+    return None
+
+
+def full_rounds(est, grid: Dict[str, Any]) -> Optional[int]:
+    """The candidate's full-budget boosting rounds, or None."""
+    name = rounds_param_name(est, grid)
+    if name is None:
+        return None
+    v = grid.get(name, est.get_param(name))
+    return int(v) if v else None
+
+
+def scale_rounds(est, grid: Dict[str, Any], frac: float) -> Dict[str, Any]:
+    """``grid`` with the round budget scaled to ``frac`` (ceil, >= 1);
+    non-boosted families and frac >= 1 return the grid unchanged."""
+    name = rounds_param_name(est, grid)
+    if name is None or frac >= 1.0:
+        return dict(grid)
+    full = grid.get(name, est.get_param(name))
+    if not full:
+        return dict(grid)
+    return {**grid, name: max(1, math.ceil(int(full) * float(frac)))}
+
+
+class CandidateLadder:
+    """One boosted candidate's resumable per-fold fits + margin metrics.
+
+    Built once when the candidate first reaches a full-row rung; each
+    :meth:`metrics_at` call advances every fold's
+    :class:`~transmogrifai_tpu.resilience.GbtLadder` to the rung's round
+    budget and scores the margins on the fold's validation rows.
+    Construction raises for non-boosted estimators — callers route those
+    through the regular sweep instead.
+    """
+
+    def __init__(self, est, grid: Dict[str, Any], X: np.ndarray,
+                 y: np.ndarray, train_w: np.ndarray):
+        import jax.numpy as jnp
+
+        from ..impl.trees_common import effective_trees_per_round
+        from ..ops import trees as Tr
+        from ..resilience import GbtLadder
+
+        if not hasattr(est, "_boost_params"):
+            raise TypeError(f"{type(est).__name__} is not a boosted family")
+        self.est = est
+        self.grid = dict(grid)
+        cand = est.copy_with_params(grid)
+        bp = cand._boost_params()
+        n, d = X.shape
+        self.n_rounds = int(bp["n_rounds"])
+        self.is_classifier = bool(getattr(cand, "is_classifier", False))
+        Xb, _edges = Tr.quantize(np.asarray(X, np.float32), bp["n_bins"])
+        ks, kf = Tr.rng_keys(int(cand.get_param("seed", 42)))
+        rw = Tr.subsample_weights(ks, n, self.n_rounds, bp["subsample"])
+        fms = Tr.feature_masks(kf, d, self.n_rounds, bp["colsample"])
+        k_eff = effective_trees_per_round(bp.get("trees_per_round", 1),
+                                          self.n_rounds)
+        y32 = np.asarray(y, np.float32)
+        Xb_dev = jnp.asarray(Xb)
+        if self.is_classifier:
+            k = cand._n_classes(y)
+            self._loss = "logistic" if k == 2 else "softmax"
+            frontier = cand._frontier(n, bp["max_depth"],
+                                      bp["min_child_weight"], 0.25)
+        else:
+            k = 1
+            self._loss = "squared"
+            frontier = cand._frontier(n, bp["max_depth"],
+                                      bp["min_child_weight"])
+        self._convert = (cand._margins_to_preds if self.is_classifier
+                         else None)
+        self.ladders: List[GbtLadder] = []
+        for f in range(train_w.shape[0]):
+            sw = np.asarray(train_w[f], np.float32)
+            kw = dict(loss=self._loss, max_depth=bp["max_depth"],
+                      n_bins=bp["n_bins"], frontier=frontier, eta=bp["eta"],
+                      reg_lambda=bp["reg_lambda"], gamma=bp["gamma"],
+                      min_child_weight=bp["min_child_weight"], n_classes=k,
+                      min_info_gain=bp.get("min_info_gain", 0.0))
+            if not self.is_classifier:
+                kw["base_score"] = float(
+                    np.average(y32, weights=np.maximum(sw, 1e-12)))
+            self.ladders.append(GbtLadder(
+                Tr.fit_gbt, Xb_dev, jnp.asarray(y32), jnp.asarray(sw),
+                jnp.asarray(rw), jnp.asarray(fms), trees_per_round=k_eff,
+                **kw))
+
+    @property
+    def rounds_done(self) -> int:
+        return self.ladders[0].rounds_done if self.ladders else 0
+
+    def rounds_at(self, rounds_frac: float) -> int:
+        """Round target for a rung, aligned up to at least one scan step."""
+        k = self.ladders[0].trees_per_round if self.ladders else 1
+        r = max(k, math.ceil(self.n_rounds * min(1.0, float(rounds_frac))))
+        return min(self.n_rounds, r)
+
+    def metrics_at(self, rounds_frac: float, evaluator, y: np.ndarray,
+                   val_mask: np.ndarray) -> List[float]:
+        """Advance every fold to the rung's round budget and return the
+        per-fold validation metrics (evaluator's default metric)."""
+        target = self.rounds_at(rounds_frac)
+        fold_metrics: List[float] = []
+        for f, ladder in enumerate(self.ladders):
+            _trees, F = ladder.advance(target)
+            F = np.asarray(F)
+            if self._convert is not None:
+                pred, _raw, prob = self._convert(self._loss, F)
+            else:
+                pred, prob = np.asarray(F[:, 0], np.float64), None
+            vm = np.asarray(val_mask[f], bool)
+            m = evaluator.evaluate_arrays(
+                np.asarray(y)[vm], np.asarray(pred)[vm],
+                None if prob is None else np.asarray(prob)[vm])
+            fold_metrics.append(float(m[evaluator.default_metric]))
+        return fold_metrics

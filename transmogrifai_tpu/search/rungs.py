@@ -1,0 +1,117 @@
+"""Successive-halving rung schedules (the static half of the ASHA search).
+
+A schedule is a short list of :class:`Rung` budget levels for a candidate
+space of ``C`` configs over ``n`` training rows.  Budget grows by the
+reduction factor ``eta`` (``TMOG_ASHA_REDUCTION``) along two axes:
+
+- **rows** — rung *r* trains on a ``subsample_frac`` row subsample (the
+  data-axis substrate already shards rows, so a fractional rung is just a
+  smaller resident matrix).  Fractions SATURATE at 1.0 one rung before the
+  end: the last two rungs share the identical full row set, which is what
+  makes boosted-margin resume (``fit_gbt(init_margins=...)``) legal there —
+  margins are per-row state and cannot survive a row-set change.
+- **boosting rounds** — ``rounds_frac`` keeps shrinking to the final rung,
+  so a promoted GBT/XGB survivor's last hop is "same rows, more rounds":
+  exactly the segment contract of
+  :func:`~transmogrifai_tpu.resilience.checkpoint.checkpointed_gbt_fit`.
+
+Promotion keeps the top ``ceil(k / eta)`` of each rung's ``k`` entrants
+(:func:`promote_count`), so survivor counts decrease strictly until the
+final rung.  All knobs read the ``TMOG_ASHA_*`` env family via
+:mod:`~transmogrifai_tpu.utils.env` (empty-string tolerant).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..utils import env as _env
+
+__all__ = ["Rung", "reduction", "min_rung_rows", "max_rungs",
+           "async_enabled", "build_schedule", "promote_count"]
+
+
+def reduction() -> int:
+    """Promotion factor eta: keep top 1/eta per rung (>= 2)."""
+    return max(2, _env.env_int("TMOG_ASHA_REDUCTION", 3))
+
+
+def min_rung_rows() -> int:
+    """Row floor for the cheapest rung — below this a subsample's fold
+    metrics are noise, not signal (also the fold-viability floor)."""
+    return max(8, _env.env_int("TMOG_ASHA_MIN_ROWS", 64))
+
+
+def max_rungs() -> int:
+    """Rung-count cap; 0 = auto (ceil(log_eta C) + 1)."""
+    return max(0, _env.env_int("TMOG_ASHA_MAX_RUNGS", 0))
+
+
+def async_enabled() -> bool:
+    """Per-family asynchronous rung advancement (default on)."""
+    return _env.env_flag("TMOG_ASHA_ASYNC", True)
+
+
+@dataclass(frozen=True)
+class Rung:
+    """One budget level of the schedule."""
+
+    index: int
+    subsample_frac: float   #: row fraction trained on (1.0 = full rows)
+    rounds_frac: float      #: boosted-rounds fraction (1.0 = full rounds)
+
+    @property
+    def is_final(self) -> bool:
+        return self.rounds_frac >= 1.0 and self.subsample_frac >= 1.0
+
+
+def promote_count(n_in: int, eta: Optional[int] = None) -> int:
+    """Survivors promoted out of a rung with ``n_in`` entrants."""
+    if n_in <= 0:
+        return 0
+    return max(1, -(-n_in // (reduction() if eta is None else max(2, eta))))
+
+
+def build_schedule(n_candidates: int, n_rows: int,
+                   eta: Optional[int] = None,
+                   min_rows: Optional[int] = None,
+                   rung_cap: Optional[int] = None) -> List[Rung]:
+    """The rung ladder for ``n_candidates`` configs over ``n_rows`` rows.
+
+    Rung count is ``ceil(log_eta(C)) + 1`` (enough halvings to reach a
+    handful of finalists, plus the full-budget rung), capped by
+    ``TMOG_ASHA_MAX_RUNGS`` and by the row floor — a rung whose row budget
+    would clip below ``min_rung_rows`` merges into the next one instead of
+    fitting a duplicate subsample.  The final rung is always
+    (frac=1.0, rounds=1.0); the penultimate rung is always frac=1.0 (the
+    margin-resume precondition); a one-candidate space degenerates to a
+    single full-budget rung.
+    """
+    e = reduction() if eta is None else max(2, int(eta))
+    floor_rows = min_rung_rows() if min_rows is None else max(8, int(min_rows))
+    cap = max_rungs() if rung_cap is None else max(0, int(rung_cap))
+    n_rows = max(int(n_rows), 1)
+    if n_candidates <= 1:
+        return [Rung(0, 1.0, 1.0)]
+    n = max(2, math.ceil(math.log(n_candidates, e)) + 1)
+    if cap:
+        n = min(n, max(cap, 2))
+    min_frac = min(1.0, floor_rows / n_rows)
+    rungs: List[Rung] = []
+    prev_frac = -1.0
+    for r in range(n):
+        # rows saturate one rung early (n-2); rounds only at the last rung
+        frac = min(1.0, float(e) ** -(n - 2 - r)) if n >= 2 else 1.0
+        frac = min(1.0, max(frac, min_frac))
+        rfrac = min(1.0, float(e) ** -(n - 1 - r))
+        if frac == prev_frac and rfrac < 1.0 and frac < 1.0:
+            # row floor made this rung identical to the previous one on
+            # both axes that matter below saturation — skip the duplicate
+            continue
+        rungs.append(Rung(len(rungs), frac, rfrac))
+        prev_frac = frac
+    # re-normalize rounds of the kept rungs so the ladder still ends at 1.0
+    if rungs[-1].rounds_frac < 1.0 or rungs[-1].subsample_frac < 1.0:
+        rungs[-1] = Rung(rungs[-1].index, 1.0, 1.0)
+    return rungs
