@@ -590,10 +590,18 @@ def main():
     n_grids = sum(len(g) for _, g in sel.models)
     n_models = sel.validator.num_folds * n_grids
 
-    # warmup: compiles every kernel in the sweep (cached thereafter)
+    # warmup: compiles every kernel in the sweep (cached thereafter).  The
+    # persistent compile cache (PR 8) is wired in FIRST so a warm-cache
+    # bench run demonstrates the instant-warm number outside serve, and the
+    # AOT compile telemetry splits the cold wall into compile vs dispatch.
+    from transmogrifai_tpu.ops import sweep as sweep_ops
+    sweep_ops._wire_compile_cache()
+    sweep_ops.reset_run_stats()
     t_first = time.perf_counter()
     sel.find_best_estimator(X, y)
     warm = time.perf_counter() - t_first
+    warmup_compile_s = float(sweep_ops.run_stats()["compile_s"])
+    warmup_dispatch_s = max(warm - warmup_compile_s, 0.0)
 
     from transmogrifai_tpu.obs import ledger, timeline, trace
 
@@ -638,7 +646,6 @@ def main():
     # sweep-launch telemetry (reset per validate: this is the LAST rep's),
     # so a multi-chip run shows its shard count + per-shard wall/compile —
     # the aggregate models/s above already spans all shards
-    from transmogrifai_tpu.ops import sweep as sweep_ops
     sweep_stats = sweep_ops.run_stats()
 
     models_per_sec = n_models / dt
@@ -655,16 +662,35 @@ def main():
         "sweep": f"{n_grids} grids x {sel.validator.num_folds} folds "
                  "(LR 8 + RF 18 + XGB 2 reference defaults)",
         "warmup_s": round(warm, 2),
+        # cold-warmup decomposition: XLA compile seconds (AOT telemetry)
+        # vs everything else (dispatch/upload/host) — the compile share is
+        # what the persistent compile cache erases on a warm restart
+        "warmup_compile_s": round(warmup_compile_s, 2),
+        "warmup_dispatch_s": round(warmup_dispatch_s, 2),
         "steady_s": round(dt, 2),
         "sweep_shards": sweep_stats["sweep_shards"],
         "data_shards": sweep_stats["data_shards"],
+        # candidate packing (TMOG_SWEEP_PACK): packed launches built in the
+        # last rep, and sequential dispatches avoided vs one-launch-per-
+        # candidate (always present so baselines can compare)
+        "sweep_pack_count": int(sweep_stats.get("sweep_pack_count") or 0),
+        "launches_avoided": int(sweep_stats.get("launches_avoided") or 0),
     }
-    # round-collapse visibility: the longest sequential GBT level chain in
-    # the sweep (steps x depth); K=4 collapse turns the reference 200x10 =
-    # 2000 levels into 500
+    # sequential GBT launch-levels on the critical path: the full
+    # dependency chain (steps x depth; K=4 round-collapse turns the
+    # reference 200x10 = 2000 levels into 500), minus measured
+    # cross-device overlap under TMOG_GBT_PIPELINE (gbt_chain_eff)
     if sweep_stats.get("gbt_chain_levels"):
-        out["gbt_sequential_launches"] = sweep_stats["gbt_chain_levels"]
+        out["gbt_sequential_launches"] = (
+            sweep_stats.get("gbt_sequential_launches")
+            or sweep_stats["gbt_chain_levels"])
+        out["gbt_chain_levels"] = sweep_stats["gbt_chain_levels"]
         out["gbt_chain_steps"] = sweep_stats["gbt_chain_steps"]
+    bf = acct.get("bf16_hist") or {}
+    if bf.get("levels"):
+        out["bf16_hist_per_rep"] = {
+            "levels": round(bf["levels"] / reps),
+            "bytes_saved": round(bf["bytes_saved"] / reps)}
     hs = acct.get("hist_subtracted") or {}
     if hs.get("levels"):
         out["hist_subtracted_per_rep"] = {

@@ -108,6 +108,37 @@ def _print_hedge_telemetry(sweep_ops) -> dict:
     return out
 
 
+def _print_pack_telemetry(sweep_ops) -> dict:
+    """MFU-gap telemetry (PR 17): candidate packing + GBT pipelining.
+    Returns the dict that rides in the run's JSONL record."""
+    stats = sweep_ops.run_stats()
+    out = {"sweep_pack_count": int(stats.get("sweep_pack_count") or 0),
+           "launches_avoided": int(stats.get("launches_avoided") or 0),
+           "gbt_sequential_launches":
+               int(stats.get("gbt_sequential_launches") or 0)}
+    if out["sweep_pack_count"]:
+        packed = out["sweep_pack_count"] + out["launches_avoided"]
+        print(f"packing: {packed} candidates in {out['sweep_pack_count']} "
+              f"packed launches ({out['launches_avoided']} launches avoided; "
+              "TMOG_SWEEP_PACK=0 disables)")
+    effs = [l["gbt_chain_eff"] for l in stats.get("launches") or []
+            if l.get("gbt_chain_eff")]
+    if effs:
+        eff = max(effs, key=lambda e: e["levels"])
+        out["gbt_overlap_fraction"] = eff.get("overlap_fraction", 0.0)
+        print(f"gbt pipeline: {eff['levels']} effective sequential levels "
+              f"(overlap~{out['gbt_overlap_fraction']:.0%}; "
+              "TMOG_GBT_PIPELINE=0 disables)")
+    from transmogrifai_tpu.utils import flops
+    bf = flops.bf16_hist_totals()
+    if bf.get("levels"):
+        print(f"bf16 hist: {int(bf['levels'])} accumulations halved, "
+              f"~{int(bf['bytes_saved']):,} hist bytes avoided "
+              "(TMOG_BF16_HIST=1 enables)")
+        out["bf16_hist_bytes_saved"] = int(bf["bytes_saved"])
+    return out
+
+
 def _load_costmodel():
     """The trained artifact at TMOG_COSTMODEL_PATH, or None (with a note)."""
     from transmogrifai_tpu import costmodel as cm
@@ -339,6 +370,7 @@ if args.data_shards > 0:
         if cm_eval:
             extra["costmodel_eval"] = cm_eval
         extra["hedge"] = _print_hedge_telemetry(sweep_ops)
+        extra["pack"] = _print_pack_telemetry(sweep_ops)
     except Exception:
         pass
     obs.write_record("profile_sweep", extra=extra)
@@ -359,6 +391,7 @@ if args.shards > 0:
         from transmogrifai_tpu.ops import sweep as sweep_ops
 
         extra["hedge"] = _print_hedge_telemetry(sweep_ops)
+        extra["pack"] = _print_pack_telemetry(sweep_ops)
     except Exception:
         pass
     obs.write_record("profile_sweep", extra=extra)
@@ -379,4 +412,5 @@ from transmogrifai_tpu.ops import sweep as sweep_ops  # noqa: E402
 _print_gbt_telemetry(sweep_ops)
 obs.write_record("profile_sweep",
                  extra={"mode": "families",
-                        "hedge": _print_hedge_telemetry(sweep_ops)})
+                        "hedge": _print_hedge_telemetry(sweep_ops),
+                        "pack": _print_pack_telemetry(sweep_ops)})

@@ -57,6 +57,12 @@ FEATURE_NAMES = (
     # pre-ASHA rows (missing -> 0.0), which correctly reads as "not a rung
     # launch" — full-budget sweep launches carry no rung features at all.
     "subsample_frac", "rung_index", "is_resumed",
+    # candidate packing / GBT pipelining (TMOG_SWEEP_PACK /
+    # TMOG_GBT_PIPELINE): candidates fused per launch pack and the dispatch
+    # pipeline depth, stamped by ops/sweep so the model learns to price
+    # packed/pipelined launches.  0.0 (old rows / knobs off) == the
+    # historical one-queue-per-device, unpipelined launch.
+    "pack_size", "pipeline_depth",
 )
 
 

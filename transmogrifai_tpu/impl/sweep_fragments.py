@@ -145,6 +145,33 @@ class SweepPlan:
                 weights = None
         if len(devices) <= 1:
             return self.run(train_w, val_mask)
+        from ..utils.env import env_flag
+        if env_flag("TMOG_SWEEP_PACK", False):
+            # candidate packing: cost-model-sized launch packs (possibly
+            # several per device when the HBM / predicted-wall budgets
+            # split a queue); every pack carries the slot it was balanced
+            # for.  At the default budgets the packs ARE the LPT shards,
+            # so the dispatched programs stay byte-identical — only the
+            # launch-count telemetry is new.
+            from ..ops.sweep import record_packs
+            from ..parallel.spec_partition import launch_packs
+
+            shards = launch_packs(self.spec, self.blob, len(devices),
+                                  self.n_rows, self.n_features,
+                                  int(train_w.shape[0]),
+                                  device_weights=weights)
+            if len(shards) <= 1:
+                return self.run(train_w, val_mask)
+            record_packs(len(shards), len(self.spec[2]))
+            run_devices = [devices[s.slot if s.slot is not None else i]
+                           for i, s in enumerate(shards)]
+            return run_sweep_partitioned(
+                shards, self.X, self.xbs, self.y,
+                np.asarray(train_w, np.float32),
+                np.asarray(val_mask, np.float32),
+                len(self.spec[2]), run_devices,
+                X_host=self.X_host, y_host=self.y_host,
+                xb_bins=self.xb_bins)
         shards = partition_spec(self.spec, self.blob, len(devices),
                                 self.n_rows, self.n_features,
                                 int(train_w.shape[0]),

@@ -45,6 +45,11 @@ POLICIES: Dict[str, Dict[str, int]] = {
         # attempts over total sweep wall — redundant dispatch should stay
         # a tail bound, not a tax
         "hedge_wasted_fraction": -1,
+        # MFU-gap levers (PR 17): sequential non-overlapped GBT launch-
+        # levels on the critical path (packing + pipelining push it down;
+        # the perfgate keeps it down) and the cold-warmup compile share
+        "gbt_sequential_launches": -1,
+        "warmup_compile_s": -1,
     },
     "transform_stream_speedup": {
         "value": +1, "transform_rows_per_sec": +1,

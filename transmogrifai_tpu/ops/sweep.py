@@ -437,9 +437,35 @@ def _metrics_of_rs(spec, y, scores, val_w, rs):
     all_gather this shard's [F, n_local] score block to [F, n_pad] (a
     transient), evaluate the single-candidate metric kernels on globally
     ordered rows, and move on.  Padding rows carry zero validation weight and
-    the metric kernels already treat vm=0 rows as excluded."""
+    the metric kernels already treat vm=0 rows as excluded.
+
+    Candidate packing (``TMOG_SWEEP_PACK``): the map runs
+    ``_metric_pack_size()`` candidates per step (inner ``vmap``), so the
+    sequential step count drops from C to ``ceil(C / P)`` while each
+    candidate's math is the untouched single-candidate kernel.  The
+    candidate axis zero-pads up to a multiple of P (dummy lanes are
+    sliced off; their scores are zeros and their outputs discarded)."""
     problem, _, strict = spec
     ax = rs[0]
+    C = int(scores.shape[1])
+    k = problem[1] if isinstance(problem, tuple) else 1
+    P_pack = _metric_pack_size(C, int(scores.shape[0]),
+                               int(scores.shape[2]) * int(rs[2]), k)
+
+    def packed_map(body, xs):
+        if P_pack <= 1:
+            return lax.map(body, xs)
+        pad = (-C) % P_pack
+
+        def prep(a):
+            if pad:
+                a = jnp.concatenate(
+                    [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)], 0)
+            return a.reshape((-(-C // P_pack), P_pack) + a.shape[1:])
+
+        out = lax.map(jax.vmap(body), jax.tree.map(prep, xs))
+        return out.reshape((-1,) + out.shape[2:])[:C]
+
     y_full = mesh_all_gather(y, ax, axis=0)             # [n_pad]
     vw_full = mesh_all_gather(val_w, ax, axis=1)        # [F, n_pad]
     if isinstance(problem, tuple):
@@ -451,7 +477,7 @@ def _metrics_of_rs(spec, y, scores, val_w, rs):
             return jax.vmap(_multiclass_one, in_axes=(None, 0, 0))(
                 y1, sf, vw_full)                        # [F, M]
 
-        out = lax.map(one_mc, jnp.moveaxis(scores, 1, 0))
+        out = packed_map(one_mc, jnp.moveaxis(scores, 1, 0))
         return jnp.moveaxis(out, 0, 1)                  # [F, C, M]
     if problem == "binary":
         def one_bin(args):
@@ -460,8 +486,8 @@ def _metrics_of_rs(spec, y, scores, val_w, rs):
             return jax.vmap(_binary_one, in_axes=(None, 0, 0, None))(
                 y_full, sf, vw_full, st)                # [F, M]
 
-        out = lax.map(one_bin, (jnp.moveaxis(scores, 1, 0),
-                                jnp.asarray(strict, jnp.float32)))
+        out = packed_map(one_bin, (jnp.moveaxis(scores, 1, 0),
+                                   jnp.asarray(strict, jnp.float32)))
         return jnp.moveaxis(out, 0, 1)
 
     def one_reg(sc):
@@ -469,7 +495,7 @@ def _metrics_of_rs(spec, y, scores, val_w, rs):
         return jax.vmap(_regression_one, in_axes=(None, 0, 0))(
             y_full, sf, vw_full)
 
-    out = lax.map(one_reg, jnp.moveaxis(scores, 1, 0))
+    out = packed_map(one_reg, jnp.moveaxis(scores, 1, 0))
     return jnp.moveaxis(out, 0, 1)
 
 
@@ -509,6 +535,64 @@ def _run_rs(spec, mesh, n_orig, X, xbs, y, train_w, val_w, blob):
 SPLIT_METRICS_ELEMS = 20_000_000
 
 
+def _sweep_pack() -> bool:
+    """Candidate-packed launches (``TMOG_SWEEP_PACK``, default off).
+
+    On: the launcher builds cost-model-sized launch packs
+    (``parallel.spec_partition.launch_packs``) instead of one monolithic
+    queue per device, and the row-sharded metric pass evaluates
+    ``_metric_pack_size()`` candidates per ``lax.map`` step instead of one
+    — fewer sequential dispatches, bit-identical per-candidate math."""
+    from ..utils.env import env_flag
+
+    return env_flag("TMOG_SWEEP_PACK", False)
+
+
+def _gbt_pipeline() -> bool:
+    """Cross-device GBT pipelining (``TMOG_GBT_PIPELINE``, default off).
+
+    On (and > 1 shard): every partitioned shard forces the two-launch
+    stage split and dispatch is double-buffered across shards — shard i
+    holds its metrics (stage 2) dispatch until shard i+1's training/
+    histogram launch (stage 1) is in flight, so scoring on one device
+    overlaps histogram building on the next.  The hedge deadline clock
+    starts AFTER the pipelined prologue (stage compiles + stage-1
+    dispatch + the neighbor handshake)."""
+    from ..utils.env import env_flag
+
+    return env_flag("TMOG_GBT_PIPELINE", False)
+
+
+def _metric_pack_size(C: int, F: int, n_pad: int, k: int = 1) -> int:
+    """Candidates per packed metric-map step (row-sharded path).
+
+    The per-candidate transient of ``_metrics_of_rs`` is one gathered
+    [F, n_pad(, k)] score block; packing P candidates per ``lax.map``
+    step multiplies that transient by P, so P is the largest count whose
+    transients fit the ``TMOG_PACK_HBM_MB`` budget (the same analytic
+    budget ``launch_packs`` bins by).  Returns 1 unless
+    ``TMOG_SWEEP_PACK`` is on — the exact historical one-candidate map.
+    Pure function of static shapes, so the traced program and the
+    launcher's host-side telemetry agree by construction."""
+    if C <= 1 or not _sweep_pack():
+        return 1
+    from ..utils.env import env_float
+
+    budget = env_float("TMOG_PACK_HBM_MB", 2048.0) * 1e6
+    per_cand = max(float(F) * float(n_pad) * max(int(k), 1) * 4.0, 1.0)
+    return int(max(1, min(int(C), budget // per_cand)))
+
+
+def _trace_knobs() -> Tuple:
+    """Trace-affecting env knobs baked into compiled programs — part of
+    every AOT cache key, so flipping a knob mid-process re-lowers instead
+    of silently reusing the other configuration's executable (the jit
+    paths still need ``jax.clear_caches()``; see
+    tests/test_hist_subtract_parity.py)."""
+    return (Tr._hist_subtract(), Tr._hist_bf16(), Tr._bf16_hist_acc(),
+            _sweep_pack())
+
+
 #: kernel trace events (hist-subtraction savings) per (spec, n_rows).  jit
 #: caches traces, so only the FIRST execution of a program re-runs the
 #: Python-level ``record_trace_event`` calls — later calls (and ``.lower``
@@ -520,11 +604,12 @@ _TRACE_EVENT_CACHE: Dict[Tuple, Tuple] = {}
 
 
 def _replay_trace_events(spec, n: int, colls) -> None:
-    # keyed on the subtraction flag too: flipping TMOG_HIST_SUBTRACT
-    # mid-process must not replay the other configuration's savings
-    key = (spec, int(n), Tr._hist_subtract())
+    # keyed on the trace-shaping flags too: flipping TMOG_HIST_SUBTRACT /
+    # TMOG_BF16_HIST mid-process must not replay the other
+    # configuration's savings
+    key = (spec, int(n), Tr._hist_subtract(), Tr._bf16_hist_acc())
     events = tuple(c for c in colls
-                   if c[0] in ("hist_subtracted", "gbt_chain"))
+                   if c[0] in ("hist_subtracted", "gbt_chain", "bf16_hist"))
     if events:
         _TRACE_EVENT_CACHE[key] = events
     else:
@@ -676,7 +761,8 @@ def run_sweep(spec, X, xbs: Tuple, y, train_w, val_w, blob):
 _sweep_scope = obs_registry.scope("sweep", defaults={
     "launches": [], "fallbacks": [], "compiles": 0, "compile_s": 0.0,
     "pruned_candidates": 0, "full_candidates": 0, "checkpoint_skips": 0,
-    "hedges_fired": 0, "hedge_wasted_s": 0.0, "asha_rungs": []})
+    "hedges_fired": 0, "hedge_wasted_s": 0.0, "asha_rungs": [],
+    "sweep_pack_count": 0, "launches_avoided": 0})
 obs_registry.register_provider("sweep", lambda: run_stats())
 
 #: per-(name, spec, device, arg-signature) AOT executables.  jit's own cache
@@ -757,6 +843,21 @@ def run_stats() -> Dict[str, Any]:
             # deadline, and the losers' discarded wall (resilience/hedge)
             "hedges_fired": _sweep_scope.get("hedges_fired"),
             "hedge_wasted_s": _sweep_scope.get("hedge_wasted_s"),
+            # candidate packing (TMOG_SWEEP_PACK): packed launches built
+            # since reset, and sequential dispatches avoided vs the
+            # one-launch-per-candidate baseline (record_packs + the
+            # row-sharded metric map)
+            "sweep_pack_count": _sweep_scope.get("sweep_pack_count"),
+            "launches_avoided": _sweep_scope.get("launches_avoided"),
+            # sequential non-overlapped GBT launch-levels on the critical
+            # path: per launch the pipelined effective chain
+            # (gbt_chain_eff, measured dispatch-window overlap) when
+            # present, else the full dependency chain — knobs off this
+            # EQUALS gbt_chain_levels (the bench's historical
+            # gbt_sequential_launches number)
+            "gbt_sequential_launches": max(
+                (int((e.get("gbt_chain_eff") or e.get("gbt_chain", {}))
+                     .get("levels", 0)) for e in launches), default=0),
             # ASHA search: one record per completed rung (search/asha)
             "asha_rungs": _sweep_scope.list("asha_rungs"),
             "fallbacks": _sweep_scope.list("fallbacks")}
@@ -768,6 +869,17 @@ def record_warm_start(pruned: int, full: int) -> None:
     wipe them)."""
     _sweep_scope.set("pruned_candidates", int(pruned))
     _sweep_scope.set("full_candidates", int(full))
+
+
+def record_packs(n_packs: int, n_candidates: int) -> None:
+    """Stamp one packed dispatch's launch-count telemetry
+    (``TMOG_SWEEP_PACK``): ``n_candidates`` candidates ran as ``n_packs``
+    fused launches.  ``launches_avoided`` counts against the honest
+    one-launch-per-candidate dispatch baseline (the legacy per-family
+    path), the same basis ``sweep_pack_count`` packs are bounded by."""
+    _sweep_scope.inc("sweep_pack_count", int(n_packs))
+    _sweep_scope.inc("launches_avoided",
+                     max(int(n_candidates) - int(n_packs), 0))
 
 
 def record_rungs(rows) -> None:
@@ -784,7 +896,8 @@ def _aot(name: str, fn, spec, device, dyn_args) -> Tuple[Any, float, Tuple]:
     (kind, axis, bytes) event list (hist-subtraction savings etc., replayed
     into utils/flops per call).  All ``dyn_args`` must be committed to
     ``device`` so lowering bakes the placement in."""
-    key = (name, spec, device, flops._signature(dyn_args, {}))
+    key = (name, spec, device, _trace_knobs(),
+           flops._signature(dyn_args, {}))
     with _aot_lock:
         hit = _aot_cache.get(key)
     if hit is not None:
@@ -916,6 +1029,62 @@ def _stamp_cost_features(stat, costs) -> None:
         pass
 
 
+def _interval_cover(a: float, b: float, wins) -> float:
+    """Total length of [a, b] covered by the union of intervals ``wins``."""
+    segs = sorted((max(a, w0), min(b, w1)) for w0, w1 in wins
+                  if w1 > a and w0 < b)
+    tot, cur = 0.0, a
+    for s0, s1 in segs:
+        s0 = max(s0, cur)
+        if s1 > s0:
+            tot += s1 - s0
+            cur = s1
+    return tot
+
+
+def _pipeline_chain_eff(shards, stats, n_shards: int
+                        ) -> Optional[Dict[str, Any]]:
+    """Effective sequential (non-overlapped) GBT chain of one pipelined
+    launch: {"levels", "steps", "overlap_fraction"}.
+
+    The f32 boosting chain is a true data dependency — its level count
+    cannot shrink bit-identically — but under pipelined dispatch the
+    chain-bearing shard's device window runs CONCURRENTLY with the other
+    shards' windows, so the launch-critical-path accounting credits the
+    measured overlap: ``eff = ceil(levels * (1 - cover))`` where
+    ``cover`` is the fraction of the chain shard's dispatch->gather
+    window covered by the union of the other shards' windows, floored at
+    ``ceil(levels / n_shards)`` (perfect overlap still leaves the chain
+    spread across the fleet).  Telemetry only — never raises; None when
+    no chain shard carries a measured window."""
+    try:
+        import math
+
+        best = None
+        wins = [st.get("_win") for st in stats]
+        for i, (sh, st) in enumerate(zip(shards, stats)):
+            c = _spec_gbt_chain(sh.spec)
+            win = wins[i]
+            if not c or win is None or win[1] <= win[0]:
+                continue
+            a, b = win
+            others = [w for j, w in enumerate(wins) if j != i and w]
+            frac = min(max(_interval_cover(a, b, others) / (b - a), 0.0),
+                       1.0)
+            floor_div = max(int(n_shards), 1)
+            cand = {
+                "levels": max(int(math.ceil(c["levels"] * (1.0 - frac))),
+                              -(-int(c["levels"]) // floor_div)),
+                "steps": max(int(math.ceil(c["steps"] * (1.0 - frac))),
+                             -(-int(c["steps"]) // floor_div)),
+                "overlap_fraction": round(frac, 4)}
+            if best is None or cand["levels"] > best["levels"]:
+                best = cand
+        return best
+    except Exception:
+        return None
+
+
 def _shard_arrays(shard, dev, X, xbs, y, X_host, y_host, xb_bins):
     """Per-device copies of the shard's static arrays.
 
@@ -974,6 +1143,11 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
         _ckpt.data_fingerprint(y_host if y_host is not None else y),
         _ckpt.data_fingerprint(train_w), _ckpt.data_fingerprint(val_w))
 
+    # cross-device GBT pipelining: one handshake event per shard, set once
+    # that shard's stage-1 (training/histogram) launch is in flight
+    pipelined = _gbt_pipeline() and len(shards) > 1
+    pipe_evs = ([threading.Event() for _ in shards] if pipelined else None)
+
     def worker(shard, dev, idx, ctl=None):
         t0 = time.perf_counter()
         ck_key = None
@@ -984,7 +1158,11 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
             hit = _ck.load("sweep_shard", ck_key)
             if hit is not None:
                 # a checkpoint hit completes instantly, so it also
-                # short-circuits any pending hedge for this shard
+                # short-circuits any pending hedge for this shard — and
+                # must still release the pipeline handshake so the
+                # predecessor shard's stage 2 is not held back
+                if pipe_evs is not None:
+                    pipe_evs[idx].set()
                 _sweep_scope.inc("checkpoint_skips")
                 stat = {"device": str(dev), "candidates": len(shard.cis),
                         "predicted_cost": float(shard.cost),
@@ -1002,30 +1180,56 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
                 vw = jax.device_put(jnp.asarray(val_w), dev)
                 bl = jax.device_put(jnp.asarray(shard.blob), dev)
             C_s = len(shard.cis)
-            split = F * C_s * n * k > SPLIT_METRICS_ELEMS
+            # the pipelined path NEEDS the two-launch stage split: the
+            # scores/metrics boundary is where one shard's scoring can
+            # overlap the next shard's histogram building
+            split = pipelined or F * C_s * n * k > SPLIT_METRICS_ELEMS
             records = []
+            win = None
             _lg = _ledger.get()
             if split:
                 args_s = (Xd, xbs_d, yd, tw, bl)
                 cs, dt_s, ev_s = _aot("sweep.run_scores", _run_scores,
                                       shard.spec, dev, args_s)
                 _lt0 = _lg.now()
-                if ctl is not None:   # deadline clock starts at dispatch
+                if ctl is not None and not pipelined:
+                    # deadline clock starts at dispatch (pipelined: the
+                    # clock starts inside _go_split, after the prologue)
                     ctl.mark_dispatch()
 
                 def _go_split():
                     _inject.maybe_fail("sweep.dispatch", key=str(dev))
                     with trace.span("sweep.dispatch", device=str(dev),
-                                    shard=idx, split=True):
-                        scores = cs(*args_s)
+                                    shard=idx, split=True,
+                                    pipelined=bool(pipelined)):
+                        t_s1 = time.perf_counter()
+                        scores = cs(*args_s)   # stage 1 in flight (async)
+                        if pipelined:
+                            pipe_evs[idx].set()
                         args_m = (yd, scores, vw)
+                        # stage-2 AOT overlaps stage-1 execution: lowering
+                        # reads only the pending scores' aval
                         cm, dt_m, ev_m = _aot("sweep.run_metrics",
                                               _run_metrics, shard.spec, dev,
                                               args_m)
-                        return cm(*args_m), args_m, cm, dt_m, ev_m
+                        if pipelined:
+                            # double buffer: hold MY metrics dispatch until
+                            # the NEXT shard's histogram launch is in its
+                            # stream, so stage 2 here overlaps stage 1 there
+                            if idx + 1 < len(pipe_evs):
+                                pipe_evs[idx + 1].wait(timeout=60.0)
+                            if ctl is not None:
+                                # hedge clock starts AFTER the pipelined
+                                # prologue (compiles + stage-1 dispatch +
+                                # neighbor handshake) — a deadline that
+                                # included the prologue would hedge on
+                                # compile time, not device health
+                                ctl.mark_dispatch()
+                        return (cm(*args_m), args_m, cm, dt_m, ev_m, t_s1)
 
-                out, args_m, cm, dt_m, ev_m = _retry.with_retry(
+                out, args_m, cm, dt_m, ev_m, _ts1 = _retry.with_retry(
                     "sweep.dispatch", _go_split, deadline_s=_deadline)
+                win = _ts1
                 compile_s = dt_s + dt_m
                 records = [("sweep.run_scores", cs, args_s, ev_s),
                            ("sweep.run_metrics", cm, args_m, ev_m)]
@@ -1051,10 +1255,16 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
                             shard=idx) as _gsp:
                 out = np.asarray(out)
                 _gsp.set(bytes=int(out.nbytes))
+        t_done = time.perf_counter()
         stat = {"device": str(dev), "candidates": C_s,
                 "predicted_cost": float(shard.cost),
                 "compile_s": round(compile_s, 4), "split": bool(split),
-                "wall_s": round(time.perf_counter() - t0, 4)}
+                "wall_s": round(t_done - t0, 4)}
+        if pipelined and win is not None:
+            stat["pipelined"] = True
+            # stage-1-dispatch -> gather-end device window; consumed (and
+            # popped) by _pipeline_chain_eff's overlap accounting
+            stat["_win"] = (win, t_done)
         if _lg.enabled:
             # dispatch start -> gather end: the full device round trip the
             # ledger row reports (gather blocks in this thread, so this IS
@@ -1062,6 +1272,10 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
             stat["launch_wall_s"] = _lg.now() - _lt0
         feat = _shard_feat(shard.spec, n, d, F)
         if feat is not None:
+            # cost-model features for the new launch shapes (append-only
+            # FEATURE_NAMES tail; 0.0 == the historical unpacked launch)
+            feat["pack_size"] = float(C_s) if _sweep_pack() else 0.0
+            feat["pipeline_depth"] = 2.0 if pipelined else 0.0
             stat["feat"] = feat
         if ck_key is not None:
             _ck.save("sweep_shard", ck_key, {"metrics": out},
@@ -1204,6 +1418,15 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
         entry["hedges"] = hedge_events
     if chain:
         entry["gbt_chain"] = chain
+        if pipelined:
+            eff = _pipeline_chain_eff(shards, per_shard, len(shards))
+            if eff is not None:
+                entry["gbt_chain_eff"] = eff
+    for st in per_shard:
+        st.pop("_win", None)
+    if pipelined:
+        entry["pipelined"] = True
+        entry["pipeline_depth"] = 2
     _sweep_scope.append("launches", entry)
     return metrics
 
@@ -1216,7 +1439,7 @@ def _aot_rs(spec, submesh, n_orig: int, dyn_args) -> Tuple[Any, float, Tuple]:
     (kind, axis, bytes) collective list (replayed into utils/flops per call).
     The collective trace is captured at lowering and cached WITH the
     executable, so steady-state calls replay it without re-tracing."""
-    key = ("sweep.run_rs", spec, submesh, n_orig,
+    key = ("sweep.run_rs", spec, submesh, n_orig, _trace_knobs(),
            flops._signature(dyn_args, {}))
     with _aot_lock:
         hit = _aot_cache.get(key)
@@ -1390,6 +1613,11 @@ def run_sweep_rowsharded(shards, X, xbs: Tuple, y, train_w, val_w,
                            data_shards=int(n_data),
                            rows_local=n_pad // n_data)
         if feat is not None:
+            k_mc = (shard.spec[0][1]
+                    if isinstance(shard.spec[0], tuple) else 1)
+            feat["pack_size"] = float(_metric_pack_size(
+                len(shard.cis), F, n_pad, k_mc)) if _sweep_pack() else 0.0
+            feat["pipeline_depth"] = 0.0
             stat["feat"] = feat
         if ck_key is not None:
             _ck.save("sweep_shard", ck_key, {"metrics": out},
@@ -1485,6 +1713,14 @@ def run_sweep_rowsharded(shards, X, xbs: Tuple, y, train_w, val_w,
         cost = flops.record_compiled(name, compiled, args, device=label)
         flops.record_collectives(colls, device=label)
         _stamp_cost_features(stat, [cost] if cost else [])
+        # packed metric map: ceil(C/P) sequential map steps instead of C
+        # (same static formula the traced program used — the launch-count
+        # telemetry and the compiled loop agree by construction)
+        k_mc = shard.spec[0][1] if isinstance(shard.spec[0], tuple) else 1
+        mp = _metric_pack_size(len(shard.cis), F, n_pad, k_mc)
+        if mp > 1:
+            stat["metric_pack"] = int(mp)
+            record_packs(-(-len(shard.cis) // mp), len(shard.cis))
         if _lg.enabled:
             _lg.launch(name,
                        wall_s=stat.get("launch_wall_s",
@@ -1496,7 +1732,7 @@ def run_sweep_rowsharded(shards, X, xbs: Tuple, y, train_w, val_w,
                                                  F),
                        shard=j, device=label)
         for kind, axis, nbytes in colls:
-            if kind in ("hist_subtracted", "gbt_chain"):
+            if kind in ("hist_subtracted", "gbt_chain", "bf16_hist"):
                 continue  # kernel trace events, not mesh traffic
             agg = coll_agg.setdefault(axis, {"count": 0.0, "bytes": 0.0})
             agg["count"] += 1

@@ -257,6 +257,26 @@ def _hist_via_matmul(n: int, d: int, n_bins: int, c1: int = 2) -> bool:
     return float(n) * d * n_bins * c1 * (2 if _hist_bf16() else 4) <= 2e9
 
 
+def _bf16_hist_acc() -> bool:
+    """bf16 G/H histogram ACCUMULATION (``TMOG_BF16_HIST``, default off).
+
+    Distinct from ``_hist_bf16`` (TMOG_HIST_BF16), which casts the matmul
+    INPUTS to bf16 while still accumulating in f32: this knob makes the
+    accumulator itself bf16 (``preferred_element_type=bfloat16`` on the
+    level GEMMs / bf16 ``segment_sum``), halving the histogram HBM traffic
+    — the dominant memory stream of a level build.  Histograms are cast
+    back to f32 IMMEDIATELY after the build, before the data-axis psum and
+    all split-gain arithmetic, so cross-device reductions and gain math
+    stay f32; only the per-bin accumulation rounds (~8-bit mantissa).
+    Split choices can flip on near-ties; sweep-metric parity is pinned in
+    tests/test_sweep_pack.py.  Each level build emits a ``bf16_hist``
+    trace event carrying the bytes saved vs f32 (utils/flops bucket).
+    """
+    from ..utils.env import env_flag
+
+    return env_flag("TMOG_BF16_HIST", False)
+
+
 def bin_onehot(Xb, n_bins: int) -> jax.Array:
     """Gradient-FREE histogram RHS: [n, d*B] with entry (r, j*B + b) =
     1[bin(r, j) == b].  Depends only on the binned matrix, so boosting
@@ -295,9 +315,12 @@ def _level_histograms_mm(Og, S, w, m: int, n_bins: int, d: int, c1: int):
     the bins axis stays minor so no tensor has a 2-wide lane dimension.
     """
     Sw = S * w.astype(S.dtype)[:, None]
+    acc_dt = jnp.bfloat16 if _bf16_hist_acc() else jnp.float32
+    if acc_dt == jnp.bfloat16:
+        record_trace_event("bf16_hist", "mm", 2 * m * c1 * d * n_bins)
     GH = lax.dot_general(Sw.astype(Og.dtype), Og, (((0,), (0,)), ((), ())),
-                         preferred_element_type=jnp.float32)     # [m, c1*d*B]
-    GH = GH.reshape(m, c1, d, n_bins)
+                         preferred_element_type=acc_dt)          # [m, c1*d*B]
+    GH = GH.astype(jnp.float32).reshape(m, c1, d, n_bins)
     return GH[:, :c1 - 1], GH[:, c1 - 1]
 
 
@@ -312,12 +335,17 @@ def _level_histograms(Xb, ghw, row_slot, m: int, n_bins: int):
     d = Xb.shape[1]
     dead = row_slot < 0
     base = jnp.where(dead, m * B, row_slot * B)
+    if _bf16_hist_acc():
+        record_trace_event("bf16_hist", "segment",
+                           2 * m * ghw.shape[1] * d * B)
+        ghw = ghw.astype(jnp.bfloat16)
 
     def per_feature(bins_j):
         seg = base + jnp.where(dead, 0, bins_j)
         return jax.ops.segment_sum(ghw, seg, num_segments=m * B + 1)[:-1]
 
-    GH = jax.vmap(per_feature, in_axes=1, out_axes=0)(Xb)  # [d, m*B, c+1]
+    GH = jax.vmap(per_feature, in_axes=1,
+                  out_axes=0)(Xb).astype(jnp.float32)      # [d, m*B, c+1]
     c = ghw.shape[1] - 1
     GH = GH.reshape(d, m, B, c + 1).transpose(1, 3, 0, 2)  # [m, c1, d, B]
     return GH[:, :c], GH[:, c]
@@ -711,17 +739,22 @@ def _grow_level_batch(Xb, gh, w_t, feat_mask_t, nodes, leaf_val, slot_base,
         S_hist = S
         mh = m
     Sw = S_hist * w_t[:, None, :]                                   # [T, mh, n]
+    acc_dt = jnp.bfloat16 if _bf16_hist_acc() else jnp.float32
+    if acc_dt == jnp.bfloat16:
+        record_trace_event("bf16_hist", "mm_batch",
+                           2 * T * mh * (c + 1) * d * B)
     if gh_t is None:
         GH = lax.dot_general(Sw.reshape(T * mh, n).astype(Og.dtype), Og,
                              (((1,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32)
+                             preferred_element_type=acc_dt)
     else:
         # [T, mh, c1, n]: slot one-hot x per-tree weighted gradients
         L = Sw[:, :, None, :] * gh_t.transpose(0, 2, 1)[:, None, :, :]
         GH = lax.dot_general(L.reshape(T * mh * (c + 1), n).astype(Obin.dtype),
                              Obin, (((1,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    GH = GH.reshape(T, mh, c + 1, d, B)
+                             preferred_element_type=acc_dt)
+    # bf16 accumulation ends HERE: psum and split gains stay f32
+    GH = GH.astype(jnp.float32).reshape(T, mh, c + 1, d, B)
     # global per-bin stats under a row-sharded launch (see _grow_level);
     # subtracted levels psum only the light half of the payload
     GH = mesh_psum(GH, axis_name)

@@ -214,6 +214,74 @@ def partition_spec(spec, blob: np.ndarray, n_shards: int, n_rows: int,
     return shards
 
 
+def launch_packs(spec, blob: np.ndarray, n_slots: int, n_rows: int,
+                 n_features: int, n_folds: int,
+                 device_weights: Optional[List[float]] = None,
+                 budget_bytes: Optional[float] = None,
+                 cost_budget: Optional[float] = None) -> List[ShardSpec]:
+    """Cost-model-sized launch packs for the partitioned sweep
+    (``TMOG_SWEEP_PACK``).
+
+    Two-level packing: first the usual LPT device partition (identical to
+    :func:`partition_spec`, including learned-cost pricing under
+    ``TMOG_COSTMODEL=1`` and health-weighted slots), then each device
+    queue is split into one or more *packs* — each pack one fused XLA
+    launch — whenever the queue exceeds the per-launch budgets:
+
+    - **HBM budget** (``budget_bytes``, default ``TMOG_PACK_HBM_MB`` MB,
+      analytic): the fused program's transient score block is
+      ~``n_rows * n_folds * 4`` bytes per candidate, so at most
+      ``budget // per_cand_bytes`` candidates share a launch.
+    - **predicted-wall budget** (``cost_budget``, default
+      ``TMOG_PACK_COST_BUDGET``, in cost-provider units): with a resolved
+      cost provider (learned model or explicit), a queue whose predicted
+      cost exceeds the budget is split into ``ceil(cost / budget)``
+      LPT-balanced packs.  Unset (0) = no wall cap — the analytic
+      fallback packs by HBM alone.
+
+    At the default budgets every queue fits one pack, so the result is
+    the *same ``ShardSpec`` objects* ``partition_spec`` returns — the
+    packed launcher then runs byte-identical programs.  Every pack
+    carries ``slot`` = the device index it was balanced for (multiple
+    packs may share a slot; the launcher queues them in order on that
+    device).
+    """
+    from ..utils import env as _env
+
+    if budget_bytes is None:
+        budget_bytes = _env.env_float("TMOG_PACK_HBM_MB", 2048.0) * 1e6
+    if cost_budget is None:
+        cost_budget = _env.env_float("TMOG_PACK_COST_BUDGET", 0.0)
+    shards = partition_spec(spec, blob, n_slots, n_rows, n_features,
+                            n_folds, device_weights)
+    per_cand_bytes = max(float(n_rows) * max(int(n_folds), 1) * 4.0, 1.0)
+    cap_cands = max(1, int(budget_bytes // per_cand_bytes))
+    provider, _src = _resolve_cost_provider()
+
+    packs: List[ShardSpec] = []
+    for pos, sh in enumerate(shards):
+        slot = sh.slot if sh.slot is not None else pos
+        n_sub = -(-sh.n_candidates // cap_cands)  # ceil: HBM cap
+        if provider is not None and cost_budget > 0.0 and sh.cost > 0.0:
+            n_sub = max(n_sub, -(-int(math.ceil(sh.cost)) //
+                                 max(int(math.ceil(cost_budget)), 1)))
+        n_sub = min(max(n_sub, 1), sh.n_candidates)
+        if n_sub <= 1:
+            # untouched ShardSpec -> byte-identical program when packing
+            # changes nothing (the bit-exactness contract)
+            packs.append(ShardSpec(sh.spec, sh.blob, sh.cis, sh.cost,
+                                   slot=slot))
+            continue
+        for sub in partition_spec(sh.spec, sh.blob, n_sub, n_rows,
+                                  n_features, n_folds):
+            # sub.cis index the SHARD's local candidate order; map back
+            # to global candidate ids through the parent shard
+            gcis = tuple(sh.cis[i] for i in sub.cis)
+            packs.append(ShardSpec(sub.spec, sub.blob, gcis, sub.cost,
+                                   slot=slot))
+    return packs
+
+
 def rung_packs(spec, blob: np.ndarray, n_rows: int, n_features: int,
                n_folds: int, max_cands: int) -> List[ShardSpec]:
     """Cost-balanced LAUNCH packs for one ASHA rung on a single device.
