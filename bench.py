@@ -175,15 +175,45 @@ def titanic_arrays():
 
 
 def transform_bench():
-    """``bench.py --transform [rows]``: streamed vs per-stage transform wall.
+    """``bench.py --transform [rows] [--data-shards D]``: streamed transform wall.
 
     Times the workflow transform pipeline ONLY (fill + 2 vectorizers +
-    combiner + scaler, fitted once on a head sample) two ways over the same
-    rows: the per-stage host path (what ran above TMOG_FUSE_MAX_ROWS before
+    combiner + scaler, fitted once on a head sample) over the same rows:
+    the per-stage host path (what ran above TMOG_FUSE_MAX_ROWS before
     streaming) and the chunked streaming executor (workflow/stream.py).
     CPU-proxy friendly — run with JAX_PLATFORMS=cpu; the streamed number
     reports warm (includes the single compile) and steady separately.
+
+    ``--data-shards D`` additionally times the mesh-sharded stream path
+    (chunks round-robined over D data devices) against the single-device
+    streamed wall and emits ``transform_stream_sharded_speedup``.  On a
+    CPU host it forces ``xla_force_host_platform_device_count=D`` so the
+    proxy actually has D devices; parity vs the host path is asserted for
+    BOTH streamed runs (fill/concat bit-exact contract, scaler rtol 2e-6).
     """
+    data_shards = 0
+    argv = sys.argv[2:]
+    if "--data-shards" in argv:
+        i = argv.index("--data-shards")
+        data_shards = int(argv[i + 1])
+        argv = argv[:i] + argv[i + 2:]
+    if data_shards > 1 and os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            flags = (flags +
+                     f" --xla_force_host_platform_device_count={data_shards}")
+        # one compute thread per proxy device: the single-device baseline
+        # models ONE chip, the sharded run models D chips.  Without this the
+        # shared XLA intra-op pool lets the "single device" use every core
+        # and the proxy can never show device scaling.  TMOG_BENCH_PIN=0
+        # opts out.  NOTE: on a host with < D cores the sharded number is
+        # still core-bound — expect ~min(cores, D)/1 scaling, not Dx.
+        if (os.environ.get("TMOG_BENCH_PIN", "1") != "0"
+                and "intra_op_parallelism_threads" not in flags):
+            flags += (" --xla_cpu_multi_thread_eigen=false"
+                      " intra_op_parallelism_threads=1")
+        os.environ["XLA_FLAGS"] = flags.strip()
+
     import transmogrifai_tpu.types as T
     from transmogrifai_tpu import FeatureBuilder
     from transmogrifai_tpu.columns import Dataset, NumericColumn
@@ -194,7 +224,7 @@ def transform_bench():
     from transmogrifai_tpu.workflow import stream
 
     platform, fallback = init_backend()
-    rows = next((int(a) for a in sys.argv[2:] if a.isdigit()), 1_000_000)
+    rows = next((int(a) for a in argv if a.isdigit()), 1_000_000)
     n_feat = 8
     rng = np.random.default_rng(0)
     cols = {}
@@ -229,6 +259,14 @@ def transform_bench():
     # live={final}: the workflow's liveness pass materializes only columns
     # needed downstream — intermediates stay device-resident (the host path
     # has no such option; it materializes every stage output)
+    if data_shards > 1:
+        # pin the baseline pair to one device even when TMOG_MESH is set
+        os.environ["TMOG_STREAM_ROUTE"] = "single"
+        # unless the user pinned a chunking, pick one that gives every
+        # device ~2 chunks; both streamed runs use it (same-work compare)
+        if not os.environ.get("TMOG_TRANSFORM_CHUNK_ROWS"):
+            c = max(4096, -(-rows // (2 * data_shards)))
+            os.environ["TMOG_TRANSFORM_CHUNK_ROWS"] = str(-(-c // 256) * 256)
     flops.enable()
     stream.reset_stream_stats()
     t0 = time.perf_counter()
@@ -245,6 +283,40 @@ def transform_bench():
     s = stream.stream_stats()
     streamed_flops = flops.totals().get("streamed") or {}
     flops.disable()
+
+    sharded = None
+    if data_shards > 1:
+        os.environ.pop("TMOG_STREAM_ROUTE", None)
+        os.environ["TMOG_STREAM_SHARDS"] = str(data_shards)
+        stream.reset_stream_stats()
+        t0 = time.perf_counter()
+        out_sh = stream.apply_streamed(ds, layers, live={final})
+        sharded_warm_s = time.perf_counter() - t0
+        assert out_sh is not None, "sharded streaming declined the bench pipeline"
+        np.testing.assert_allclose(out_sh[final].values, host[final].values,
+                                   rtol=2e-6, atol=1e-6)
+        stream.reset_stream_stats()
+        t0 = time.perf_counter()
+        out_sh = stream.apply_streamed(ds, layers, live={final})
+        sharded_steady_s = time.perf_counter() - t0
+        ss = stream.stream_stats()
+        os.environ.pop("TMOG_STREAM_SHARDS", None)
+        sharded = {
+            "metric": "transform_stream_sharded_speedup",
+            "value": round(steady_s / sharded_steady_s, 2),
+            "unit": "x vs single-device streamed path",
+            "data_shards": data_shards,
+            "shards_used": ss["shards"],
+            "stream_warm_s": round(sharded_warm_s, 3),
+            "stream_steady_s": round(sharded_steady_s, 3),
+            "transform_rows_per_sec": round(ss["transform_rows_per_sec"]),
+            "chunks": ss["chunks"],
+            "compiles_steady": ss["compiles"],
+            "overlap_efficiency": round(ss["overlap_efficiency"], 3),
+            "prep_s": round(ss["prep_s"], 3),
+            "prep_blocked_s": round(ss["prep_blocked_s"], 3),
+            "by_device": {k: v["chunks"] for k, v in ss["by_device"].items()},
+        }
 
     report = {
         "metric": "transform_stream_speedup",
@@ -269,6 +341,7 @@ def transform_bench():
         "streamed_flops_bucket": streamed_flops,
         "platform": platform,
         **({"backend_fallback": fallback} if fallback else {}),
+        **({"sharded": sharded} if sharded else {}),
     }
     print(json.dumps(report))
     with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -277,6 +350,8 @@ def transform_bench():
     from transmogrifai_tpu import obs
 
     obs.write_record("bench", extra={"report": report})
+    if sharded:
+        obs.write_record("bench", extra={"report": sharded})
 
 
 def serve_bench():

@@ -238,7 +238,67 @@ def main():
             "transform_rows_per_sec": round(s["transform_rows_per_sec"]),
             "overlap_efficiency": round(s["overlap_efficiency"], 3),
             "fallbacks": s["fallbacks"],
+            # mesh-sharded stream telemetry: shard count the router used,
+            # host-prep walls (blocked share is what overlap_efficiency
+            # reads from), winner-score stages routed through the sharded
+            # head, and the per-device chunk/byte/wall split — an uneven
+            # by_device map at scale means a straggling data shard
+            "shards": s["shards"],
+            "prep_s": round(s["prep_s"], 3),
+            "prep_blocked_s": round(s["prep_blocked_s"], 3),
+            "score_stages": s["score_stages"],
+            "score_chunks": s["score_chunks"],
+            "by_device": {
+                k: {"chunks": v["chunks"], "rows": v["rows"],
+                    "bytes_in": round(v["bytes_in"]),
+                    "bytes_out": round(v["bytes_out"]),
+                    "upload_s": round(v["upload_s"], 3),
+                    "pull_wait_s": round(v["pull_wait_s"], 3)}
+                for k, v in (s["by_device"] or {}).items()
+            },
         }
+    # sharded-vs-single score pass (the "modelSelector.transform is
+    # single-chip" wall the mesh-sharded stream path attacks): when more
+    # than one stream device is active, score the trained model over the
+    # raw rows both ways and record the walls per stage — the single pass
+    # pins TMOG_STREAM_ROUTE=single, the sharded pass uses the mesh
+    try:
+        from transmogrifai_tpu.parallel import mesh as pmesh
+        if len(pmesh.stream_devices()) > 1:
+            def _timed_score(tag):
+                lst = OpListener(app_name=f"scale10m-score-{tag}",
+                                 collect_stage_metrics=True)
+                stream.reset_stream_stats()
+                t0 = time.perf_counter()
+                with lst.install():
+                    model.score(df)
+                wall = time.perf_counter() - t0
+                per_stage = {}
+                for m in lst.metrics.stage_metrics:
+                    key = f"{m.stage_name}.{m.phase}"
+                    per_stage[key] = round(per_stage.get(key, 0.0)
+                                           + m.duration_ms / 1e3, 2)
+                return wall, per_stage, stream.stream_stats()
+
+            os.environ["TMOG_STREAM_ROUTE"] = "single"
+            single_s, single_stages, _ = _timed_score("single")
+            os.environ.pop("TMOG_STREAM_ROUTE", None)
+            sharded_s, sharded_stages, ss = _timed_score("sharded")
+            out["score_walls"] = {
+                "single_s": round(single_s, 2),
+                "sharded_s": round(sharded_s, 2),
+                "speedup": round(single_s / max(sharded_s, 1e-9), 2),
+                "shards": ss["shards"],
+                "score_stages": ss["score_stages"],
+                "score_chunks": ss["score_chunks"],
+                "single_stage_s": single_stages,
+                "sharded_stage_s": sharded_stages,
+                "by_device": {k: v["chunks"]
+                              for k, v in (ss["by_device"] or {}).items()},
+            }
+            log(f"score single {single_s:.2f}s sharded {sharded_s:.2f}s")
+    except Exception as e:  # telemetry must never fail the scale run
+        out["score_walls"] = {"error": str(e)}
     if fallback:
         out["backend_fallback"] = fallback
     print(json.dumps(out))

@@ -204,7 +204,8 @@ def test_stream_samples_golden():
     st = stream_samples([_golden_row(_golden_feat())])
     assert st == [{"chunk_rows": 256, "buffers": 3, "rows": 1000.0,
                    "wall_s": 2.0, "rows_per_sec": 500.0,
-                   "handoff_bytes": 1024.0}]
+                   "handoff_bytes": 1024.0, "shards": 1,
+                   "overlap_efficiency": 0.0}]
     # stream snapshots with zero rows/wall are not evidence
     row = _golden_row(_golden_feat())
     row["snapshot"]["stream"]["rows"] = 0

@@ -103,7 +103,9 @@ def test_stream_parity_across_chunkings(monkeypatch, n, chunk, n_chunks, pad):
     assert s["pad_rows"] == pad
     assert s["rows"] == n
     assert s["stages_fused"] == 5
-    assert s["compiles"] <= 1  # exactly one program for all layers
+    # one program per chip: a data mesh (TMOG_MESH / TMOG_STREAM_SHARDS)
+    # specializes the same jit per committed device, never per chunk
+    assert s["compiles"] <= min(max(1, s["shards"]), s["chunks"])
     assert np.isfinite(out[nm].values).all()
 
 
@@ -114,8 +116,9 @@ def test_steady_state_reuses_compiled_program(monkeypatch):
 
     stream.reset_stream_stats()
     assert stream.apply_streamed(ds, layers) is not None
-    first = stream.stream_stats()["compiles"]
-    assert first <= 1
+    s0 = stream.stream_stats()
+    first = s0["compiles"]
+    assert first <= min(max(1, s0["shards"]), s0["chunks"])  # one per chip
     assert stream.apply_streamed(ds, layers) is not None
     s = stream.stream_stats()
     assert s["streams"] == 2
@@ -243,7 +246,7 @@ def test_onehot_host_prep_streams_bit_exact(monkeypatch):
                                   ref[_out_name(comb)].values)
     s = stream.stream_stats()
     assert s["chunks"] == 4 and s["pad_rows"] == 8
-    assert s["compiles"] <= 1
+    assert s["compiles"] <= min(max(1, s["shards"]), s["chunks"])  # one per chip
 
 
 def test_workflow_end_to_end_forced_streaming(monkeypatch):

@@ -306,9 +306,12 @@ def stream_samples(rows) -> List[Dict[str, Any]]:
         out.append({
             "chunk_rows": int(ck),
             "buffers": int(_finite(st.get("buffers"), 2.0) or 2.0),
+            "shards": int(_finite(st.get("shards"), 1.0) or 1.0),
             "rows": n_rows,
             "wall_s": wall,
             "rows_per_sec": n_rows / wall,
+            "overlap_efficiency": max(
+                _finite(st.get("overlap_efficiency")), 0.0),
             "handoff_bytes": max(_finite(st.get("handoff_bytes")), 0.0),
         })
     return out

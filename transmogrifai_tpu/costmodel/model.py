@@ -155,16 +155,24 @@ class CostModel:
 
     @staticmethod
     def _fit_stream(samples: List[Dict[str, Any]]) -> Dict[str, Any]:
-        """Best observed (chunk_rows, buffers) by streaming throughput."""
+        """Best observed (chunk_rows, buffers) by streaming throughput.
+
+        Aggregated PER SHARD COUNT: the profitable per-device chunk size
+        shrinks as the stream spreads over more devices (each chip sees
+        1/D of the rows but still wants a full in-flight window), so the
+        proposal carries a ``by_shards`` table and ``stream_proposal``
+        answers for the shard count the executor is about to run with."""
         agg: Dict[tuple, Dict[str, float]] = {}
         max_handoff = 0.0
         for s in samples:
             try:
-                key = (int(s["chunk_rows"]), int(s.get("buffers") or 2))
+                key = (int(s["chunk_rows"]), int(s.get("buffers") or 2),
+                       int(s.get("shards") or 1))
                 rows, wall = float(s["rows"]), float(s["wall_s"])
             except (KeyError, TypeError, ValueError):
                 continue
-            if rows <= 0 or wall <= 0 or key[0] <= 0 or key[1] <= 0:
+            if rows <= 0 or wall <= 0 or key[0] <= 0 or key[1] <= 0 \
+                    or key[2] <= 0:
                 continue
             a = agg.setdefault(key, {"rows": 0.0, "wall": 0.0})
             a["rows"] += rows
@@ -173,12 +181,22 @@ class CostModel:
                               float(s.get("handoff_bytes") or 0.0))
         if not agg:
             return {}
+        by_shards: Dict[str, Dict[str, Any]] = {}
+        for (chunk, buffers, shards), a in agg.items():
+            rps = a["rows"] / a["wall"]
+            cur = by_shards.get(str(shards))
+            if cur is None or rps > cur["rows_per_sec"]:
+                by_shards[str(shards)] = {
+                    "chunk_rows": int(chunk), "buffers": int(buffers),
+                    "rows_per_sec": round(rps, 2),
+                }
         best = max(agg.items(), key=lambda kv: kv[1]["rows"] / kv[1]["wall"])
-        (chunk, buffers), a = best
+        (chunk, buffers, _shards), a = best
         out: Dict[str, Any] = {
             "chunk_rows": int(chunk), "buffers": int(buffers),
             "rows_per_sec": round(a["rows"] / a["wall"], 2),
             "samples": len(samples),
+            "by_shards": by_shards,
         }
         if max_handoff > 0:
             # budget with 2x headroom over the biggest observed handoff so
@@ -209,9 +227,20 @@ class CostModel:
             raise RuntimeError("CostModel.unit_scale before fit/load")
         return self.family_scale.get(unit_family(kind), self.t0)
 
-    def stream_proposal(self) -> Dict[str, Any]:
-        """Autotune proposal for the streaming executor (possibly {})."""
-        return dict(self.stream)
+    def stream_proposal(self, shards: Optional[int] = None) -> Dict[str, Any]:
+        """Autotune proposal for the streaming executor (possibly {}).
+
+        With ``shards`` given, per-device evidence for that shard count
+        overrides the global best (chunk_rows, buffers) — unseen shard
+        counts keep the global best, so a first sharded run still gets a
+        sane window."""
+        out = dict(self.stream)
+        if shards is not None:
+            hit = (out.get("by_shards") or {}).get(str(int(shards)))
+            if hit:
+                out.update({k: hit[k] for k in ("chunk_rows", "buffers")})
+                out["rows_per_sec"] = hit["rows_per_sec"]
+        return out
 
     # -- persistence --------------------------------------------------------
     def to_dict(self) -> Dict[str, Any]:

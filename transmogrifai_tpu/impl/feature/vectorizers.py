@@ -473,6 +473,35 @@ class VectorsCombiner(SequenceTransformer):
         return vm
 
 
+def _scaler_moments(V: np.ndarray) -> tuple:
+    """Full-width column mean / population std for the scaler fit.
+
+    Past TMOG_SHARDED_FIT_ROWS (default 256Ki) with more than one stream
+    device, the moments reduce as per-device round-robin Chan partials
+    (``parallel/stats.sharded_column_moments``) so the fit shards over the
+    same devices the transform stream dispatches to; otherwise — and always
+    with TMOG_MESH unset — the host numpy path is bit-identical to the
+    pre-sharding behavior."""
+    from ...utils.env import env_int
+
+    n = V.shape[0]
+    if n > max(env_int("TMOG_SHARDED_FIT_ROWS", 1 << 18), 1):
+        try:
+            from ...parallel.mesh import stream_devices
+            from ...parallel.stats import sharded_column_moments
+
+            devs = stream_devices()
+            if len(devs) > 1:
+                _cnt, mean, std = sharded_column_moments(V, devices=devs)
+                return (np.asarray(mean, V.dtype),
+                        np.asarray(std, V.dtype))
+        except Exception:
+            from ...obs.registry import record_fallback
+
+            record_fallback("stream", "sharded_fit_failed", rows=int(n))
+    return V.mean(axis=0), V.std(axis=0)
+
+
 class StandardScalerVectorizer(UnaryEstimator):
     """Standardize an OPVector column (z-score); the OpScalarStandardScaler /
     Spark StandardScaler analog."""
@@ -486,8 +515,7 @@ class StandardScalerVectorizer(UnaryEstimator):
     def fit_columns(self, cols: Sequence[Column], dataset: Dataset) -> "StandardScalerModel":
         col = cols[0]
         assert isinstance(col, VectorColumn)
-        mean = col.values.mean(axis=0)
-        std = col.values.std(axis=0)
+        mean, std = _scaler_moments(col.values)
         std = np.where(std < 1e-12, 1.0, std)
         return StandardScalerModel(
             mean=mean if self.get_param("with_mean") else np.zeros_like(mean),

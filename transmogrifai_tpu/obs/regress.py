@@ -55,6 +55,11 @@ POLICIES: Dict[str, Dict[str, int]] = {
         "value": +1, "transform_rows_per_sec": +1,
         "stream_steady_s": -1, "stream_warm_s": -1, "compiles_steady": -1,
     },
+    "transform_stream_sharded_speedup": {
+        "value": +1, "transform_rows_per_sec": +1,
+        "overlap_efficiency": +1,
+        "stream_steady_s": -1, "stream_warm_s": -1, "compiles_steady": -1,
+    },
     "serve_replica_qps": {
         "value": +1, "warm_restart_speedup": +1, "p99_ms": -1,
         # data-plane hardening (PR 14): share of traffic quarantined /

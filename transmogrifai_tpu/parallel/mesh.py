@@ -89,6 +89,71 @@ def model_devices(mesh: Optional[Mesh] = None) -> list:
     return list(grid[tuple(index)])
 
 
+def data_devices(mesh: Optional[Mesh] = None) -> list:
+    """The devices along the ``data`` axis of ``mesh`` (default: the active
+    mesh) — one per row shard of the streaming transform executor.  Taken at
+    model-column 0: the streamed transforms replicate nothing across the
+    model axis, so each data shard runs on exactly one device.  Falls back
+    to the first local device when no mesh is active."""
+    m = mesh if mesh is not None else _ACTIVE_MESH
+    if m is None:
+        return [jax.devices()[0]]
+    grid = np.asarray(m.devices)
+    ax = list(m.axis_names).index(DATA_AXIS)
+    index = [0] * grid.ndim
+    index[ax] = slice(None)
+    return list(grid[tuple(index)])
+
+
+def stream_route() -> str:
+    """Chunk->device routing policy for the streamed transforms
+    (TMOG_STREAM_ROUTE): "roundrobin" (default) dispatches chunk k to data
+    device k mod D; "single"/"off" pins every chunk to the default device
+    (the legacy path)."""
+    from ..utils.env import env_str
+
+    return (env_str("TMOG_STREAM_ROUTE").strip().lower() or "roundrobin")
+
+
+def stream_shards() -> int:
+    """Data-parallel device count for the streamed transform executor.
+
+    Resolution: TMOG_STREAM_ROUTE=single|off forces 1; else an explicit
+    TMOG_STREAM_SHARDS wins; else the ``data`` axis of the active mesh (or
+    the TMOG_MESH env mesh when none is installed).  Always clamped to the
+    local device count, and 1 when nothing requests sharding — the
+    single-device path stays bit-identical with TMOG_MESH unset."""
+    from ..utils.env import env_int, env_set
+
+    if stream_route() in ("single", "off"):
+        return 1
+    if env_set("TMOG_STREAM_SHARDS"):
+        want = env_int("TMOG_STREAM_SHARDS", 1)
+    else:
+        m = _ACTIVE_MESH if _ACTIVE_MESH is not None else env_mesh()
+        if m is None or DATA_AXIS not in m.shape:
+            return 1
+        want = int(m.shape[DATA_AXIS])
+    return max(1, min(want, len(jax.devices())))
+
+
+def stream_devices() -> list:
+    """Dispatch targets for the streamed transforms: the first
+    ``stream_shards()`` devices along the data axis of the active/env mesh
+    (all local devices when sharding is requested without a mesh).  Returns
+    ``[None]`` when unsharded — the executor then uses the default device
+    exactly as before."""
+    D = stream_shards()
+    if D <= 1:
+        return [None]
+    m = _ACTIVE_MESH if _ACTIVE_MESH is not None else env_mesh()
+    devs = data_devices(m) if m is not None else list(jax.devices())
+    if len(devs) < D:
+        devs = list(jax.devices())
+    devs = devs[:D]
+    return devs if len(devs) > 1 else [None]
+
+
 def auto_mesh() -> Optional[Mesh]:
     """All local devices on the ``model`` axis (the OpValidator default) —
     the TPU replacement for the reference's 8-thread sweep pool

@@ -213,6 +213,96 @@ def _fused_stats_step(carry, X, yv, m):
 
 
 @jax.jit
+def _chan_moments_step(carry, X, m):
+    """One Chan pairwise-merge step of streaming column moments.
+
+    carry: (n, mean[d], M2[d]) with M2 the CENTERED sum of squares.  The
+    chunk is centered at its OWN mean and merged with the exact pairwise
+    cross term (the _fused_stats_step recipe minus the Gram), so no raw
+    second moments enter the f32 accumulator.  m masks padding rows."""
+    n0, mean0, M2 = carry
+    nc = m.sum()
+    ncs = jnp.maximum(nc, 1.0)
+    mc = (X * m[:, None]).sum(axis=0) / ncs
+    Z = (X - mc[None, :]) * m[:, None]
+    M2c = (Z * Z).sum(axis=0)
+    nt = n0 + nc
+    f = jnp.where(nt > 0, n0 * nc / jnp.maximum(nt, 1.0), 0.0)
+    dx = mc - mean0
+    M2 = M2 + M2c + f * dx * dx
+    mean = mean0 + dx * (nc / jnp.maximum(nt, 1.0))
+    return nt, mean, M2
+
+
+def _merge_moment_carries(carries):
+    """Chan-merge per-device (n, mean, M2) partials host-side in f64 — the
+    cross-device half of the reduction (ROADMAP item 4's per-host merge
+    pattern, applied across the stream devices of one host)."""
+    n_t: float = 0.0
+    mean_t = M2_t = None
+    for c in carries:
+        n_c, mean_c, M2_c = (np.asarray(x, np.float64) for x in c)
+        n_c = float(n_c)
+        if n_c <= 0:
+            continue
+        if mean_t is None:
+            n_t, mean_t, M2_t = n_c, mean_c, M2_c
+            continue
+        nt = n_t + n_c
+        dx = mean_c - mean_t
+        M2_t = M2_t + M2_c + (n_t * n_c / nt) * dx * dx
+        mean_t = mean_t + dx * (n_c / nt)
+        n_t = nt
+    return n_t, mean_t, M2_t
+
+
+def sharded_column_moments(X: np.ndarray, chunk_rows: int = 1 << 18,
+                           devices: Optional[list] = None
+                           ) -> Tuple[float, np.ndarray, np.ndarray]:
+    """Column mean and POPULATION std of ``X [n, d]`` via per-device
+    round-robin Chan partials.
+
+    Chunk i accumulates into device i-mod-D's carry, so each device runs an
+    independent async accumulation pipeline (no per-chunk lockstep
+    collective, unlike the mesh-placed ``DataShardedStats``), and the D
+    partial carries merge exactly at the end.  This is what the streamed
+    scaler fit reduces through when the transform stream is sharded — fit
+    and transform ride the same devices.  Returns ``(count, mean, std)``
+    f64; ``devices=None``/single runs the identical math on the default
+    device."""
+    X = np.asarray(X)
+    n = X.shape[0]
+    d = X.shape[1] if X.ndim > 1 else 1
+    X = X.reshape(n, d)
+    devices = list(devices) if devices else [None]
+    D = len(devices)
+    carries: list = [None] * D
+    for k, lo in enumerate(range(0, n, chunk_rows)):
+        chunk = np.ascontiguousarray(X[lo:lo + chunk_rows], np.float32)
+        rows = chunk.shape[0]
+        m = np.ones(rows, np.float32)
+        if rows < chunk_rows:  # constant chunk shape: one compile per device
+            chunk = np.concatenate(
+                [chunk, np.zeros((chunk_rows - rows, d), np.float32)])
+            m = np.concatenate([m, np.zeros(chunk_rows - rows, np.float32)])
+        di = k % D
+        dev = devices[di]
+        if carries[di] is None:
+            z = (jnp.zeros(()), jnp.zeros(d), jnp.zeros(d))
+            carries[di] = jax.device_put(z, dev) if dev is not None else z
+        xa = jax.device_put(chunk, dev) if dev is not None \
+            else jnp.asarray(chunk)
+        ma = jax.device_put(m, dev) if dev is not None else jnp.asarray(m)
+        carries[di] = _chan_moments_step(carries[di], xa, ma)
+    n_t, mean, M2 = _merge_moment_carries(
+        [c for c in carries if c is not None])
+    if not n_t or mean is None:
+        z = np.zeros(d)
+        return 0.0, z, z.copy()
+    return n_t, mean, np.sqrt(np.maximum(M2, 0.0) / n_t)
+
+
+@jax.jit
 def _midrank_cols(Xb):
     """Per-column average-tie midranks (1-based): f32[n, k] -> f32[n, k]."""
 

@@ -43,11 +43,27 @@ from ..workflow import stream
 from . import compile_cache
 from .registry import bucket_for
 
-__all__ = ["AotUnsupported", "BucketScorer"]
+__all__ = ["AotUnsupported", "BucketScorer", "head_program"]
 
 
 class AotUnsupported(RuntimeError):
     """Model's DAG has no fusable sub-DAG worth an AOT program."""
+
+
+def head_program(t: Any) -> Optional[Any]:
+    """The pure-JAX ``X -> (pred, raw|None, prob|None)`` closure for a
+    prediction-head stage, or None when the stage isn't a single-output
+    predictor or its family has no traceable program (the tree predictors
+    raise NotImplementedError).  The shared duck type between the
+    per-replica serving head AOT below and the sharded stream's
+    winner-score pass (``workflow/stream.score_head_sharded``)."""
+    cls = getattr(t, "predictor_class", None)
+    if cls is None or getattr(t, "n_outputs", 0) != 1:
+        return None
+    try:
+        return cls.predict_program(t.model_params)
+    except NotImplementedError:
+        return None
 
 
 #: in-process executables keyed (plan key, bucket, device): repeated deploys
@@ -285,7 +301,10 @@ class BucketScorer:
         state = self._heads.get(t.uid)
         if state is None or state[1] != V.shape:
             try:
-                program = cls.predict_program(t.model_params)
+                program = head_program(t)
+                if program is None:  # tree families: no traceable program
+                    self._heads[t.uid] = False
+                    return None
                 lowered = jax.jit(program).lower(
                     jax.device_put(jnp.zeros(V.shape, jnp.float32),
                                    self.device))

@@ -214,10 +214,21 @@ def _apply_layer_transforms(ds: Dataset, transformers: Sequence[Transformer],
     if fusables:
         with _maybe_time(_FusedLabel(fusables), "transform", len(ds)):
             new_cols.update(_fused_layer(ds, fusables))
+    big = len(ds) > _fuse_max_rows()
     for t in rest:
         out_feats = t.get_outputs()
         with _maybe_time(t, "transform", len(ds)):
-            col = t.transform_dataset(ds)
+            col = None
+            if big:
+                # past the fuse cliff, unfusable prediction heads (the
+                # winner's modelSelector.transform) score in round-robin
+                # chunks across the stream devices when a data mesh is
+                # active; None keeps the generic single-pass path
+                from . import stream as stream_mod
+
+                col = stream_mod.maybe_score_sharded(t, ds)
+            if col is None:
+                col = t.transform_dataset(ds)
         if t.n_outputs == 1:
             new_cols[out_feats[0].name] = col
         else:

@@ -29,6 +29,13 @@ class OpWorkflowModel(OpWorkflowCore):
         self.train_data: Optional[Dataset] = None  # transformed training data
 
     # ---- scoring (OpWorkflowModel.scala:261,333) ---------------------------
+    # All scoring entry points funnel through apply_transformations_dag:
+    # above the fuse cliff the transform layers stream in chunks, and when a
+    # data mesh is active (TMOG_MESH / TMOG_STREAM_SHARDS) both the streamed
+    # transforms AND the winner's score pass shard round-robin across the
+    # stream devices (workflow/stream.score_head_sharded).  Heads without a
+    # pure-JAX predict_program fall back to the single-chip transform with
+    # the reason recorded in stream_stats()["fallbacks"] — never an error.
     def score_fn(self) -> Callable[[Dataset], Dataset]:
         """Precompute the scoring DAG once; returns dataset -> scored dataset."""
         dag = self.dag

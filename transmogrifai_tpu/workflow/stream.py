@@ -16,10 +16,21 @@ minutes per layer.  This module removes the cliff:
   pulled, once per chunk.
 - ``execute`` streams fixed-size row chunks through the program: constant
   chunk shape (``TMOG_TRANSFORM_CHUNK_ROWS``) with a zero-padded, mask-aware
-  tail so there is exactly ONE compilation; async ``jax.device_put`` of
-  chunk k+1 overlaps the compute of chunk k (``TMOG_STREAM_BUFFERS``
-  bounds the in-flight window); input buffers are donated so XLA reuses
-  them in place.
+  tail so there is exactly ONE compilation per device; background prefetch
+  threads slice/pad chunk k+1's host buffers while chunk k computes, and
+  async ``jax.device_put`` + dispatch keep ``TMOG_STREAM_BUFFERS`` chunks
+  in flight per device; input buffers are donated so XLA reuses them in
+  place.
+- When a data mesh is active (TMOG_MESH / ``parallel.mesh.use_mesh``) or
+  ``TMOG_STREAM_SHARDS`` asks for it, chunks dispatch round-robin across
+  ``parallel.mesh.stream_devices()`` (``TMOG_STREAM_ROUTE`` policy): the
+  per-chunk program compiles once per device and D chunks compute
+  concurrently, one per chip.  Prediction-head stages exposing the
+  ``predict_program`` contract additionally score in round-robin chunks
+  across the same devices (``score_head_sharded``) so the winner's
+  ``modelSelector.transform`` stops being a single-chip full-width pass.
+  With TMOG_MESH unset and no explicit shard request the executor is
+  bit-identical to the single-device path.
 - When a downstream consumer is the model selector, the final feature
   matrix chunks are additionally kept device-side (``device_view`` /
   ``handoff_rows``) and seeded into ``utils.devcache`` so the selector
@@ -41,6 +52,7 @@ the transfer-wait share of wall time (overlap efficiency).
 from __future__ import annotations
 
 import os
+import queue
 import threading
 import time
 import warnings
@@ -81,7 +93,13 @@ def _autotune_proposal() -> Dict[str, Any]:
         m = costmodel.active_model()
         if m is None:
             return {}
-        prop = m.stream_proposal()
+        try:
+            from ..parallel import mesh as pmesh
+
+            shards = pmesh.stream_shards()
+        except Exception:
+            shards = None
+        prop = m.stream_proposal(shards=shards)
         if prop:
             _stream_scope.set("autotune", dict(prop))
         return prop
@@ -132,6 +150,31 @@ def handoff_budget_bytes() -> int:
                  "handoff_budget_bytes", floor=None)
 
 
+def prefetch_workers(n_devices: int = 1) -> int:
+    """Background host-prep threads per stream (TMOG_STREAM_PREFETCH).
+
+    0 disables prefetch (chunk slicing/padding runs inline on the dispatch
+    thread — the pre-pipelined behavior, where ``overlap_efficiency``
+    honestly reports ~0).  Default: one worker per stream device, capped at
+    4 — host prep is numpy memcpy-bound and oversubscribing it just churns
+    the GIL."""
+    if env.env_set("TMOG_STREAM_PREFETCH"):
+        return max(0, env.env_int("TMOG_STREAM_PREFETCH", 1))
+    return max(1, min(int(n_devices), 4))
+
+
+def _stream_devices() -> list:
+    """Dispatch targets for this stream: ``[None]`` (legacy default device)
+    unless a data mesh / TMOG_STREAM_SHARDS requests sharding — see
+    ``parallel.mesh.stream_devices``.  Never raises."""
+    try:
+        from ..parallel import mesh as pmesh
+
+        return pmesh.stream_devices()
+    except Exception:
+        return [None]
+
+
 # ---------------------------------------------------------------------------
 # Telemetry (ops/sweep.run_stats pattern) — storage lives in the central obs
 # registry (scope "stream"); stream_stats() below is the backward-compatible
@@ -139,13 +182,15 @@ def handoff_budget_bytes() -> int:
 # ---------------------------------------------------------------------------
 _stream_scope = obs_registry.scope("stream", defaults=dict(
     streams=0, chunks=0, rows=0, pad_rows=0, chunk_rows=0, buffers=0,
-    stages_fused=0, stages_host=0, layers=0,
+    shards=0, stages_fused=0, stages_host=0, layers=0,
     terminals=0, device_only=0,
     bytes_in=0.0, bytes_out=0.0, compiles=0,
     device_handoffs=0, handoff_bytes=0.0,
     upload_s=0.0, pull_wait_s=0.0, wall_s=0.0,
+    prep_s=0.0, prep_blocked_s=0.0,
+    score_stages=0, score_chunks=0,
     checkpoint_skips=0, quarantined=0,
-    autotune={}, fallbacks=[],
+    by_device={}, autotune={}, fallbacks=[],
 ))
 
 
@@ -156,11 +201,20 @@ def reset_stream_stats() -> None:
 def stream_stats() -> Dict[str, Any]:
     out = _stream_scope.snapshot()
     wall = out["wall_s"]
-    # device-busy vs transfer-wait: share of stream wall NOT spent blocked
-    # on host-side chunk prep/upload or on output pulls
-    out["overlap_efficiency"] = (
-        max(0.0, 1.0 - (out["pull_wait_s"] + out["upload_s"]) / wall)
-        if wall > 0 else 0.0)
+    # overlap = share of host-side chunk prep genuinely hidden behind device
+    # execution: prep_s is the work the prefetch threads did, prep_blocked_s
+    # is how long the dispatch thread actually stalled waiting for them.
+    # The old definition (1 - transfer/wall) read 0.002 because "upload_s"
+    # included the inline host prep that serialized the whole pipeline; with
+    # prefetch off, prep_blocked_s == prep_s and this still honestly reads 0.
+    prep = out["prep_s"]
+    if prep > 0:
+        out["overlap_efficiency"] = max(
+            0.0, min(1.0, 1.0 - out["prep_blocked_s"] / prep))
+    else:
+        out["overlap_efficiency"] = (
+            max(0.0, 1.0 - (out["pull_wait_s"] + out["upload_s"]) / wall)
+            if wall > 0 else 0.0)
     out["transform_rows_per_sec"] = out["rows"] / wall if wall > 0 else 0.0
     return out
 
@@ -576,10 +630,21 @@ def device_view(host_arr) -> Optional[Any]:
     if ent is None:
         return None
     if ent["full"] is None:
+        import jax
         import jax.numpy as jnp
 
         parts = [a if int(a.shape[0]) == r else a[:r]
                  for a, r in ent["chunks"]]
+        if len(parts) > 1:
+            # a sharded stream leaves chunks committed to different devices;
+            # concatenation needs them co-located — gather onto the first
+            # chunk's device (no-op copies when already there)
+            try:
+                d0 = next(iter(parts[0].devices()))
+                parts = [p if next(iter(p.devices())) == d0
+                         else jax.device_put(p, d0) for p in parts]
+            except Exception:
+                pass  # uncommitted arrays (single-device path): as before
         ent["full"] = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
         ent["chunks"] = []  # drop per-chunk refs; keep one buffer
     return ent["full"]
@@ -615,16 +680,23 @@ def clear_views() -> None:
 def execute(plan: StreamPlan, ds: Dataset) -> Dict[str, Any]:
     """Stream ``ds`` through the plan's jitted per-chunk program.
 
-    Returns the materialized terminal columns (name -> Column).  Uses a
-    bounded in-flight window: JAX dispatch is async, so while chunk k's
-    program runs, chunk k+1's host slices are prepared and uploaded; pulls
-    block only when the window (TMOG_STREAM_BUFFERS) is full.
+    Returns the materialized terminal columns (name -> Column).  Three-deep
+    pipeline: background prefetch threads slice/pad host chunk buffers,
+    the dispatch thread round-robins ``device_put`` + async launch across
+    the stream devices (one jit specialization per device), and pulls block
+    only when a device's in-flight window (TMOG_STREAM_BUFFERS) is full.
     """
     import jax
 
     C = chunk_rows()
     B = stream_buffers()
     n = len(ds)
+    devs = _stream_devices()
+    D = len(devs)
+    dev_labels = [str(d) if d is not None else "default" for d in devs]
+    perdev: Dict[str, Dict[str, float]] = {
+        lbl: dict(chunks=0, rows=0, bytes_in=0.0, bytes_out=0.0,
+                  upload_s=0.0, pull_wait_s=0.0) for lbl in dev_labels}
     jitted = _program_for(plan)
     cs_before = _cache_size(jitted)
     bytes_in0 = _stream_scope.get("bytes_in")
@@ -677,14 +749,17 @@ def execute(plan: StreamPlan, ds: Dataset) -> Dict[str, Any]:
         return True
 
     def drain(item) -> None:
-        lo, rows, outs, ck_key = item
+        lo, rows, outs, ck_key, di = item
+        label = dev_labels[di]
         t0 = time.perf_counter()
         saved: Dict[str, np.ndarray] = {}
+        b_out0 = _stream_scope.get("bytes_out")
 
         def _pull():
             _inject.maybe_fail("stream.pull", key=lo)
             pulled = 0
-            with trace.span("stream.chunk.pull", lo=lo, rows=rows) as _psp:
+            with trace.span("stream.chunk.pull", lo=lo, rows=rows,
+                            device=label) as _psp:
                 for e in terminals:
                     o = outs[e.out_name]
                     if e.out_kind == "numeric":
@@ -718,19 +793,87 @@ def execute(plan: StreamPlan, ds: Dataset) -> Dict[str, Any]:
         if ck_key is not None:
             _ck.save("stream_chunk", ck_key, saved, meta={"lo": lo,
                                                           "rows": rows})
-        _stream_scope.inc("pull_wait_s", time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        _stream_scope.inc("pull_wait_s", dt)
+        pd = perdev[label]
+        pd["pull_wait_s"] += dt
+        pd["bytes_out"] += float(_stream_scope.get("bytes_out") - b_out0)
 
     inflight: deque = deque()
+    counts = [0] * D
     n_chunks = 0
     restored = 0
-    with trace.span("stream.execute", rows=n, chunk_rows=C, window=B):
-        for lo in range(0, n, C):
-            hi = min(lo + C, n)
-            rows = hi - lo
-            t0 = time.perf_counter()
-            with trace.span("stream.chunk.upload", lo=lo, rows=rows) as _usp:
-                host_args, nbytes = _host_chunk_args(plan, ds, lo, hi, C)
-                _usp.set(bytes=int(nbytes))
+    dispatched = 0
+    chunk_los = list(range(0, n, C))
+
+    # ---- host-prep prefetch pool -------------------------------------------
+    # Chunk slicing/padding used to run inline on the dispatch thread, which
+    # serialized the whole pipeline (the overlap_efficiency=0.002 bug: the
+    # "async" upload of chunk k+1 could not start until its host prep
+    # finished, which could not start until chunk k's pull returned).  Prep
+    # now runs in background threads feeding a bounded queue; chunks may
+    # arrive out of order (row slices are disjoint, so assembly is
+    # order-free), and with one worker the prep order is unchanged.
+    task_q: "queue.Queue" = queue.Queue()
+    for lo in chunk_los:
+        task_q.put(lo)
+    out_q: "queue.Queue" = queue.Queue(maxsize=max(2, B * D))
+    stop_evt = threading.Event()
+
+    def _prep_one(lo: int):
+        hi = min(lo + C, n)
+        t0 = time.perf_counter()
+        with trace.span("stream.chunk.prep", lo=lo, rows=hi - lo):
+            host_args, nbytes = _host_chunk_args(plan, ds, lo, hi, C)
+        return lo, hi, host_args, nbytes, time.perf_counter() - t0
+
+    def _prefetch_worker() -> None:
+        while not stop_evt.is_set():
+            try:
+                lo = task_q.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                item = ("ok",) + _prep_one(lo)
+            except BaseException as e:  # noqa: BLE001 — re-raised on dispatch
+                item = ("err", e)
+            while not stop_evt.is_set():
+                try:
+                    out_q.put(item, timeout=0.05)
+                    break
+                except queue.Full:
+                    continue
+            if item[0] == "err":
+                return
+
+    workers = [threading.Thread(target=_prefetch_worker, daemon=True,
+                                name=f"tmog-stream-prep-{i}")
+               for i in range(min(prefetch_workers(D), len(chunk_los)))]
+
+    def _next_prepped():
+        """The next prepped chunk; the dispatch thread's stall time here is
+        the overlap metric's numerator (prep_blocked_s)."""
+        if not workers:  # TMOG_STREAM_PREFETCH=0: inline, fully blocking
+            item = ("ok",) + _prep_one(task_q.get_nowait())
+            _stream_scope.inc("prep_s", item[5])
+            _stream_scope.inc("prep_blocked_s", item[5])
+            return item[1:]
+        t0 = time.perf_counter()
+        item = out_q.get()
+        _stream_scope.inc("prep_blocked_s", time.perf_counter() - t0)
+        if item[0] == "err":
+            raise item[1]
+        _stream_scope.inc("prep_s", item[5])
+        return item[1:]
+
+    try:
+        with trace.span("stream.execute", rows=n, chunk_rows=C, window=B,
+                        shards=D):
+            for w in workers:
+                w.start()
+            for _ in range(len(chunk_los)):
+                lo, hi, host_args, nbytes, _pw = _next_prepped()
+                rows = hi - lo
                 ck_key = None
                 if _ck.enabled:
                     ck_key = _chunk_key(lo, host_args)
@@ -748,32 +891,64 @@ def execute(plan: StreamPlan, ds: Dataset) -> Dict[str, Any]:
                 pol = _quar.policy()
                 if pol:
                     _quarantine_chunk(plan, host_args, lo, rows, pol)
+                di = dispatched % D
+                dev = devs[di]
+                label = dev_labels[di]
+                t0 = time.perf_counter()
+                with trace.span("stream.chunk.upload", lo=lo, rows=rows,
+                                device=label) as _usp:
+                    _usp.set(bytes=int(nbytes))
 
-                def _go():
-                    _inject.maybe_fail("stream.upload", key=lo)
-                    dev_args = jax.device_put(host_args)
-                    with warnings.catch_warnings():
-                        # XLA can't reuse every donated buffer (e.g. bool
-                        # masks with no same-shape output); that's expected,
-                        # not actionable
-                        warnings.filterwarnings(
-                            "ignore",
-                            message="Some donated buffers were not usable")
-                        # async dispatch; donates the uploads
-                        return jitted(dev_args)
+                    def _go(dev=dev, host_args=host_args, lo=lo):
+                        _inject.maybe_fail("stream.upload", key=lo)
+                        # committed transfer: jit specializes per device, so
+                        # the D-device stream compiles once per chip
+                        dev_args = (jax.device_put(host_args, dev)
+                                    if dev is not None
+                                    else jax.device_put(host_args))
+                        with warnings.catch_warnings():
+                            # XLA can't reuse every donated buffer (e.g. bool
+                            # masks with no same-shape output); that's
+                            # expected, not actionable
+                            warnings.filterwarnings(
+                                "ignore",
+                                message="Some donated buffers were not usable")
+                            # async dispatch; donates the uploads
+                            return jitted(dev_args)
 
-                outs = _retry.with_retry("stream.upload", _go)
-            _stream_scope.inc("upload_s", time.perf_counter() - t0)
-            _stream_scope.inc("bytes_in", nbytes)
-            _stream_scope.inc("pad_rows", C - rows)
-            n_chunks += 1
-            for nm in plan.handoff:
-                hand_chunks[nm].append((outs[nm], rows))
-            inflight.append((lo, rows, outs, ck_key))
-            while len(inflight) > B:
-                drain(inflight.popleft())
-        while inflight:
-            drain(inflight.popleft())
+                    outs = _retry.with_retry("stream.upload", _go)
+                dt = time.perf_counter() - t0
+                _stream_scope.inc("upload_s", dt)
+                _stream_scope.inc("bytes_in", nbytes)
+                _stream_scope.inc("pad_rows", C - rows)
+                pd = perdev[label]
+                pd["chunks"] += 1
+                pd["rows"] += rows
+                pd["bytes_in"] += float(nbytes)
+                pd["upload_s"] += dt
+                n_chunks += 1
+                dispatched += 1
+                for nm in plan.handoff:
+                    hand_chunks[nm].append((lo, outs[nm], rows))
+                inflight.append((lo, rows, outs, ck_key, di))
+                counts[di] += 1
+                while counts[di] > B:
+                    it = inflight.popleft()
+                    counts[it[4]] -= 1
+                    drain(it)
+            while inflight:
+                it = inflight.popleft()
+                counts[it[4]] -= 1
+                drain(it)
+    finally:
+        stop_evt.set()
+        try:  # unblock any worker parked on a full queue, then reap
+            while True:
+                out_q.get_nowait()
+        except queue.Empty:
+            pass
+        for w in workers:
+            w.join(timeout=5.0)
 
     cs_after = _cache_size(jitted)
     if cs_before is not None and cs_after is not None:
@@ -782,6 +957,16 @@ def execute(plan: StreamPlan, ds: Dataset) -> Dict[str, Any]:
     _stream_scope.inc("chunks", n_chunks)
     _stream_scope.set("chunk_rows", C)
     _stream_scope.set("buffers", B)
+    _stream_scope.set("shards", D)
+    bd = dict(_stream_scope.get("by_device") or {})
+    for label, v in perdev.items():
+        if not v["chunks"]:
+            continue
+        cur = dict(bd.get(label) or {})
+        for k2, val in v.items():
+            cur[k2] = cur.get(k2, 0) + val
+        bd[label] = cur
+    _stream_scope.set("by_device", bd)
     _stream_scope.inc("rows", n)
     _stream_scope.inc("terminals", len(terminals))
     _stream_scope.inc("device_only", len(plan.stages) - len(terminals))
@@ -810,8 +995,160 @@ def execute(plan: StreamPlan, ds: Dataset) -> Dict[str, Any]:
             obs_registry.record_fallback("stream", "handoff_skipped_resume",
                                          name=nm, restored=restored)
         elif chunks and nm in new_cols:
-            _register_view(new_cols[nm].values, chunks, n)
+            # prefetch may dispatch chunks out of row order; the view is a
+            # row-ordered concat
+            ordered = [(a, r) for _lo, a, r in
+                       sorted(chunks, key=lambda c: c[0])]
+            _register_view(new_cols[nm].values, ordered, n)
     return new_cols
+
+
+# ---------------------------------------------------------------------------
+# Sharded winner scoring (the modelSelector.transform wall)
+# ---------------------------------------------------------------------------
+#: jitted predict programs keyed by head-stage identity; values pin the stage
+#: so the id() key can't be recycled (the _PROGRAMS idiom)
+_HEAD_JITS: "OrderedDict[int, Tuple[Any, Any]]" = OrderedDict()
+_HEAD_JITS_MAX = 16
+_HEAD_LOCK = threading.Lock()
+
+
+def _head_jit(t):
+    """One jitted ``X -> (pred, raw|None, prob|None)`` program per head
+    stage, via the same ``predict_program`` duck type the serving-side
+    ``serve/aot.BucketScorer._head_call`` AOT-compiles per replica.  jit
+    specializes per committed device, so the round-robin score pass below
+    compiles once per chip.  Raises NotImplementedError for heads without a
+    pure-JAX program (the tree families)."""
+    import jax
+
+    key = id(t)
+    with _HEAD_LOCK:
+        hit = _HEAD_JITS.get(key)
+        if hit is not None:
+            _HEAD_JITS.move_to_end(key)
+            return hit[0]
+    from ..serve.aot import head_program
+
+    program = head_program(t)
+    if program is None:
+        raise NotImplementedError("head has no predict_program")
+    built = (jax.jit(program), t)
+    with _HEAD_LOCK:
+        hit = _HEAD_JITS.setdefault(key, built)
+        while len(_HEAD_JITS) > _HEAD_JITS_MAX:
+            _HEAD_JITS.popitem(last=False)
+    return hit[0]
+
+
+def score_head_sharded(t, ds: Dataset, devs: Optional[list] = None):
+    """Chunked multi-device score pass for a prediction-head stage.
+
+    The winner model (``modelSelector.transform``) has no ``jax_transform``,
+    so on the legacy path it scores the full feature matrix in one
+    single-chip pass.  When the stream is sharded this routes heads exposing
+    the pure-JAX ``predict_program`` contract through round-robin chunks
+    across the stream devices — the same per-device in-flight window as the
+    transform stream.  Returns the assembled PredictionColumn, or None when
+    it can't apply (not a head, no program, single device, any failure) —
+    always a recorded fallback for real heads, never an error."""
+    import jax
+
+    from ..columns import PredictionColumn
+
+    cls = getattr(t, "predictor_class", None)
+    if cls is None or getattr(t, "n_outputs", 0) != 1:
+        return None
+    vec = ds.columns.get(t.inputs[-1].name)
+    if not isinstance(vec, VectorColumn):
+        return None
+    if devs is None:
+        devs = _stream_devices()
+    D = len(devs)
+    n = len(ds)
+    if D <= 1 or n == 0:
+        return None
+    try:
+        jitted = _head_jit(t)
+    except NotImplementedError:
+        record_fallback("score_head_no_program", stage=type(t).__name__,
+                        head=cls.__name__)
+        return None
+    except Exception as e:  # noqa: BLE001 — scoring must not break
+        record_fallback("score_head_failed", stage=type(t).__name__,
+                        error=str(e))
+        return None
+    C = chunk_rows()
+    B = stream_buffers()
+    try:
+        pred: Optional[np.ndarray] = None
+        raw: Optional[np.ndarray] = None
+        prob: Optional[np.ndarray] = None
+
+        def assemble(item) -> None:
+            nonlocal pred, raw, prob
+            lo, rows, outs = item
+            p, r, q = outs
+            hp = np.asarray(p)
+            if pred is None:
+                pred = np.empty(n, np.float64)
+            pred[lo:lo + rows] = hp[:rows]
+            if r is not None:
+                hr = np.asarray(r)
+                if raw is None:
+                    raw = np.empty((n,) + hr.shape[1:], np.float64)
+                raw[lo:lo + rows] = hr[:rows]
+            if q is not None:
+                hq = np.asarray(q)
+                if prob is None:
+                    prob = np.empty((n,) + hq.shape[1:], np.float64)
+                prob[lo:lo + rows] = hq[:rows]
+
+        inflight: deque = deque()
+        n_chunks = 0
+        with trace.span("stream.score", rows=n, chunk_rows=C, shards=D,
+                        head=cls.__name__):
+            for k, lo in enumerate(range(0, n, C)):
+                hi = min(lo + C, n)
+                rows = hi - lo
+                chunk = _pad0(np.ascontiguousarray(
+                    vec.values[lo:hi], np.float32), C - rows)
+                dev = devs[k % D]
+                label = str(dev) if dev is not None else "default"
+                with trace.span("stream.score.chunk", lo=lo, rows=rows,
+                                device=label):
+                    xa = (jax.device_put(chunk, dev) if dev is not None
+                          else jax.device_put(chunk))
+                    outs = jitted(xa)  # async dispatch
+                inflight.append((lo, rows, outs))
+                n_chunks += 1
+                while len(inflight) > B * D:
+                    assemble(inflight.popleft())
+            while inflight:
+                assemble(inflight.popleft())
+        col = PredictionColumn(T.Prediction, pred, raw, prob)
+        summary = getattr(t, "summary", None)
+        if summary is not None:  # the SelectedModel metadata contract
+            col.metadata = {"model_selector_summary": summary.to_json()}
+        _stream_scope.inc("score_stages")
+        _stream_scope.inc("score_chunks", n_chunks)
+        return col
+    except Exception as e:  # noqa: BLE001 — fall back to transform_dataset
+        record_fallback("score_head_failed", stage=type(t).__name__,
+                        error=str(e))
+        return None
+
+
+def maybe_score_sharded(t, ds: Dataset):
+    """Route one unfusable stage through the sharded score pass when a data
+    mesh is active; None (with the reason recorded for real heads) keeps the
+    caller's generic ``transform_dataset`` path."""
+    if not enabled():
+        return None
+    devs = _stream_devices()
+    if len(devs) <= 1:
+        return None
+    return score_head_sharded(t, ds, devs=devs)
 
 
 class _StreamLabel:
@@ -854,6 +1191,7 @@ def apply_streamed(ds: Dataset, layers: Sequence[Sequence[Any]],
     with dag_util._maybe_time(_StreamLabel(plan), "transform", n):
         new_cols = execute(plan, ds)
     ds = ds.with_columns(new_cols)
+    devs = _stream_devices()
     for layer in plan.host_layers:
         if not layer:
             continue
@@ -861,7 +1199,13 @@ def apply_streamed(ds: Dataset, layers: Sequence[Sequence[Any]],
         for t in layer:
             out_feats = t.get_outputs()
             with dag_util._maybe_time(t, "transform", n):
-                col = t.transform_dataset(ds)
+                # sharded winner scoring: prediction heads ride the same
+                # device round-robin as the transform chunks instead of a
+                # single-chip full-width pass
+                col = (score_head_sharded(t, ds, devs=devs)
+                       if len(devs) > 1 else None)
+                if col is None:
+                    col = t.transform_dataset(ds)
             if t.n_outputs == 1:
                 new[out_feats[0].name] = col
             else:
