@@ -49,15 +49,17 @@ MAX_TRAIN = int(os.environ.get("TMOG_SCALE_MAX_TRAIN", 500_000))
 FOLDS = 5
 
 
-def synthesize(n: int):
+def synthesize(n: int, seed=7):
     """Synthetic COLUMNAR dataset (zero-copy into the reader's Dataset fast
     path — no 20 GB pandas shadow): informative numerics, correlated pairs,
     categorical signal, and a binary label — enough structure for the
-    SanityChecker and selector to have something real to do."""
+    SanityChecker and selector to have something real to do.  ``seed`` may
+    be a SeedSequence-style list — scale100m.py seeds per host so two hosts
+    never synthesize the same rows."""
     import transmogrifai_tpu.types as T
     from transmogrifai_tpu.columns import Dataset, NumericColumn, ObjectColumn
 
-    rng = np.random.default_rng(7)
+    rng = np.random.default_rng(seed)
     cols = {}
     ones = np.ones(n, bool)
     signal = rng.normal(size=n).astype(np.float32)

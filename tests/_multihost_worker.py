@@ -1,0 +1,217 @@
+"""One host of the two-process CPU-proxy topology (tests/test_multihost.py).
+
+Invoked as::
+
+    python tests/_multihost_worker.py <mode> <host> <n_hosts> <port> <out>
+
+With ``n_hosts > 1`` the worker joins a ``jax.distributed`` process group on
+``127.0.0.1:<port>`` before importing anything else jax-shaped; with 1 it
+runs the identical code single-process (the parity reference).  The result
+is written to ``<out>`` as JSON — the driving test process asserts across
+hosts' files, so a worker never asserts cross-host facts itself.
+
+Modes:
+
+- ``stats``  — ambient-sharded CustomReader ingest (each host reads ONLY its
+  ``host_rows`` range) + the host-merged streaming moments/correlations.
+- ``train``  — tiny end-to-end workflow train (transmogrify -> sanity_check
+  sharded stats -> 4-candidate selector) on this host's shard; reports the
+  sweep winner.
+- ``stream`` — env-emulated host (``TMOG_HOSTS``/``TMOG_HOST_INDEX``, no
+  process group): chunked streaming transform under TMOG_CHECKPOINT_DIR.
+  ``TMOG_MH_CRASH_AFTER=k`` SIGKILLs the process the moment the k-th chunk
+  checkpoint lands — a real mid-stream preemption for the resume test.
+"""
+import json
+import os
+import signal
+import sys
+
+N_ROWS = 2000
+N_FEATS = 5
+
+
+def _full_frame():
+    """The GLOBAL deterministic frame — every host constructs the same one;
+    the reader tier decides which rows this host actually ingests."""
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(123)
+    cols = {f"x{j}": rng.normal(loc=float(j), scale=1.0 + 0.1 * j,
+                                size=N_ROWS)
+            for j in range(N_FEATS)}
+    logits = cols["x0"] - 0.0 + 0.8 * (cols["x1"] - 1.0)
+    cols["label"] = (logits + 0.1 * rng.normal(size=N_ROWS) > 0).astype(float)
+    return pd.DataFrame(cols)
+
+
+def _ingest(with_label=False):
+    import transmogrifai_tpu.types as T
+    from transmogrifai_tpu import FeatureBuilder
+    from transmogrifai_tpu.readers.base import CustomReader
+
+    feats = [FeatureBuilder(f"x{j}", T.Real).extract(
+        field=f"x{j}").as_predictor() for j in range(N_FEATS)]
+    label = FeatureBuilder("label", T.RealNN).extract(
+        field="label").as_response()
+    ds = CustomReader(_full_frame()).generate_dataset(
+        feats + [label] if with_label else feats, {})
+    return ds, feats, label
+
+
+def run_stats(h, H):
+    import numpy as np
+
+    from transmogrifai_tpu.parallel import stats as pstats
+
+    ds, _, _ = _ingest()
+    keys = [int(k) for k in ds.key]
+    X = np.stack([np.asarray(ds[f"x{j}"].values, np.float64)
+                  for j in range(N_FEATS)], axis=1)
+    y_full = _full_frame()["label"].to_numpy()
+    y = y_full[keys[0]:keys[-1] + 1] if keys else y_full[:0]
+
+    n, mean, std = pstats.sharded_column_moments(X, chunk_rows=256)
+
+    def chunks():
+        for lo in range(0, X.shape[0], 200):
+            yield (X[lo:lo + 200].astype(np.float32),
+                   y[lo:lo + 200].astype(np.float32))
+
+    st, corr, _ = pstats.fused_moments_and_correlations(
+        chunks, N_FEATS, with_corr_matrix=False)
+    from transmogrifai_tpu import obs
+
+    host_scope = obs.snapshot().get("host", {})
+    return {
+        "host": h, "n_local": len(ds),
+        "key_lo": keys[0] if keys else None,
+        "key_hi": keys[-1] if keys else None,
+        "keys_contiguous": keys == list(range(keys[0], keys[-1] + 1))
+        if keys else True,
+        "moments_count": float(n),
+        "mean": [float(v) for v in mean],
+        "std": [float(v) for v in std],
+        "fused_count": int(st.count),
+        "fused_mean": [float(v) for v in st.mean],
+        "fused_var": [float(v) for v in st.variance],
+        "corr": [float(v) for v in corr],
+        "host_collectives": int(host_scope.get("collectives", 0)),
+    }
+
+
+def run_train(h, H):
+    from transmogrifai_tpu import OpWorkflow
+    from transmogrifai_tpu.impl.classification.logistic import (
+        OpLogisticRegression)
+    from transmogrifai_tpu.impl.classification.svc import OpLinearSVC
+    from transmogrifai_tpu.impl.feature.transmogrifier import transmogrify
+    from transmogrifai_tpu.impl.selector.factories import (
+        BinaryClassificationModelSelector)
+    from transmogrifai_tpu.impl.tuning.splitters import DataBalancer
+    from transmogrifai_tpu.dsl import sanity_check  # noqa: F401 (registers DSL)
+
+    ds, feats, label = _ingest(with_label=True)
+    vec = transmogrify(feats)
+    checked = vec.sanity_check(label, sharded_stats=True)
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        splitter=DataBalancer(sample_fraction=0.1, reserve_test_fraction=0.1),
+        num_folds=3, seed=42,
+        models_and_parameters=[
+            (OpLogisticRegression(max_iter=60),
+             [{"reg_param": 1e-4}, {"reg_param": 30.0}]),
+            (OpLinearSVC(max_iter=60),
+             [{"reg_param": 1e-3}, {"reg_param": 30.0}]),
+        ])
+    pred = sel.set_input(label, checked).get_output()
+    wf = (OpWorkflow().set_result_features(pred).set_input_dataset(ds)
+          .with_selector_cv())
+    model = wf.train()
+    best = None
+    for st in model.stages:
+        s = getattr(st, "summary", None)
+        if s is not None and getattr(s, "best_model_name", None):
+            best = s.best_model_name
+    return {"host": h, "n_local": len(ds), "best_model": best}
+
+
+def run_stream(h, H):
+    import hashlib
+
+    import numpy as np
+
+    import transmogrifai_tpu.types as T
+    from transmogrifai_tpu import Dataset, FeatureBuilder
+    from transmogrifai_tpu.columns import NumericColumn
+    from transmogrifai_tpu.impl.feature.transformers import FillMissingWithMean
+    from transmogrifai_tpu.impl.feature.vectorizers import (RealVectorizer,
+                                                            VectorsCombiner)
+    from transmogrifai_tpu.workflow import stream
+
+    crash_after = int(os.environ.get("TMOG_MH_CRASH_AFTER", "0"))
+    # IDENTICAL data on every emulated host: the sharpest isolation test —
+    # if the host range were missing from the chunk keys, host 1 would
+    # happily restore host 0's bit-identical chunks
+    rng = np.random.default_rng(11)
+    n = 256
+    cols = {}
+    for j in range(4):
+        v = rng.normal(size=n)
+        m = rng.random(n) > 0.1
+        cols[f"x{j}"] = NumericColumn(T.Real, np.where(m, v, 0.0), m)
+    ds = Dataset(cols)
+    xs = [FeatureBuilder(f"x{j}", T.Real).extract(
+        field=f"x{j}").as_predictor() for j in range(4)]
+    fm = FillMissingWithMean().set_input(xs[0]).fit(ds)
+    m1 = RealVectorizer().set_input(*xs[:2]).fit(ds)
+    m2 = RealVectorizer(fill_with_mean=False,
+                        fill_value=-1.0).set_input(*xs[2:]).fit(ds)
+    comb = VectorsCombiner().set_input(m1.get_output(), m2.get_output())
+    layers = [[fm, m1, m2], [comb]]
+
+    if crash_after > 0:
+        from transmogrifai_tpu.resilience.checkpoint import CheckpointStore
+
+        orig_save = CheckpointStore.save
+        state = {"n": 0}
+
+        def _kill_after(self, kind, key, arrays, meta=None):
+            r = orig_save(self, kind, key, arrays, meta)
+            if kind == "stream_chunk" and r is not None:
+                state["n"] += 1
+                if state["n"] >= crash_after:
+                    os.kill(os.getpid(), signal.SIGKILL)  # real preemption
+            return r
+
+        CheckpointStore.save = _kill_after
+
+    stream.reset_stream_stats()
+    out = stream.apply_streamed(ds, layers)
+    s = stream.stream_stats()
+    digest = hashlib.sha256()
+    for nm in sorted(out.columns):
+        digest.update(np.ascontiguousarray(
+            np.asarray(out[nm].values, np.float64)).tobytes())
+    return {"host": h, "chunks": int(s["chunks"]),
+            "checkpoint_skips": int(s["checkpoint_skips"]),
+            "digest": digest.hexdigest()}
+
+
+def main():
+    mode, h, H, port, out_path = (sys.argv[1], int(sys.argv[2]),
+                                  int(sys.argv[3]), sys.argv[4], sys.argv[5])
+    if H > 1 and mode != "stream":
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=f"127.0.0.1:{port}",
+            num_processes=H, process_id=h)
+    result = {"stats": run_stats, "train": run_train,
+              "stream": run_stream}[mode](h, H)
+    with open(out_path, "w") as f:
+        json.dump(result, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -98,8 +98,8 @@ def test_golden_rows_stable_vectors(tmp_path):
         feat["log_gbt_chain_levels"], 12.0, feat["log_bins_max"],
         2.0, feat["log_rows_local"], 8.0, 1.0,
         # PR-12 measured-cost tail + PR-15 ASHA rung tail + PR-17 launch
-        # packing tail: absent from this golden row -> 0.0
-        0.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0])
+        # packing tail + PR-19 host tail: absent from this golden row -> 0.0
+        0.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0])
     v = feature_vector(samples[0]["feat"])
     assert v.shape == (len(FEATURE_NAMES),)
     np.testing.assert_array_equal(v, expected)
@@ -131,34 +131,36 @@ def test_missing_and_nan_fields_degrade(tmp_path):
 
 def test_feature_names_append_only_with_cost_tail():
     """PR-12 appended the measured-cost features, PR-15 the ASHA rung
-    context, and PR-17 the launch-packing shape; the contract is that the
-    tail is append-only and old rows without them still vectorize (0.0 in
-    the new slots, original prefix untouched)."""
+    context, PR-17 the launch-packing shape, and PR-19 the multi-host
+    context; the contract is that the tail is append-only and old rows
+    without them still vectorize (0.0 in the new slots, original prefix
+    untouched)."""
     from transmogrifai_tpu.costmodel.features import (cost_feature_dict,
                                                       rung_feature_dict)
 
-    assert FEATURE_NAMES[-8:] == ("log_flops", "log_bytes_accessed",
-                                  "arith_intensity", "subsample_frac",
-                                  "rung_index", "is_resumed",
-                                  "pack_size", "pipeline_depth")
+    assert FEATURE_NAMES[-10:] == ("log_flops", "log_bytes_accessed",
+                                   "arith_intensity", "subsample_frac",
+                                   "rung_index", "is_resumed",
+                                   "pack_size", "pipeline_depth",
+                                   "host_count", "host_index")
     assert FEATURE_NAMES[:2] == ("log_units", "log_units_linear")
-    assert len(FEATURE_NAMES) == len(set(FEATURE_NAMES)) == 28
+    assert len(FEATURE_NAMES) == len(set(FEATURE_NAMES)) == 30
 
     legacy = _golden_feat()  # pre-PR-12 dict: no cost/rung features at all
     v = feature_vector(legacy)
-    assert v.shape == (28,)
-    assert np.all(v[-8:] == 0.0)
+    assert v.shape == (30,)
+    assert np.all(v[-10:] == 0.0)
     assert v[0] == pytest.approx(math.log1p(5.5e8))
 
     new = dict(legacy)
     new.update(cost_feature_dict(2e9, 1e8))
     v2 = feature_vector(new)
-    assert np.array_equal(v2[:-8], v[:-8])  # prefix order unchanged
-    assert v2[-8] == pytest.approx(math.log1p(2e9))
-    assert v2[-7] == pytest.approx(math.log1p(1e8))
-    assert v2[-6] == pytest.approx(20.0)
-    # rung + PR-17 launch-shape slots untouched by cost features
-    assert np.all(v2[-5:] == 0.0)
+    assert np.array_equal(v2[:-10], v[:-10])  # prefix order unchanged
+    assert v2[-10] == pytest.approx(math.log1p(2e9))
+    assert v2[-9] == pytest.approx(math.log1p(1e8))
+    assert v2[-8] == pytest.approx(20.0)
+    # rung + PR-17 launch-shape + PR-19 host slots untouched by cost features
+    assert np.all(v2[-7:] == 0.0)
     # zero-byte launches (cost_analysis without the bytes key) stay finite
     z = cost_feature_dict(1e6, 0.0)
     assert z["arith_intensity"] == 0.0
@@ -166,11 +168,13 @@ def test_feature_names_append_only_with_cost_tail():
     # the PR-15 rung tail composes the same way, clamped to sane ranges
     new.update(rung_feature_dict(0.25, 2, True))
     v3 = feature_vector(new)
-    assert np.array_equal(v3[:-5], v2[:-5])
-    assert v3[-5] == pytest.approx(0.25)
-    assert v3[-4] == 2.0
-    assert v3[-3] == 1.0
-    assert np.all(v3[-2:] == 0.0)  # pack slots only stamped by the sweep
+    assert np.array_equal(v3[:-7], v2[:-7])
+    assert v3[-7] == pytest.approx(0.25)
+    assert v3[-6] == 2.0
+    assert v3[-5] == 1.0
+    # pack slots are only stamped by the sweep; host slots by the ambient
+    # mesh context in shard_feature_dict
+    assert np.all(v3[-4:] == 0.0)
     assert rung_feature_dict(7.0, -4, False) == {
         "subsample_frac": 1.0, "rung_index": 0.0, "is_resumed": 0.0}
 

@@ -1,0 +1,196 @@
+"""Multi-host sharded ingestion (PR 19): the reader-tier row-range math.
+
+Every test runs single-process: ``shard=(host_index, host_count)`` is an
+explicit reader param (or ambient ``TMOG_HOSTS``/``TMOG_HOST_INDEX``), so
+the divide/remainder/empty-tail arithmetic, global key reconstruction,
+quarantine audit-index globality, and limit-then-shard ordering are all
+checkable without spawning coordinated processes (tests/test_multihost.py
+covers the real two-process topology).
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.parallel.mesh import host_rows
+from transmogrifai_tpu.readers import DataReaders
+from transmogrifai_tpu.readers.avro_io import read_avro, write_avro
+from transmogrifai_tpu.readers.base import CustomReader
+from transmogrifai_tpu.resilience import quarantine
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for k in ("TMOG_HOSTS", "TMOG_HOST_INDEX", "TMOG_QUARANTINE"):
+        monkeypatch.delenv(k, raising=False)
+    quarantine.reset_store()
+    yield
+    quarantine.reset_store()
+
+
+def _x():
+    return FeatureBuilder("x", T.Real).extract(field="x").as_predictor()
+
+
+# ---------------------------------------------------------------------------
+# host_rows: the one range-assignment function every reader defers to
+# ---------------------------------------------------------------------------
+class TestHostRows:
+    def test_even_divide(self):
+        assert [host_rows(12, index=h, count=3) for h in range(3)] == \
+            [(0, 4), (4, 8), (8, 12)]
+
+    def test_remainder_lands_on_low_indices(self):
+        ranges = [host_rows(10, index=h, count=3) for h in range(3)]
+        assert ranges == [(0, 4), (4, 7), (7, 10)]
+        sizes = [hi - lo for lo, hi in ranges]
+        assert max(sizes) - min(sizes) == 1  # balanced to within one row
+
+    def test_empty_tail_when_hosts_exceed_rows(self):
+        ranges = [host_rows(2, index=h, count=5) for h in range(5)]
+        assert ranges == [(0, 1), (1, 2), (2, 2), (2, 2), (2, 2)]
+        assert all(lo <= hi for lo, hi in ranges)  # empty ranges are legal
+
+    @pytest.mark.parametrize("n,H", [(0, 3), (1, 1), (7, 2), (100, 7),
+                                     (1000, 13)])
+    def test_covering_and_disjoint(self, n, H):
+        """Exact global-row-index reconstruction: the union of every host's
+        range is 0..n with no overlap and no gap."""
+        seen = []
+        for h in range(H):
+            lo, hi = host_rows(n, index=h, count=H)
+            seen.extend(range(lo, hi))
+        assert seen == list(range(n))
+
+    def test_out_of_range_host_raises(self):
+        with pytest.raises(ValueError):
+            host_rows(10, index=3, count=3)
+        with pytest.raises(ValueError):
+            host_rows(10, index=-1, count=3)
+
+
+# ---------------------------------------------------------------------------
+# In-memory frames: row-range slicing with global keys + global audit rows
+# ---------------------------------------------------------------------------
+def test_custom_reader_shards_cover_full_read(monkeypatch):
+    df = pd.DataFrame({"x": np.arange(10, dtype=float)})
+    full = CustomReader(df).generate_dataset([_x()], {})
+    parts = [CustomReader(df).generate_dataset([_x()], {"shard": (h, 3)})
+             for h in range(3)]
+    assert [len(p) for p in parts] == [4, 3, 3]
+    got = np.concatenate([np.asarray(p["x"].values) for p in parts])
+    np.testing.assert_array_equal(got, np.asarray(full["x"].values))
+    # keys are GLOBAL row indices, not per-shard positions
+    keys = [k for p in parts for k in map(str, p.key)]
+    assert keys == [str(i) for i in range(10)]
+
+
+def test_explicit_single_shard_is_identity():
+    df = pd.DataFrame({"x": [1.0, 2.0, 3.0]})
+    base = CustomReader(df).generate_dataset([_x()], {})
+    one = CustomReader(df).generate_dataset([_x()], {"shard": (0, 1)})
+    np.testing.assert_array_equal(np.asarray(one["x"].values),
+                                  np.asarray(base["x"].values))
+    assert list(map(str, one.key)) == list(map(str, base.key))
+
+
+def test_ambient_host_env_shards_automatically(monkeypatch):
+    monkeypatch.setenv("TMOG_HOSTS", "2")
+    monkeypatch.setenv("TMOG_HOST_INDEX", "1")
+    df = pd.DataFrame({"x": np.arange(20, dtype=float)})
+    ds = CustomReader(df).generate_dataset([_x()], {})
+    np.testing.assert_array_equal(np.asarray(ds["x"].values),
+                                  np.arange(10, 20, dtype=float))
+    assert list(map(str, ds.key)) == [str(i) for i in range(10, 20)]
+
+
+def test_limit_then_shard_ordering():
+    """``limit`` defines the dataset, THEN hosts split it — so a limited
+    multi-host run still covers exactly the first ``limit`` rows."""
+    df = pd.DataFrame({"x": np.arange(100, dtype=float)})
+    parts = [CustomReader(df).generate_dataset(
+        [_x()], {"maybeReaderParams": {"limit": 10}, "shard": (h, 2)})
+        for h in range(2)]
+    assert [len(p) for p in parts] == [5, 5]
+    got = np.concatenate([np.asarray(p["x"].values) for p in parts])
+    np.testing.assert_array_equal(got, np.arange(10, dtype=float))
+    assert list(map(str, parts[1].key)) == [str(i) for i in range(5, 10)]
+
+
+def test_quarantine_audit_indices_stay_global(monkeypatch):
+    """A poison row on host 1 is audited under its GLOBAL row index — the
+    whole point of the audit trail is that operators can find the row in
+    the source frame without knowing the host topology."""
+    monkeypatch.setenv("TMOG_QUARANTINE", "drop")
+    vals = [float(i) for i in range(8)]
+    vals[5] = "abc"  # type: ignore[call-overload] — global row 5 is poison
+    df = pd.DataFrame({"x": pd.Series(vals, dtype=object)})
+    ds = CustomReader(df).generate_dataset([_x()], {"shard": (1, 2)})
+    assert len(ds) == 3  # host 1 owns rows 4..7, one dropped
+    rows = quarantine.store().rows()
+    assert [(r["index"], r["reason"]) for r in rows] == [(5, "type_mismatch")]
+    assert all(r["source"] == "reader" for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# File readers: multi-file striping + Avro block-level row ranges
+# ---------------------------------------------------------------------------
+def test_csv_file_list_stripes_across_hosts(tmp_path):
+    for i in range(5):
+        pd.DataFrame({"x": [float(10 * i), float(10 * i + 1)]}).to_csv(
+            tmp_path / f"part{i}.csv", index=False)
+    paths = sorted(str(p) for p in tmp_path.glob("part*.csv"))
+    parts = [DataReaders.Simple.csv_auto(paths).generate_dataset(
+        [_x()], {"shard": (h, 2)}) for h in range(2)]
+    # host h reads files h, h+2, h+4, ... — disjoint and covering
+    assert [len(p) for p in parts] == [6, 4]
+    got = sorted(float(v) for p in parts for v in np.asarray(p["x"].values))
+    assert got == sorted(float(10 * i + j) for i in range(5) for j in range(2))
+
+
+def test_csv_glob_stripes_across_hosts(tmp_path):
+    for i in range(4):
+        pd.DataFrame({"x": [float(i)]}).to_csv(
+            tmp_path / f"g{i}.csv", index=False)
+    pattern = str(tmp_path / "g*.csv")
+    parts = [DataReaders.Simple.csv_auto(pattern).generate_dataset(
+        [_x()], {"shard": (h, 2)}) for h in range(2)]
+    got = sorted(float(v) for p in parts for v in np.asarray(p["x"].values))
+    assert got == [0.0, 1.0, 2.0, 3.0]
+
+
+AVRO_SCHEMA = {"type": "record", "name": "Row", "fields": [
+    {"name": "id", "type": "long"}, {"name": "x", "type": "double"}]}
+
+
+def _write_avro_rows(path, n, block_records=16):
+    write_avro(str(path), AVRO_SCHEMA,
+               [{"id": i, "x": float(i)} for i in range(n)],
+               block_records=block_records)
+
+
+def test_read_avro_row_range_and_count_only(tmp_path):
+    p = tmp_path / "r.avro"
+    _write_avro_rows(p, 100)
+    _, n = read_avro(str(p), count_only=True)
+    assert n == 100
+    _, records = read_avro(str(p), row_range=(33, 67))
+    assert [r["id"] for r in records] == list(range(33, 67))
+    # degenerate ranges: empty, past-the-end, full
+    assert read_avro(str(p), row_range=(50, 50))[1] == []
+    assert read_avro(str(p), row_range=(98, 400))[1] == \
+        [{"id": 98, "x": 98.0}, {"id": 99, "x": 99.0}]
+    assert len(read_avro(str(p), row_range=(0, 100))[1]) == 100
+
+
+def test_avro_reader_single_container_row_range_global_keys(tmp_path):
+    p = str(tmp_path / "big.avro")
+    _write_avro_rows(p, 100)
+    feat = FeatureBuilder("x", T.Real).extract(field="x").as_predictor()
+    parts = [DataReaders.Simple.avro(p).generate_dataset(
+        [feat], {"shard": (h, 3)}) for h in range(3)]
+    got = np.concatenate([np.asarray(p_["x"].values) for p_ in parts])
+    np.testing.assert_array_equal(got, np.arange(100, dtype=float))
+    # positional keys carry the host's global base offset
+    assert str(parts[1].key[0]) == str(host_rows(100, index=1, count=3)[0])

@@ -221,7 +221,8 @@ def test_metric_pack_size(monkeypatch):
 def test_feature_names_appended():
     # append-only contract: new launch-shape features extend the tail so
     # historical training rows (zero-filled) stay loadable
-    assert FEATURE_NAMES[-2:] == ("pack_size", "pipeline_depth")
+    assert FEATURE_NAMES[-4:] == ("pack_size", "pipeline_depth",
+                                  "host_count", "host_index")
 
 
 def test_perfgate_gates_sequential_launches():

@@ -63,12 +63,34 @@ FEATURE_NAMES = (
     # packed/pipelined launches.  0.0 (old rows / knobs off) == the
     # historical one-queue-per-device, unpipelined launch.
     "pack_size", "pipeline_depth",
+    # multi-host scale-out (PR 19): how many coordinated processes split the
+    # row space, and which slice this row was measured on.  0.0 in host_count
+    # (old rows) == the historical single-host launch; host_index lets the
+    # model see per-host skew (remainder rows land on the low indices).
+    "host_count", "host_index",
 )
 
 
 def unit_family(kind: str) -> str:
     """Cost family of a ``SweepUnit.kind`` (unknown kinds -> "linear")."""
     return _KIND_FAMILY.get(kind, "linear")
+
+
+def _ambient_host_count() -> int:
+    """Lazy (jax stays un-imported for offline extraction paths)."""
+    try:
+        from ..parallel import mesh
+        return mesh.host_count()
+    except Exception:  # noqa: BLE001 — offline/odd envs: single host
+        return 1
+
+
+def _ambient_host_index() -> int:
+    try:
+        from ..parallel import mesh
+        return mesh.host_index()
+    except Exception:  # noqa: BLE001
+        return 0
 
 
 def _finite(v: Any, default: float = 0.0) -> float:
@@ -126,6 +148,8 @@ def shard_feature_dict(spec, n_rows: int, n_features: int, n_folds: int,
         "log_bins_max": math.log1p(bins_max),
         "data_shards": float(max(int(data_shards), 1)),
         "log_rows_local": math.log1p(max(rl, 0)),
+        "host_count": float(_ambient_host_count()),
+        "host_index": float(_ambient_host_index()),
     }
     for f in FAMILIES:
         feat[f"log_units_{f}"] = math.log1p(fam_units[f])

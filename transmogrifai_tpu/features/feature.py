@@ -9,7 +9,7 @@ so the whole program is recoverable from the result features alone
 """
 from __future__ import annotations
 
-import secrets
+import itertools
 from dataclasses import dataclass, field, replace
 from typing import Any, Callable, Dict, List, Optional, Tuple, Type, TYPE_CHECKING
 
@@ -17,6 +17,8 @@ from .. import types as T
 
 if TYPE_CHECKING:
     from ..stages.base import PipelineStage
+
+_UID_COUNTER = itertools.count()
 
 
 @dataclass(frozen=True)
@@ -43,7 +45,12 @@ class Feature:
     is_response: bool
     origin_stage: "PipelineStage"
     parents: Tuple["Feature", ...] = ()
-    uid: str = field(default_factory=lambda: f"Feature_{secrets.token_hex(6)}")
+    # deterministic counter, not random hex: a restarted process rebuilding
+    # the same DAG reconstructs the same uids, which is what lets
+    # content-keyed checkpoints resume across preemptions (stages/base.py
+    # make_uid has the full rationale)
+    uid: str = field(
+        default_factory=lambda: f"Feature_{next(_UID_COUNTER):012x}")
 
     # identity semantics: DAG nodes are compared by object identity (uid)
     def __eq__(self, other):

@@ -95,7 +95,7 @@ def compare(current: Dict[str, Any], baseline: Dict[str, Any],
     [keys], "ok": bool}``; each result row carries ``key`` / ``direction`` /
     ``baseline`` / ``current`` / ``ratio`` / ``status`` with status one of
     ``ok`` / ``regressed`` / ``improved`` / ``skipped_missing`` /
-    ``skipped_platform``.
+    ``skipped_platform`` / ``skipped_core_bound``.
     """
     tol = default_tolerance() if tol is None else max(0.0, float(tol))
     metric = baseline.get("metric") or current.get("metric") or "?"
@@ -105,6 +105,9 @@ def compare(current: Dict[str, Any], baseline: Dict[str, Any],
     results: List[Dict[str, Any]] = []
     regressed: List[str] = []
     mismatch = bool(b_plat and c_plat and b_plat != c_plat)
+    # a run stamped core_bound ran more shards than physical cores — its
+    # numbers measure time-slicing, not scaling; judge nothing either way
+    core_bound = bool(baseline.get("core_bound") or current.get("core_bound"))
     for key in sorted(policy):
         direction = policy[key]
         b, c = baseline.get(key), current.get(key)
@@ -112,6 +115,8 @@ def compare(current: Dict[str, Any], baseline: Dict[str, Any],
                                "baseline": b, "current": c, "ratio": None}
         if mismatch:
             row["status"] = "skipped_platform"
+        elif core_bound:
+            row["status"] = "skipped_core_bound"
         elif not _num(b) or not _num(c):
             row["status"] = "skipped_missing"
         elif b == 0:

@@ -633,7 +633,8 @@ def run_sweep(spec, X, xbs: Tuple, y, train_w, val_w, blob):
     ck_key = None
     if _ck.enabled:
         ck_key = _ckpt.content_key(
-            "sweep_launch", spec, blob, _ckpt.data_fingerprint(X),
+            "sweep_launch", spec, blob, *_ckpt.host_key_part(),
+            _ckpt.data_fingerprint(X),
             _ckpt.data_fingerprint(y), _ckpt.data_fingerprint(train_w),
             _ckpt.data_fingerprint(val_w))
         hit = _ck.load("sweep_launch", ck_key)
@@ -1139,6 +1140,7 @@ def run_sweep_partitioned(shards, X, xbs: Tuple, y, train_w, val_w,
     # that restarts with the same inputs skips its completed shards
     _ck = _ckpt.store()
     ck_data = () if not _ck.enabled else (
+        *_ckpt.host_key_part(),
         _ckpt.data_fingerprint(X_host if X_host is not None else X),
         _ckpt.data_fingerprint(y_host if y_host is not None else y),
         _ckpt.data_fingerprint(train_w), _ckpt.data_fingerprint(val_w))
@@ -1540,7 +1542,7 @@ def run_sweep_rowsharded(shards, X, xbs: Tuple, y, train_w, val_w,
     # data-shard count because the launch layout is part of the artifact
     _ck = _ckpt.store()
     ck_data = () if not _ck.enabled else (
-        ("rs", int(n_data)),
+        ("rs", int(n_data)), *_ckpt.host_key_part(),
         _ckpt.data_fingerprint(X_host if X_host is not None else X),
         _ckpt.data_fingerprint(y_host if y_host is not None else y),
         _ckpt.data_fingerprint(tw_host), _ckpt.data_fingerprint(vw_host))

@@ -7,10 +7,12 @@ replacement keeps ONE program shape at every scale:
 - single chip: a 1x1 mesh, collectives elided by XLA,
 - one host, many chips: a (data, model) mesh over ICI,
 - many hosts: ``jax.distributed.initialize`` connects the processes over
-  DCN; ``jax.devices()`` then spans every host's chips and the SAME
-  ``make_mesh`` call returns a process-spanning mesh — XLA routes
-  intra-slice collectives over ICI and cross-host over DCN.  No code above
-  the mesh changes (the scaling-book recipe).
+  DCN.  Per-host pipelines (``mesh.make_mesh`` and friends) stay LOCAL —
+  each host ingests only its ``mesh.host_rows`` range and runs its own
+  device-resident stream/sweep over its own chips; statistics cross hosts
+  in the tiny moment domain (``parallel/stats`` host tier), never as rows.
+  A deliberately process-spanning mesh is ``mesh.make_global_mesh``'s job
+  (host-major data axis, aligned with the ingestion ranges).
 
 Process topology comes from explicit args or the standard environment
 (``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``,
@@ -51,9 +53,11 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
                            ) -> DistributedInfo:
     """Join (or form) the multi-host cluster; idempotent.
 
-    After this returns, ``jax.devices()`` spans all hosts and
-    ``mesh.make_mesh`` builds process-spanning meshes; every stats pass and
-    selector sweep in the library runs unchanged on top.
+    After this returns, ``jax.devices()`` spans all hosts,
+    ``mesh.host_count()``/``host_index()`` report the topology, the readers
+    shard ingestion by ``mesh.host_rows``, and the stats tier merges
+    per-host moments globally; the per-host pipelines themselves keep
+    running on ``jax.local_devices()`` unchanged.
     """
     global _INITIALIZED
     coordinator_address = coordinator_address or _env(

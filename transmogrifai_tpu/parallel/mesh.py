@@ -81,7 +81,7 @@ def model_devices(mesh: Optional[Mesh] = None) -> list:
     Falls back to the first local device when no mesh is active."""
     m = mesh if mesh is not None else _ACTIVE_MESH
     if m is None:
-        return [jax.devices()[0]]
+        return [jax.local_devices()[0]]
     grid = np.asarray(m.devices)
     ax = list(m.axis_names).index(MODEL_AXIS)
     index = [0] * grid.ndim
@@ -97,7 +97,7 @@ def data_devices(mesh: Optional[Mesh] = None) -> list:
     to the first local device when no mesh is active."""
     m = mesh if mesh is not None else _ACTIVE_MESH
     if m is None:
-        return [jax.devices()[0]]
+        return [jax.local_devices()[0]]
     grid = np.asarray(m.devices)
     ax = list(m.axis_names).index(DATA_AXIS)
     index = [0] * grid.ndim
@@ -134,7 +134,9 @@ def stream_shards() -> int:
         if m is None or DATA_AXIS not in m.shape:
             return 1
         want = int(m.shape[DATA_AXIS])
-    return max(1, min(want, len(jax.devices())))
+    # clamp to THIS host's chips: the stream executor only dispatches to
+    # addressable devices (identical to jax.devices() single-process)
+    return max(1, min(want, len(jax.local_devices())))
 
 
 def stream_devices() -> list:
@@ -147,9 +149,14 @@ def stream_devices() -> list:
     if D <= 1:
         return [None]
     m = _ACTIVE_MESH if _ACTIVE_MESH is not None else env_mesh()
-    devs = data_devices(m) if m is not None else list(jax.devices())
+    devs = data_devices(m) if m is not None else list(jax.local_devices())
+    # multi-host: a process-spanning mesh's data axis includes other hosts'
+    # chips; this host's stream feeds ONLY its own (chunks it ingested stay
+    # resident here — no cross-host row traffic).  Single-process this
+    # filter keeps every device, bit-identically.
+    devs = local_data_devices(m) if m is not None else devs
     if len(devs) < D:
-        devs = list(jax.devices())
+        devs = list(jax.local_devices())
     devs = devs[:D]
     return devs if len(devs) > 1 else [None]
 
@@ -157,8 +164,11 @@ def stream_devices() -> list:
 def auto_mesh() -> Optional[Mesh]:
     """All local devices on the ``model`` axis (the OpValidator default) —
     the TPU replacement for the reference's 8-thread sweep pool
-    (OpValidator.scala:373-380).  None on a single device."""
-    devs = jax.devices()
+    (OpValidator.scala:373-380).  None on a single device.  LOCAL devices
+    only: under ``jax.distributed`` each host runs its own sweep pipeline —
+    a process-spanning mesh is ``make_global_mesh``'s job, never an implicit
+    default (and XLA:CPU cannot even compile one)."""
+    devs = jax.local_devices()
     if len(devs) <= 1:
         return None
     return make_mesh(n_data=1, n_model=len(devs))
@@ -171,7 +181,7 @@ def serve_devices(n: Optional[int] = None) -> List[jax.Device]:
     oversubscribing CPU test hosts, harmless on a real mesh."""
     from ..utils.env import env_int
 
-    devs = jax.devices()
+    devs = jax.local_devices()
     if n is None:
         n = env_int("TMOG_SERVE_REPLICAS", len(devs))
     n = max(1, int(n))
@@ -181,8 +191,10 @@ def serve_devices(n: Optional[int] = None) -> List[jax.Device]:
 def data_mesh() -> Optional[Mesh]:
     """All local devices on the ``data`` axis — for row-sharded statistics
     passes (SanityChecker / RFF moments + Gram, SURVEY §2.7 axis 1).
-    None on a single device (XLA needs no collectives then anyway)."""
-    devs = jax.devices()
+    None on a single device (XLA needs no collectives then anyway).  LOCAL
+    devices only: per-host partials merge across hosts in the moment domain
+    (the ``parallel/stats`` host tier), never as a cross-process XLA mesh."""
+    devs = jax.local_devices()
     if len(devs) <= 1:
         return None
     return make_mesh(n_data=len(devs), n_model=1)
@@ -197,7 +209,7 @@ def make_mesh(n_data: Optional[int] = None, n_model: int = 1,
     elides the collectives), which is how the reference runs Spark local-mode
     as its test backend (TestSparkContext.scala:50).
     """
-    devs = list(devices if devices is not None else jax.devices())
+    devs = list(devices if devices is not None else jax.local_devices())
     if n_data is None:
         n_data = max(len(devs) // max(n_model, 1), 1)
     n = n_data * n_model
@@ -366,6 +378,129 @@ def mesh_all_gather(x, axis_name: Optional[str], axis: int = 0):
 
     _record_collective("all_gather", axis_name, x)
     return lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# Multi-host topology — process-spanning meshes and per-host row ranges.
+#
+# ``jax.distributed.initialize`` (parallel/distributed.py) makes
+# ``jax.devices()`` span every host; the helpers below carve that global pool
+# into a host-major (data, model) mesh and assign each host its contiguous
+# slice of the global row axis.  Everything degrades to the single-host
+# behavior when ``host_count() == 1``: ``host_rows(n)`` is ``(0, n)``,
+# ``make_global_mesh`` is ``make_mesh``, and no call below touches
+# ``jax.distributed`` state — the one-host path stays bit-identical.
+# ---------------------------------------------------------------------------
+
+
+def host_count() -> int:
+    """Number of hosts (processes) in the cluster.
+
+    An explicit ``TMOG_HOSTS`` wins (lets single-process tests and the
+    scale harness exercise the range math without ``jax.distributed``);
+    otherwise ``jax.process_count()`` (1 when not distributed)."""
+    from ..utils.env import env_int, env_set
+
+    if env_set("TMOG_HOSTS"):
+        return max(1, env_int("TMOG_HOSTS", 1))
+    try:
+        return max(1, int(jax.process_count()))
+    except Exception:
+        return 1
+
+
+def host_index() -> int:
+    """This process's host rank in ``[0, host_count())``.
+
+    ``TMOG_HOST_INDEX`` wins; otherwise ``jax.process_index()`` (0 when not
+    distributed)."""
+    from ..utils.env import env_int, env_set
+
+    if env_set("TMOG_HOST_INDEX"):
+        return max(0, env_int("TMOG_HOST_INDEX", 0))
+    try:
+        return max(0, int(jax.process_index()))
+    except Exception:
+        return 0
+
+
+def host_rows(n_rows: int, index: Optional[int] = None,
+              count: Optional[int] = None) -> Tuple[int, int]:
+    """Contiguous global row range ``[lo, hi)`` owned by one host.
+
+    Ranges are disjoint, covering, and within one row of balanced: the
+    first ``n_rows % count`` hosts carry the remainder row each.  A host
+    past the data (``count > n_rows``) gets an empty range — legal, its
+    stream simply runs zero chunks.  With one host this is ``(0, n_rows)``,
+    so the single-host path sees no change at all."""
+    H = max(1, int(count if count is not None else host_count()))
+    h = int(index if index is not None else host_index())
+    if not 0 <= h < H:
+        raise ValueError(f"host_index {h} out of range for {H} hosts")
+    n = max(0, int(n_rows))
+    base, extra = divmod(n, H)
+    lo = h * base + min(h, extra)
+    hi = lo + base + (1 if h < extra else 0)
+    return lo, hi
+
+
+def make_global_mesh(n_hosts: Optional[int] = None,
+                     n_data: Optional[int] = None,
+                     n_model: int = 1) -> Mesh:
+    """Build a host-major (data, model) mesh spanning ``n_hosts`` processes.
+
+    Devices are grouped by owning process and laid out host-major along the
+    data axis, so host ``h``'s local chips own the contiguous block of row
+    shards ``[h * n_data/n_hosts, (h+1) * n_data/n_hosts)`` — matching the
+    ``host_rows`` ingestion ranges, which keeps every streamed chunk resident
+    on the host that read it.  ``mesh_psum``/``mesh_all_gather`` compose
+    unchanged (same axis names; XLA routes the cross-host hops over DCN).
+
+    With ``n_data=None`` each host contributes all its local chips to the
+    data axis.  On one process this degrades exactly to ``make_mesh``."""
+    H = max(1, int(n_hosts) if n_hosts is not None else host_count())
+    by_proc: dict = {}
+    for d in jax.devices():
+        by_proc.setdefault(int(getattr(d, "process_index", 0)), []).append(d)
+    procs = sorted(by_proc)
+    if H > len(procs):
+        raise ValueError(
+            f"global mesh over {H} hosts needs {H} processes, "
+            f"have {len(procs)} (did jax.distributed initialize?)")
+    procs = procs[:H]
+    n_model = max(1, int(n_model))
+    if n_data is None:
+        per_host = max(min(len(by_proc[p]) for p in procs) // n_model, 1)
+        n_data = per_host * H
+    n_data = int(n_data)
+    if n_data % H:
+        raise ValueError(f"data axis {n_data} not divisible by {H} hosts")
+    per_host = n_data // H
+    need = per_host * n_model
+    rows: List[jax.Device] = []
+    for p in procs:
+        local = by_proc[p]
+        if need > len(local):
+            raise ValueError(
+                f"host {p} holds {len(local)} devices, mesh block needs {need}")
+        rows.extend(local[:need])
+    grid = np.array(rows).reshape(n_data, n_model)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def local_data_devices(mesh: Optional[Mesh] = None) -> list:
+    """Data-axis devices of ``mesh`` owned by THIS process.
+
+    The per-host stream executor dispatches only to these, so chunks read by
+    a host stay resident on that host's chips.  Falls back to the full
+    data-axis list when the mesh is single-process (every device is local)."""
+    devs = data_devices(mesh)
+    try:
+        pid = int(jax.process_index())
+    except Exception:
+        pid = 0
+    local = [d for d in devs if int(getattr(d, "process_index", 0)) == pid]
+    return local or devs
 
 
 # ---------------------------------------------------------------------------

@@ -109,6 +109,12 @@ class DataShardedStats:
         for X, m in self._chunks_masked(chunks):
             carry = _moments_step(carry, self._place(X), self._place(m))
         n, s1, s2, mn, mx = (np.asarray(c, np.float64) for c in carry)
+        # cross-host tier: raw sums add, min/max lattice-merge (identity
+        # single-process)
+        packed = host_sum_reduce(np.concatenate([[float(n)], s1, s2]),
+                                 "moments_raw")
+        n, s1, s2 = packed[0], packed[1:1 + d], packed[1 + d:]
+        mn, mx = host_merge_minmax(mn, mx)
         n = float(n)
         mean = s1 / max(n, 1.0)
         var = np.maximum(s2 / max(n, 1.0) - mean * mean, 0.0) * (
@@ -138,6 +144,14 @@ class DataShardedStats:
             carry = _gram_step(carry, self._place(X), self._place(y),
                                self._place(m), meand, ymd)
         G, gy, yy, n = (np.asarray(c, np.float64) for c in carry)
+        # cross-host tier: every host's Gram is centered at the SAME global
+        # mean (pass 1 already merged), so the carries are plain sums
+        packed = host_sum_reduce(
+            np.concatenate([[float(n), float(yy)], gy, G.reshape(-1)]),
+            "gram")
+        n, yy = packed[0], packed[1]
+        gy = packed[2:2 + d]
+        G = packed[2 + d:].reshape(d, d)
         diag = np.diag(G).copy()
         zero = diag <= 0.0
         denom = np.sqrt(np.maximum(diag, 1e-300))
@@ -256,6 +270,173 @@ def _merge_moment_carries(carries):
     return n_t, mean_t, M2_t
 
 
+# ---------------------------------------------------------------------------
+# Host-level merge tier — the cross-host (DCN) half of the fit statistics.
+#
+# Per-device Chan partials merge on each host (``_merge_moment_carries``);
+# under ``jax.distributed`` the per-host results then cross the host boundary
+# ONCE as a tiny f64 carry (O(d) floats, never row data) via
+# ``process_allgather``, and every host merges the SAME ordered list in f64 —
+# deterministic and bit-identical across hosts.  Single-process runs skip all
+# of it (``jax.process_count() == 1`` → the carry passes through untouched),
+# so the one-host path stays byte-identical.
+# ---------------------------------------------------------------------------
+
+
+#: per-kind monotone sequence for the coordination-service transport: every
+#: host performs the SAME gathers in the SAME order (an all-gather invariant
+#: already), so the counter names each round's keys identically everywhere
+_KV_SEQ: dict = {}
+
+
+def _kv_gather(raw: np.ndarray, kind: str):
+    """All-gather raw bytes through the jax.distributed coordination-service
+    key-value store (pure gRPC — no XLA computation involved).
+
+    This is the CPU-proxy transport: XLA:CPU refuses multiprocess
+    computations outright ("Multiprocess computations aren't implemented on
+    the CPU backend"), so the two-process CI topology exchanges its moment
+    carries host->coordinator->host instead.  Payloads are per-host moment
+    carries (KBs), not row data — the store is never a data plane."""
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    seq = _KV_SEQ.get(kind, 0)
+    _KV_SEQ[kind] = seq + 1
+    me = int(jax.process_index())
+    client.key_value_set_bytes(f"tmog_gather/{kind}/{seq}/{me}",
+                               raw.tobytes())
+    out = []
+    for h in range(int(jax.process_count())):
+        buf = client.blocking_key_value_get_bytes(
+            f"tmog_gather/{kind}/{seq}/{h}", 120_000)
+        out.append(np.frombuffer(bytes(buf), np.uint8))
+    return out
+
+
+def _cross_host_gather(vec64: np.ndarray, kind: str):
+    """All-gather one f64 vector across processes -> list of per-host rows.
+
+    The payload crosses DCN as raw bytes (uint8 view), so the f64 carries
+    survive even with jax x64 disabled.  Each gather is counted in the
+    ``host`` obs scope (kind, payload bytes) — the cross-host analog of the
+    ``mesh_psum`` trace telemetry."""
+    from ..obs.registry import scope as _scope
+
+    raw = np.ascontiguousarray(np.asarray(vec64, np.float64)).view(np.uint8)
+    sc = _scope("host")
+    sc.inc("collectives")
+    sc.inc("collective_bytes", float(raw.nbytes))
+    sc.append("events", {"kind": kind, "bytes": int(raw.nbytes)})
+    if jax.default_backend() == "cpu":
+        rows8 = _kv_gather(raw, kind)
+    else:
+        from jax.experimental import multihost_utils
+
+        gathered = np.asarray(multihost_utils.process_allgather(raw))
+        rows8 = [np.ascontiguousarray(gathered[i])
+                 for i in range(gathered.shape[0])]
+    return [row.view(np.float64) for row in rows8]
+
+
+def _multi_host() -> bool:
+    try:
+        return int(jax.process_count()) > 1
+    except Exception:
+        return False
+
+
+def host_merge_moments(carry, d: int):
+    """Merge one host's (n, mean[d], M2[d]) Chan carry into the GLOBAL carry.
+
+    A host with an empty row range contributes an exact zero carry (its
+    ``mean`` may be None).  Single-process: identity."""
+    n, mean, M2 = carry
+    if not _multi_host():
+        return carry
+    if mean is None:
+        n, mean, M2 = 0.0, np.zeros(d), np.zeros(d)
+    packed = np.concatenate([[float(n)], np.asarray(mean, np.float64),
+                             np.asarray(M2, np.float64)])
+    rows = _cross_host_gather(packed, "moments")
+    return _merge_moment_carries(
+        [(r[0], r[1:1 + d], r[1 + d:]) for r in rows])
+
+
+def host_sum_reduce(parts, kind: str = "sum"):
+    """Element-wise sum of a flat f64 vector across hosts (for carries
+    already centered at a GLOBAL reference — raw sums, common-mean Grams).
+    min/max components must not ride through this; see
+    ``host_merge_minmax``.  Single-process: identity."""
+    parts = np.asarray(parts, np.float64)
+    if not _multi_host():
+        return parts
+    rows = _cross_host_gather(parts, kind)
+    return np.sum(np.stack(rows, axis=0), axis=0)
+
+
+def host_merge_minmax(mn, mx):
+    """Global element-wise column min/max across hosts (empty-range hosts
+    hold ±inf identities).  Single-process: identity."""
+    mn = np.asarray(mn, np.float64)
+    mx = np.asarray(mx, np.float64)
+    if not _multi_host():
+        return mn, mx
+    d = mn.shape[0]
+    rows = _cross_host_gather(np.concatenate([mn, mx]), "minmax")
+    stacked = np.stack(rows, axis=0)
+    return stacked[:, :d].min(axis=0), stacked[:, d:].max(axis=0)
+
+
+def host_merge_fused_carry(carry, d: int):
+    """Chan-merge the fused one-pass carry (n, mean, ym, mn, mx, G, gy, yy)
+    across hosts in f64 — exact pairwise cross terms for the Gram, so the
+    global correlations match a single-host pass to f32-accumulation noise.
+    Single-process: identity."""
+    if not _multi_host():
+        return carry
+    n, mean, ym, mn, mx, G, gy, yy = (np.asarray(c, np.float64)
+                                      for c in carry)
+    packed = np.concatenate([[float(n), float(ym), float(yy)], mean, mn, mx,
+                             gy, G.reshape(-1)])
+    rows = _cross_host_gather(packed, "fused_stats")
+    nt = 0.0
+    mean_t = ym_t = G_t = gy_t = yy_t = None
+    mn_t = np.full(d, np.inf)
+    mx_t = np.full(d, -np.inf)
+    for r in rows:
+        n_c, ym_c, yy_c = r[0], r[1], r[2]
+        o = 3
+        mean_c = r[o:o + d]; o += d
+        mn_c = r[o:o + d]; o += d
+        mx_c = r[o:o + d]; o += d
+        gy_c = r[o:o + d]; o += d
+        G_c = r[o:].reshape(d, d)
+        mn_t = np.minimum(mn_t, mn_c)
+        mx_t = np.maximum(mx_t, mx_c)
+        if n_c <= 0:
+            continue
+        if mean_t is None:
+            nt, mean_t, ym_t = n_c, mean_c, ym_c
+            G_t, gy_t, yy_t = G_c, gy_c, yy_c
+            continue
+        ns = nt + n_c
+        f = nt * n_c / ns
+        dx = mean_c - mean_t
+        dy = ym_c - ym_t
+        G_t = G_t + G_c + f * np.outer(dx, dx)
+        gy_t = gy_t + gy_c + f * dx * dy
+        yy_t = yy_t + yy_c + f * dy * dy
+        w = n_c / ns
+        mean_t = mean_t + dx * w
+        ym_t = ym_t + dy * w
+        nt = ns
+    if mean_t is None:
+        z = np.zeros(d)
+        return 0.0, z, 0.0, mn_t, mx_t, np.zeros((d, d)), z.copy(), 0.0
+    return nt, mean_t, ym_t, mn_t, mx_t, G_t, gy_t, yy_t
+
+
 def sharded_column_moments(X: np.ndarray, chunk_rows: int = 1 << 18,
                            devices: Optional[list] = None
                            ) -> Tuple[float, np.ndarray, np.ndarray]:
@@ -294,8 +475,8 @@ def sharded_column_moments(X: np.ndarray, chunk_rows: int = 1 << 18,
             else jnp.asarray(chunk)
         ma = jax.device_put(m, dev) if dev is not None else jnp.asarray(m)
         carries[di] = _chan_moments_step(carries[di], xa, ma)
-    n_t, mean, M2 = _merge_moment_carries(
-        [c for c in carries if c is not None])
+    n_t, mean, M2 = host_merge_moments(_merge_moment_carries(
+        [c for c in carries if c is not None]), d)
     if not n_t or mean is None:
         z = np.zeros(d)
         return 0.0, z, z.copy()
@@ -363,9 +544,17 @@ def fused_moments_and_correlations(chunks_factory, d: int, mesh=None,
         carry = _fused_stats_step(carry, acc._place(X), acc._place(y),
                                   acc._place(m))
     if carry is None:
-        z = np.zeros(d)
-        return ColStats(0, z, z.copy(), z.copy(), z.copy()), \
-            np.full(d, np.nan), None
+        if _multi_host():
+            # an empty-range host still joins the cross-host merge with an
+            # exact zero carry — the other hosts' allgather must not hang
+            carry = (jnp.zeros(()), jnp.zeros(d), jnp.zeros(()),
+                     jnp.full(d, jnp.inf), jnp.full(d, -jnp.inf),
+                     jnp.zeros((d, d)), jnp.zeros(d), jnp.zeros(()))
+        else:
+            z = np.zeros(d)
+            return ColStats(0, z, z.copy(), z.copy(), z.copy()), \
+                np.full(d, np.nan), None
+    carry = host_merge_fused_carry(carry, d)
     n_, mean, _ym, mn, mx, G, gy, yy = (np.asarray(c, np.float64)
                                         for c in carry)
     n = float(n_)

@@ -200,8 +200,19 @@ def _matches(schema: Any, v: Any) -> bool:
 # ---------------------------------------------------------------------------
 # Container files
 # ---------------------------------------------------------------------------
-def read_avro(path: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
-    """Read an Object Container File -> (schema, records)."""
+def read_avro(path: str, row_range: Optional[Tuple[int, int]] = None,
+              count_only: bool = False):
+    """Read an Object Container File -> (schema, records).
+
+    ``count_only=True`` returns ``(schema, n_records)`` by walking block
+    headers alone — counts and sizes are in the frame, so no payload is ever
+    inflated or decoded (the cheap first pass of a sharded read).
+
+    ``row_range=(lo, hi)`` returns only the records with global index in
+    ``[lo, hi)``: blocks wholly outside the range are skipped undecoded
+    (deflate payloads not even inflated), boundary blocks are decoded and
+    sliced.  This is the multi-host ingestion path — each host pays decode
+    cost proportional to its own range, not the file."""
     with open(path, "rb") as fh:
         buf = io.BytesIO(fh.read())
     if buf.read(4) != MAGIC:
@@ -213,6 +224,7 @@ def read_avro(path: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
         raise ValueError(f"unsupported avro codec {codec!r}")
     sync = buf.read(SYNC_SIZE)
     records: List[Dict[str, Any]] = []
+    pos = 0  # global index of the next block's first record
     while True:
         head = buf.read(1)
         if not head:
@@ -220,14 +232,25 @@ def read_avro(path: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
         buf.seek(-1, io.SEEK_CUR)
         count = _read_long(buf)
         size = _read_long(buf)
-        payload = buf.read(size)
-        if codec == "deflate":
-            payload = zlib.decompress(payload, -15)
-        block = io.BytesIO(payload)
-        for _ in range(count):
-            records.append(_read_value(block, schema))
+        skip = count_only or (
+            row_range is not None
+            and (pos + count <= row_range[0] or pos >= row_range[1]))
+        if skip:
+            buf.seek(size, io.SEEK_CUR)
+        else:
+            payload = buf.read(size)
+            if codec == "deflate":
+                payload = zlib.decompress(payload, -15)
+            block = io.BytesIO(payload)
+            for j in range(count):
+                rec = _read_value(block, schema)
+                if row_range is None or row_range[0] <= pos + j < row_range[1]:
+                    records.append(rec)
+        pos += count
         if buf.read(SYNC_SIZE) != sync:
             raise ValueError("sync marker mismatch (corrupt block)")
+    if count_only:
+        return schema, pos
     return schema, records
 
 

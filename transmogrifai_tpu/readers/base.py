@@ -119,31 +119,78 @@ def _bad_rows(raw_features: Sequence[Feature], df=None,
     return out
 
 
+def _shard_param(params, consumed: bool = False):
+    """Resolve ``shard=(host_index, host_count)`` for a read.
+
+    An explicit ``shard`` in the reader params (top level or under
+    ``maybeReaderParams``) wins; otherwise the ambient host topology
+    (``TMOG_HOSTS``/``TMOG_HOST_INDEX``, or ``jax.process_count()`` under
+    ``jax.distributed``) shards automatically when more than one host is
+    active — each host ingests ONLY its ``host_rows`` range.  Returns None
+    on a single host (or ``shard=(0, 1)`` explicitly): the legacy unsharded
+    path, byte-identical.  ``consumed=True`` means the reader already
+    striped its file list across hosts, so row-range slicing must not apply
+    a second time."""
+    if consumed:
+        return None
+    s = (params or {}).get("maybeReaderParams", {}).get("shard") \
+        or (params or {}).get("shard")
+    if s is None:
+        from ..parallel import mesh as _mesh
+
+        H = _mesh.host_count()
+        if H <= 1:
+            return None
+        s = (_mesh.host_index(), H)
+    h, H = int(s[0]), int(s[1])
+    if H <= 1:
+        return None
+    if not 0 <= h < H:
+        raise ValueError(f"shard index {h} out of range for {H} hosts")
+    return h, H
+
+
+def _shard_range(n_rows: int, shard) -> tuple:
+    """Global row range ``[lo, hi)`` this shard owns of an ``n_rows`` source
+    (after any ``limit``): the contiguous ``parallel.mesh.host_rows`` split."""
+    from ..parallel.mesh import host_rows
+
+    if shard is None:
+        return 0, int(n_rows)
+    return host_rows(n_rows, index=shard[0], count=shard[1])
+
+
 def _apply_row_policy(raw_features: Sequence[Feature], df,
-                      records: Optional[List[Dict[str, Any]]]):
+                      records: Optional[List[Dict[str, Any]]],
+                      index_base: int = 0):
     """``TMOG_QUARANTINE`` at read time; returns ``(df, records)`` with bad
     rows dropped (``drop``), or raises :class:`DataFault` (``strict`` /
-    ``fail``).  Unset policy returns the inputs untouched, unscanned."""
+    ``fail``).  Unset policy returns the inputs untouched, unscanned.
+
+    ``index_base`` is the global row index of local row 0 — nonzero under
+    ``shard=``, so audit/fault indices always name the GLOBAL row (the one
+    an operator can find in the source), never the host-local offset."""
     pol = _quar.policy()
     if not pol:
         return df, records
     bad = _bad_rows(raw_features, df, records)
     if not bad:
         return df, records
+    base = int(index_base)
     dls = _quar.store()
     if pol == "strict":
         i, name, reason = bad[0]
-        dls.put("reader", reason, index=i, field=name,
+        dls.put("reader", reason, index=i + base, field=name,
                 record=records[i] if records else None,
                 detail="TMOG_QUARANTINE=strict")
-        raise DataFault(reason, index=i, field=name,
+        raise DataFault(reason, index=i + base, field=name,
                         detail="TMOG_QUARANTINE=strict")
     for i, name, reason in bad:
-        dls.put("reader", reason, index=i, field=name,
+        dls.put("reader", reason, index=i + base, field=name,
                 record=records[i] if records and i < len(records) else None)
     if pol == "fail":
         i, name, reason = bad[0]
-        raise DataFault(reason, index=i, field=name,
+        raise DataFault(reason, index=i + base, field=name,
                         detail=f"{len({b[0] for b in bad})} bad row(s), "
                                "TMOG_QUARANTINE=fail")
     drop = {i for i, _, _ in bad}
@@ -211,11 +258,15 @@ class DataReader(Reader):
         import pandas as pd
 
         data = self.read(params)
+        shard = _shard_param(params, consumed=getattr(self, "_shard_consumed", False))
+        # rows the reader itself already skipped (e.g. avro block-skip decode)
+        # — added to every audit/positional-key index so they stay global
+        pre = int(getattr(self, "_shard_base", 0) or 0)
         if isinstance(data, Dataset):
             # zero-copy fast path: a columnar Dataset whose columns already
             # match every raw feature's field extractor (and key needs) is
             # consumed directly — no pandas round-trip, no row dicts
-            direct = self._dataset_direct(raw_features, data, params)
+            direct = self._dataset_direct(raw_features, data, params, shard)
             if direct is not None:
                 return direct
             data = data.to_pandas()  # keeps field extraction on the vectorized path
@@ -225,26 +276,43 @@ class DataReader(Reader):
             # no per-row dict materialization — critical at 10M+ rows
             if limit:
                 df = df.head(int(limit))
-            df, _ = _apply_row_policy(raw_features, df, None)
+            # limit-then-shard: hosts split the SAME limited view the
+            # single-host run would see, so the shard union equals it exactly
+            lo, hi = _shard_range(len(df), shard)
+            if shard is not None:
+                df = df.iloc[lo:hi].reset_index(drop=True)
+            df, _ = _apply_row_policy(raw_features, df, None, index_base=pre + lo)
             cols = _extract_columns(raw_features, [], df)
-            return Dataset(cols, self._vectorized_keys(df))
+            return Dataset(cols, self._vectorized_keys(df, base=pre + lo))
         records = _records_from(data)
         if limit:
             records = records[: int(limit)]
             df = df.head(int(limit)) if df is not None else None
-        df, records = _apply_row_policy(raw_features, df, records)
+        lo, hi = _shard_range(len(records), shard)
+        if shard is not None:
+            records = records[lo:hi]
+            df = df.iloc[lo:hi].reset_index(drop=True) if df is not None else None
+        df, records = _apply_row_policy(raw_features, df, records,
+                                        index_base=pre + lo)
         cols = _extract_columns(raw_features, records, df)
-        keys = np.array([self._key_of(r, i) for i, r in enumerate(records)], dtype=object)
+        keys = np.array([self._key_of(r, pre + lo + i)
+                         for i, r in enumerate(records)], dtype=object)
         return Dataset(cols, keys)
 
     def _dataset_direct(self, raw_features: Sequence[Feature], data: Dataset,
-                        params) -> Optional[Dataset]:
+                        params, shard=None) -> Optional[Dataset]:
         limit = (params or {}).get("maybeReaderParams", {}).get("limit") \
             or (params or {}).get("limit")
         if limit or callable(self.key):
             return None
         if isinstance(self.key, str) and self.key not in data.columns:
             return None
+        lo, hi = _shard_range(len(data), shard)
+        if shard is not None:
+            # row-range slice of the in-memory frame: still zero host copies
+            # of the untouched remainder — this host materializes only its
+            # own range
+            data = data.take(np.arange(lo, hi))
         cols: Dict[str, Any] = {}
         for f in raw_features:
             ex = getattr(f.origin_stage, "extract_fn", None)
@@ -260,7 +328,8 @@ class DataReader(Reader):
         elif data.key is not None:
             keys = data.key
         else:
-            keys = np.arange(len(data)).astype(str).astype(object)
+            # positional keys stay GLOBAL row indices under shard=
+            keys = np.arange(lo, hi).astype(str).astype(object)
         return Dataset(cols, keys)
 
     def _fully_vectorizable(self, raw_features: Sequence[Feature], df) -> bool:
@@ -278,13 +347,15 @@ class DataReader(Reader):
                 return False
         return True
 
-    def _vectorized_keys(self, df) -> np.ndarray:
+    def _vectorized_keys(self, df, base: int = 0) -> np.ndarray:
         n = len(df)
         if isinstance(self.key, str):
             return df[self.key].astype(str).to_numpy(dtype=object)
         if self.key is None and KEY_FIELD in df.columns:
             return df[KEY_FIELD].astype(str).to_numpy(dtype=object)
-        return np.arange(n).astype(str).astype(object)
+        # positional keys are GLOBAL row indices (base = shard range start),
+        # so every host's keys reconstruct the exact pre-shard row identity
+        return np.arange(base, base + n).astype(str).astype(object)
 
 
 class CustomReader(DataReader):

@@ -9,15 +9,55 @@ the image; the reader raises a clear error if used without one).
 """
 from __future__ import annotations
 
+import glob as _glob
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
-from .base import AggregateDataReader, ConditionalDataReader, DataReader
+from .base import (AggregateDataReader, ConditionalDataReader, DataReader,
+                   _shard_param)
+
+
+def _host_paths(reader: DataReader, path, params) -> List[str]:
+    """Expand a list/glob path spec and stripe multiple files across hosts.
+
+    Under ``shard=(host_index, host_count)`` a multi-file source is split by
+    striping the sorted file list (host ``h`` reads files ``h::H``) — each
+    host opens ONLY its own files.  Striping consumes the shard (row-range
+    slicing must not apply a second time), at the price of positional keys
+    being local to the host's file set rather than global row indices; pass
+    row-indexed sources a key column when global identity matters.  A single
+    concrete file is returned as-is and keeps the exact row-range path."""
+    reader._shard_consumed = False
+    reader._shard_base = 0
+    if isinstance(path, (list, tuple)):
+        paths = [str(p) for p in path]
+    elif isinstance(path, str) and _glob.has_magic(path):
+        paths = sorted(_glob.glob(path))
+    else:
+        return [path]
+    shard = _shard_param(params)
+    if shard is not None and len(paths) > 1:
+        h, H = shard
+        paths = paths[h::H]
+        reader._shard_consumed = True
+    return paths
+
+
+def _concat_frames(frames, columns=None):
+    import pandas as pd
+
+    if not frames:
+        return pd.DataFrame(columns=list(columns) if columns else None)
+    if len(frames) == 1:
+        return frames[0]
+    return pd.concat(frames, ignore_index=True)
 
 
 class CSVReader(DataReader):
-    """Schema'd CSV without header (CSVReaders.scala:54)."""
+    """Schema'd CSV without header (CSVReaders.scala:54).  ``path`` may be a
+    single file, a list of files, or a glob — multi-file sources stripe
+    across hosts under ``shard=``."""
 
-    def __init__(self, path: str, schema: Sequence[str],
+    def __init__(self, path: Union[str, Sequence[str]], schema: Sequence[str],
                  key: Union[str, Callable, None] = None, **read_kwargs):
         super().__init__(key=key)
         self.path = path
@@ -27,14 +67,17 @@ class CSVReader(DataReader):
     def read(self, params: Optional[Dict[str, Any]] = None):
         import pandas as pd
 
-        path = (params or {}).get("path", self.path)
-        return pd.read_csv(path, header=None, names=self.schema, **self.read_kwargs)
+        paths = _host_paths(self, (params or {}).get("path", self.path), params)
+        return _concat_frames(
+            [pd.read_csv(p, header=None, names=self.schema, **self.read_kwargs)
+             for p in paths], columns=self.schema)
 
 
 class CSVAutoReader(DataReader):
     """Header-inferring CSV (CSVReaders.scala CSVAutoReader)."""
 
-    def __init__(self, path: str, key: Union[str, Callable, None] = None, **read_kwargs):
+    def __init__(self, path: Union[str, Sequence[str]],
+                 key: Union[str, Callable, None] = None, **read_kwargs):
         super().__init__(key=key)
         self.path = path
         self.read_kwargs = read_kwargs
@@ -42,8 +85,8 @@ class CSVAutoReader(DataReader):
     def read(self, params: Optional[Dict[str, Any]] = None):
         import pandas as pd
 
-        path = (params or {}).get("path", self.path)
-        return pd.read_csv(path, **self.read_kwargs)
+        paths = _host_paths(self, (params or {}).get("path", self.path), params)
+        return _concat_frames([pd.read_csv(p, **self.read_kwargs) for p in paths])
 
 
 class CSVProductReader(CSVAutoReader):
@@ -54,15 +97,16 @@ class CSVProductReader(CSVAutoReader):
 class ParquetReader(DataReader):
     """Parquet via pyarrow (ParquetProductReader.scala:47)."""
 
-    def __init__(self, path: str, key: Union[str, Callable, None] = None):
+    def __init__(self, path: Union[str, Sequence[str]],
+                 key: Union[str, Callable, None] = None):
         super().__init__(key=key)
         self.path = path
 
     def read(self, params: Optional[Dict[str, Any]] = None):
         import pandas as pd
 
-        path = (params or {}).get("path", self.path)
-        return pd.read_parquet(path)
+        paths = _host_paths(self, (params or {}).get("path", self.path), params)
+        return _concat_frames([pd.read_parquet(p) for p in paths])
 
 
 ParquetProductReader = ParquetReader
@@ -71,24 +115,49 @@ ParquetProductReader = ParquetReader
 class AvroReader(DataReader):
     """Avro records (AvroReaders.scala:55) via the vendored pure-Python
     Object Container File codec (readers/avro_io.py) — fastavro is used only
-    if present."""
+    if present.  Multi-file sources stripe across hosts; a single container
+    file under ``shard=`` decodes only the blocks overlapping this host's
+    row range (``avro_io.read_avro(row_range=...)``) — the skipped blocks
+    are never even inflated."""
 
-    def __init__(self, path: str, key: Union[str, Callable, None] = None):
+    def __init__(self, path: Union[str, Sequence[str]],
+                 key: Union[str, Callable, None] = None):
         super().__init__(key=key)
         self.path = path
 
     def read(self, params: Optional[Dict[str, Any]] = None):
-        path = (params or {}).get("path", self.path)
-        try:
-            import fastavro
+        paths = _host_paths(self, (params or {}).get("path", self.path), params)
+        limit = (params or {}).get("maybeReaderParams", {}).get("limit") \
+            or (params or {}).get("limit")
+        shard = None
+        if len(paths) == 1 and not limit:
+            # single container: push the row range into the block decoder
+            # (limit forces the full read — limit-then-shard needs the
+            # limited total row count, which only the base path knows)
+            shard = _shard_param(
+                params, consumed=getattr(self, "_shard_consumed", False))
+        out: List[Dict[str, Any]] = []
+        for path in paths:
+            try:
+                import fastavro
 
-            with open(path, "rb") as fh:
-                return list(fastavro.reader(fh))
-        except ImportError:
-            from .avro_io import read_avro
+                with open(path, "rb") as fh:
+                    out.extend(fastavro.reader(fh))
+            except ImportError:
+                from .avro_io import read_avro
 
-            _, records = read_avro(path)
-            return records
+                if shard is not None:
+                    from ..parallel.mesh import host_rows
+
+                    _, n_total = read_avro(path, count_only=True)
+                    lo, hi = host_rows(n_total, index=shard[0], count=shard[1])
+                    _, records = read_avro(path, row_range=(lo, hi))
+                    self._shard_consumed = True
+                    self._shard_base = lo
+                    return records
+                _, records = read_avro(path)
+                out.extend(records)
+        return out
 
 
 def _with_aggregate(reader_cls):

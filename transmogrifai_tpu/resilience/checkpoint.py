@@ -67,6 +67,21 @@ def data_fingerprint(arr) -> str:
     return h.hexdigest()[:20]
 
 
+def host_key_part() -> tuple:
+    """``(("host", index, count),)`` when the process is one of several
+    hosts, else ``()``.
+
+    Splice into every per-work-unit content key (``*host_key_part()``) so a
+    restarted host resumes exactly ITS OWN completed chunks/shards — even
+    when the per-host data fingerprints collide (synthetic per-host frames
+    can be identical across hosts).  Single-host returns empty, keeping keys
+    byte-identical to the pre-multi-host layout."""
+    from ..parallel.mesh import host_count, host_index
+
+    H = host_count()
+    return (("host", host_index(), H),) if H > 1 else ()
+
+
 def content_key(*parts) -> str:
     """Hash heterogeneous parts (arrays via :func:`data_fingerprint`,
     everything else via ``repr``) into one checkpoint key."""

@@ -437,7 +437,10 @@ class AshaScheduler:
         from ..resilience import inject as _inject
         from ..resilience.hedge import run_hedged
 
-        devs = list(jax.devices())
+        # local devices only: each host hedges its own family slots; a
+        # process-spanning pool would dispatch to chips this host cannot
+        # address under jax.distributed
+        devs = list(jax.local_devices())
         n_fam = len(self.families)
         deadlines = [self._family_deadline(fi) for fi in range(n_fam)]
 

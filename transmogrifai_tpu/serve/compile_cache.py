@@ -31,7 +31,9 @@ import hashlib
 import os
 import pickle
 import tempfile
+import threading
 import time
+from collections import OrderedDict
 from typing import Any, Optional, Sequence, Tuple
 
 from ..obs import registry as obs_registry
@@ -45,6 +47,35 @@ __all__ = ["cache_dir", "fingerprint", "load_or_compile", "cache_stats",
 #: pickle payload format — bump when the on-disk tuple layout changes;
 #: mismatched entries fall back to compile (never an error)
 _ENTRY_VERSION = 1
+
+#: process-level second tier, keyed by (cache dir, program name, content
+#: fingerprint).  XLA:CPU cannot round-trip SOME serialized executables
+#: (deserialize_and_load raises "Symbols not found" on e.g. the logistic
+#: prediction-head program, while the fused bucket programs round-trip
+#: fine) — so a re-deploy in the same process reuses the executable the
+#: cache itself produced for that exact fingerprint.  Consulted ONLY when a
+#: VALID entry fails backend deserialization: corrupt/truncated pickles
+#: still take the recorded compile fallback, and a process restart (memo
+#: empty) still measures the true disk round-trip.
+_MEM: "OrderedDict[Tuple[str, str, str], Any]" = OrderedDict()
+_MEM_CAP = 256
+_MEM_LOCK = threading.Lock()
+
+
+def _mem_put(mkey: Tuple[str, str, str], compiled: Any) -> None:
+    with _MEM_LOCK:
+        _MEM[mkey] = compiled
+        _MEM.move_to_end(mkey)
+        while len(_MEM) > _MEM_CAP:
+            _MEM.popitem(last=False)
+
+
+def _mem_get(mkey: Tuple[str, str, str]) -> Optional[Any]:
+    with _MEM_LOCK:
+        compiled = _MEM.get(mkey)
+        if compiled is not None:
+            _MEM.move_to_end(mkey)
+        return compiled
 
 _scope = obs_registry.scope("compile_cache", defaults=dict(
     hits=0, misses=0, compiles=0, compile_s=0.0, load_s=0.0,
@@ -93,27 +124,38 @@ def _entry_path(directory: str, name: str, key: str) -> str:
     return os.path.join(directory, f"{safe}-{key}.aotx")
 
 
-def _try_load(path: str) -> Optional[Any]:
-    """Deserialize one entry; None (plus a recorded fallback) on ANY defect —
-    truncated pickle, wrong entry version, undeserializable payload."""
+def _try_load(path: str) -> Tuple[Optional[Any], Optional[str]]:
+    """Deserialize one entry -> ``(compiled, failure_kind)``.
+
+    ``(executable, None)`` on success.  On any defect the fallback is
+    recorded and ``compiled`` is None; ``failure_kind`` distinguishes
+    ``"corrupt"`` (truncated pickle, wrong entry version — the entry itself
+    is bad) from ``"backend"`` (a VALID entry whose payload this backend
+    refuses to deserialize — XLA:CPU round-trip gaps), which decides
+    whether the in-process memo may stand in."""
     from jax.experimental import serialize_executable
 
     t0 = time.perf_counter()
+    entry = None
     try:
         _inject.maybe_fail("compile_cache.load")
         with open(path, "rb") as f:
             entry = pickle.load(f)
         if not (isinstance(entry, tuple) and len(entry) == 4
                 and entry[0] == _ENTRY_VERSION):
-            raise ValueError(f"entry version mismatch: {entry[:1]!r}")
+            entry = None
+            raise ValueError(f"entry version mismatch")
         _, payload, in_tree, out_tree = entry
         compiled = serialize_executable.deserialize_and_load(
             payload, in_tree, out_tree)
     except Exception as e:  # noqa: BLE001 — corrupt entry -> compile fallback
-        _record_fallback("corrupt_cache_entry", path=path, error=repr(e))
-        return None
+        kind = "backend" if entry is not None else "corrupt"
+        _record_fallback("corrupt_cache_entry" if kind == "corrupt"
+                         else "backend_deserialize_failed",
+                         path=path, error=repr(e))
+        return None, kind
     _scope.inc("load_s", time.perf_counter() - t0)
-    return compiled
+    return compiled, None
 
 
 def _save(path: str, compiled: Any) -> bool:
@@ -160,19 +202,26 @@ def load_or_compile(name: str, lowered: Any, device: Any,
     (counted, so the obs compile counters stay meaningful either way).
     """
     directory = cache_dir()
-    path = None
+    path = mkey = None
     if directory:
         if hlo_text is None:
             hlo_text = lowered.as_text()
         key = fingerprint(name, hlo_text, device, extra)
         path = _entry_path(directory, name, key)
+        mkey = (directory, name, key)
         if os.path.exists(path):
             with trace.span("compile_cache.load", program=name,
                             device=str(device)):
-                compiled = _try_load(path)
+                compiled, fail_kind = _try_load(path)
             if compiled is not None:
+                _mem_put(mkey, compiled)
                 _scope.inc("hits")
                 return compiled, "hit"
+            if fail_kind == "backend":
+                compiled = _mem_get(mkey)
+                if compiled is not None:
+                    _scope.inc("hits")
+                    return compiled, "hit"
         _scope.inc("misses")
     if callable(lowered) and not hasattr(lowered, "compile"):
         lowered = lowered()
@@ -184,4 +233,5 @@ def load_or_compile(name: str, lowered: Any, device: Any,
     _scope.inc("compile_s", time.perf_counter() - t0)
     if path is not None:
         _save(path, compiled)
+        _mem_put(mkey, compiled)
     return compiled, "compile"

@@ -19,7 +19,7 @@ property the reference asserts in every OpTransformerSpec).
 """
 from __future__ import annotations
 
-import secrets
+import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Type, TYPE_CHECKING
 
 import numpy as np
@@ -31,9 +31,23 @@ if TYPE_CHECKING:
     from ..features.feature import Feature
 
 
+_UID_LOCK = threading.Lock()
+_UID_COUNTS: Dict[str, int] = {}
+
+
 def make_uid(cls_name: str) -> str:
-    """Reference-style stage uid: ``ClassName_<12 hex>`` (UID.scala analog)."""
-    return f"{cls_name}_{secrets.token_hex(6)}"
+    """Reference-style stage uid: ``ClassName_<12 hex>`` (UID.scala analog).
+
+    Deterministic — a per-class construction counter, not random hex: a
+    restarted process that rebuilds the same pipeline reconstructs the SAME
+    uids, so content-keyed checkpoint keys (stream chunk resume, sweep shard
+    resume) survive preemption — a SIGKILLed host re-running its script
+    finds its own completed work.  In-process uniqueness is unchanged (the
+    counter never repeats a value for a class)."""
+    with _UID_LOCK:
+        n = _UID_COUNTS.get(cls_name, 0)
+        _UID_COUNTS[cls_name] = n + 1
+    return f"{cls_name}_{n:012x}"
 
 
 class PipelineStage:

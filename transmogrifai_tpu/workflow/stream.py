@@ -721,6 +721,15 @@ def execute(plan: StreamPlan, ds: Dataset) -> Dict[str, Any]:
              getattr(e.stage, "operation_name", "?"),
              e.out_name, e.out_kind, bool(e.terminal))
             for e in plan.stages))
+        # multi-host: the host range joins the signature, so a restarted
+        # host finds exactly ITS OWN completed chunks and can never restore
+        # another host's range (chunk offsets are host-local).  Single-host
+        # keys stay byte-identical to the pre-multi-host layout.
+        from ..parallel.mesh import host_count, host_index
+
+        H = host_count()
+        if H > 1:
+            plan_sig = plan_sig + (("host", host_index(), H),)
 
     def _chunk_key(lo, host_args):
         fps = []
