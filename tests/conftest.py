@@ -25,6 +25,13 @@ jax.config.update("jax_platforms", "cpu")
 # exact pre-hedge dispatch; tests/test_hedge.py opts back in per test.
 os.environ.setdefault("TMOG_HEDGE", "0")
 
+# obs/record.py defaults to ./telemetry.jsonl, so any test that drives a
+# record-writing entry point (__graft_entry__ dryrun, bench helpers) would
+# drop a stray file at repo root — the exact droppings the tier1 repo-
+# hygiene step rejects.  Default the suite's telemetry out of the tree;
+# CI entries that WANT the artifact set TMOG_TELEMETRY explicitly first.
+os.environ.setdefault("TMOG_TELEMETRY", "/tmp/tmog_test_telemetry.jsonl")
+
 
 import numpy as np
 import pandas as pd

@@ -1,0 +1,289 @@
+"""Multi-tenant serving plane: placement determinism, LRU lifecycle edges,
+per-tenant admission isolation, and cross-tenant hot-swap/heal guarantees.
+
+Everything here is EVENT-asserted (counters, bit-equality, structural
+invariants) — no wall-clock bounds, so an oversubscribed CI host cannot
+flake these.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu import OpWorkflow
+from transmogrifai_tpu.impl.classification.logistic import OpLogisticRegression
+from transmogrifai_tpu.impl.feature.vectorizers import (OneHotVectorizer,
+                                                        RealVectorizer,
+                                                        VectorsCombiner)
+from transmogrifai_tpu.serve import (MicroBatcher, ModelRegistry, ShedError,
+                                     placement)
+from transmogrifai_tpu.serve import aot as serve_aot
+from transmogrifai_tpu.serve import compile_cache
+from transmogrifai_tpu.testkit import TestFeatureBuilder
+
+REC = {"x": 0.5, "cat": "a"}
+
+
+def _train(n=80, shift=0.0):
+    ds, (x, cat, y) = TestFeatureBuilder.of(
+        ("x", T.Real, list(np.linspace(-2 + shift, 2 + shift, n))),
+        ("cat", T.PickList, ["a", "b"] * (n // 2)),
+        ("y", T.RealNN, [float(i % 2) for i in range(n)]), response="y")
+    feats = VectorsCombiner().set_input(
+        RealVectorizer().set_input(x).get_output(),
+        OneHotVectorizer(top_k=3, min_support=1).set_input(cat).get_output(),
+    ).get_output()
+    pred = OpLogisticRegression(reg_param=0.1).set_input(y, feats).get_output()
+    return OpWorkflow().set_input_dataset(ds).set_result_features(pred).train()
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _train()
+
+
+@pytest.fixture(scope="module")
+def model_v2():
+    return _train(shift=0.3)
+
+
+# ---------------------------------------------------------------------------
+# placement: pure-function planning
+# ---------------------------------------------------------------------------
+def test_placement_oversubscription_is_round_robin():
+    """16 fresh (equal-load) tenants on 8 slots: deterministic tenant i ->
+    slot i % 8, and a second identical call returns an identical plan."""
+    loads = [placement.TenantLoad(f"t{i:02d}", 64.0, 0.0) for i in range(16)]
+    p1 = placement.plan(loads, 8)
+    p2 = placement.plan(loads, 8)
+    assert p1.slots == p2.slots
+    assert p1.source == "analytic"  # TMOG_COSTMODEL off in tier-1
+    for i in range(16):
+        assert p1.slots[f"t{i:02d}"] == [i % 8], (i, p1.slots)
+
+
+def test_placement_fixed_tenants_never_move():
+    loads = [placement.TenantLoad("a", 64.0, 5.0),
+             placement.TenantLoad("b", 64.0, 0.0)]
+    p = placement.plan(loads, 4, fixed={"a": [3]}, per_tenant=1)
+    assert p.slots["a"] == [3]
+    # b avoids a's loaded slot
+    assert p.slots["b"] != [3]
+
+
+def test_placement_heavier_tenants_get_slots_first():
+    """LPT: the heavy tenant is placed before the light ones, so with one
+    slot per tenant it takes the emptiest chips first — and its load lands
+    on the plan's slot_load ledger."""
+    loads = [placement.TenantLoad("light", 8.0, 1.0),
+             placement.TenantLoad("heavy", 512.0, 10.0)]
+    p = placement.plan(loads, 2, per_tenant=1)
+    assert set(p.slots["heavy"] + p.slots["light"]) == {0, 1}
+    heavy_slot = p.slots["heavy"][0]
+    assert p.load[heavy_slot] > p.load[p.slots["light"][0]]
+    # heavy went first: it took slot 0 (all slots empty, lowest index wins)
+    assert heavy_slot == 0
+
+
+def test_placement_chip_sharing_spreads_across_chips():
+    """Oversubscribed slots (2 slots per chip) count against the CHIP's
+    budget: two single-slot tenants land on different chips, not on the two
+    slots of chip 0."""
+    p = placement.plan([placement.TenantLoad("a", 64.0, 1.0),
+                        placement.TenantLoad("b", 64.0, 1.0)],
+                       4, chip_of=[0, 0, 1, 1], per_tenant=1)
+    chip = {0: 0, 1: 0, 2: 1, 3: 1}
+    assert chip[p.slots["a"][0]] != chip[p.slots["b"][0]]
+
+
+def test_batch_wall_analytic_when_costmodel_off(monkeypatch):
+    monkeypatch.delenv("TMOG_COSTMODEL", raising=False)
+    wall, source = placement.batch_wall_s(128.0)
+    assert source == "analytic" and wall > 0.0
+
+
+# ---------------------------------------------------------------------------
+# registry lifecycle: LRU eviction, instant-warm reactivation
+# ---------------------------------------------------------------------------
+def test_reactivation_is_bit_identical_and_compile_free(model):
+    registry = ModelRegistry(max_batch=8)
+    batcher = MicroBatcher(registry, max_batch=8, max_wait_ms=1.0).start()
+    try:
+        registry.deploy(model, tenant="alpha")
+        before = batcher.score(REC, tenant="alpha")
+        slots_before = registry.tenant_slots("alpha")
+
+        assert registry.evict_tenant("alpha") is True
+        assert registry.tenants_info()["alpha"]["resident"] is False
+        # sticky placement survives eviction — reactivation cannot shuffle
+        assert registry.tenant_slots("alpha") == slots_before
+
+        compile_cache.reset_cache_stats()
+        serve_aot.reset_warm_stats()
+        after = batcher.score(REC, tenant="alpha")  # first request reactivates
+        assert registry.tenants_info()["alpha"]["resident"] is True
+        assert registry.tenant_slots("alpha") == slots_before
+        # zero fresh XLA compiles: same model object -> memoized executables
+        assert compile_cache.cache_stats()["compiles"] == 0
+        warms = serve_aot.warm_stats()
+        assert warms.get("compile", 0) == 0 and warms.get("memo", 0) >= 1
+        # bit-identical scores through the round trip
+        assert before == after
+        snap = batcher.metrics.snapshot()
+        assert snap["tenant_evictions"] >= 1
+        assert snap["tenant_reactivations"] >= 1
+    finally:
+        batcher.stop()
+
+
+def test_lru_evicts_least_recently_used(model):
+    registry = ModelRegistry(max_batch=8)
+    try:
+        registry.deploy(model, tenant="a")
+        registry.deploy(model, tenant="b")
+        registry.touch_tenant("a")  # a is now more recent than b
+        import os
+        os.environ["TMOG_MAX_ACTIVE_TENANTS"] = "2"
+        try:
+            registry.deploy(model, tenant="c")  # over cap: evicts b (LRU)
+            info = registry.tenants_info()
+            assert info["b"]["resident"] is False
+            assert info["a"]["resident"] is True
+            assert info["c"]["resident"] is True
+        finally:
+            os.environ.pop("TMOG_MAX_ACTIVE_TENANTS", None)
+    finally:
+        for t in ("a", "b", "c"):
+            registry.evict_tenant(t, drain_timeout_s=5.0)
+
+
+def test_mid_request_eviction_never_drops_futures(model):
+    """Evicting a tenant with a burst in flight: every submitted future
+    resolves with a real score (drain + sticky reactivation), none error."""
+    registry = ModelRegistry(max_batch=8)
+    batcher = MicroBatcher(registry, max_batch=8, max_wait_ms=1.0,
+                           queue_size=512).start()
+    try:
+        registry.deploy(model, tenant="alpha")
+        futures = [batcher.submit(REC, tenant="alpha") for _ in range(64)]
+        evictor = threading.Thread(
+            target=lambda: registry.evict_tenant("alpha"))
+        evictor.start()
+        outs = [f.result(120).output for f in futures]
+        evictor.join(120)
+        assert len(outs) == 64
+        assert all(o == outs[0] for o in outs)
+        assert batcher.metrics.snapshot()["tenants"]["alpha"]["errors"] == 0
+    finally:
+        batcher.stop()
+
+
+def test_unknown_tenant_is_a_lookup_error(model):
+    registry = ModelRegistry(max_batch=8)
+    batcher = MicroBatcher(registry, max_batch=8, max_wait_ms=1.0).start()
+    try:
+        with pytest.raises(LookupError):
+            batcher.submit(REC, tenant="ghost").result(30)
+    finally:
+        batcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# admission isolation: one noisy tenant sheds alone
+# ---------------------------------------------------------------------------
+def test_noisy_tenant_sheds_without_touching_neighbours(model, monkeypatch):
+    monkeypatch.setenv("TMOG_TENANT_QUEUE_SIZE", "4")
+    registry = ModelRegistry(max_batch=8)
+    batcher = MicroBatcher(registry, max_batch=8, max_wait_ms=1.0,
+                           queue_size=1024)  # NOT started: nothing drains
+    try:
+        registry.deploy(model, tenant="noisy")
+        registry.deploy(model, tenant="quiet")
+        held = [batcher.submit(REC, tenant="noisy") for _ in range(4)]
+        with pytest.raises(ShedError):
+            batcher.submit(REC, tenant="noisy")  # over ITS budget
+        # the neighbour still has the whole global queue behind its budget
+        held.append(batcher.submit(REC, tenant="quiet"))
+        snap = batcher.metrics.snapshot()
+        assert snap["tenants"]["noisy"]["shed"] == 1
+        assert snap["tenants"]["quiet"]["shed"] == 0
+        batcher.start()  # drain: every admitted future must still resolve
+        for f in held:
+            assert f.result(120).output
+    finally:
+        batcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# cross-tenant hot-swap + heal
+# ---------------------------------------------------------------------------
+def test_tenant_hot_swap_never_gaps_neighbour(model, model_v2):
+    """While tenant a hot-swaps to v2, tenant b's traffic keeps resolving
+    with zero errors and zero evictions — the rolling swap is per-tenant."""
+    registry = ModelRegistry(max_batch=8)
+    batcher = MicroBatcher(registry, max_batch=8, max_wait_ms=1.0,
+                           queue_size=512).start()
+    try:
+        registry.deploy(model, tenant="a", version="a-v1")
+        registry.deploy(model, tenant="b", version="b-v1")
+        stop = threading.Event()
+        errors = []
+        served = [0]
+
+        def b_traffic():
+            while not stop.is_set():
+                try:
+                    batcher.score(REC, timeout_s=120, tenant="b")
+                    served[0] += 1
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+        th = threading.Thread(target=b_traffic)
+        th.start()
+        try:
+            time.sleep(0.05)  # let b's traffic begin
+            registry.deploy(model_v2, tenant="a", version="a-v2")
+        finally:
+            stop.set()
+            th.join(120)
+        assert not errors, errors[:3]
+        assert served[0] > 0
+        info = registry.tenants_info()
+        assert info["a"]["version"] == "a-v2"
+        assert info["b"]["resident"] is True and info["b"]["version"] == "b-v1"
+        snap = batcher.metrics.snapshot()
+        assert snap["tenants"]["b"]["errors"] == 0
+        assert snap["tenant_evictions"] == 0
+    finally:
+        batcher.stop()
+
+
+def test_rebuild_slot_heals_tenant_replicas(model):
+    registry = ModelRegistry(max_batch=8)
+    batcher = MicroBatcher(registry, max_batch=8, max_wait_ms=1.0).start()
+    try:
+        registry.deploy(model, tenant="alpha")
+        slot = registry.tenant_slots("alpha")[0]
+        old = registry.tenant_replica("alpha", slot)
+        assert old is not None
+        registry.rebuild_slot(slot)
+        new = registry.tenant_replica("alpha", slot)
+        assert new is not None and new is not old
+        assert batcher.score(REC, tenant="alpha")  # still serves
+    finally:
+        batcher.stop()
+
+
+def test_registry_info_surfaces_tenants(model):
+    registry = ModelRegistry(max_batch=8)
+    try:
+        registry.deploy(model, tenant="alpha")
+        info = registry.info()
+        assert "alpha" in info["tenants"]
+        assert info["tenants"]["alpha"]["resident"] is True
+        assert info["placement_source"] in ("analytic", "costmodel")
+        assert info["max_active_tenants"] == 0  # unbounded by default
+    finally:
+        registry.evict_tenant("alpha", drain_timeout_s=5.0)

@@ -90,6 +90,11 @@ def main(argv=None) -> int:
     p.add_argument("--replicas", type=int, default=None,
                    help="per-chip model replicas (default: "
                         "TMOG_SERVE_REPLICAS or one per device)")
+    p.add_argument("--tenants", type=int, default=0, metavar="N",
+                   help="multi-tenant mode: deploy the model as N named "
+                        "tenants sharing the plane; client threads "
+                        "round-robin tenants and the JSONL gains per-tenant "
+                        "QPS/p99 (0 = classic single-tenant probe)")
     p.add_argument("--compile-cache", default=None,
                    help="persistent AOT executable cache dir (sets "
                         "TMOG_COMPILE_CACHE for this run)")
@@ -142,7 +147,13 @@ def main(argv=None) -> int:
                          queue_size=args.queue_size)
     compile_cache.reset_cache_stats()
     t_warm = time.perf_counter()
-    registry.deploy(model)
+    if args.tenants > 0:
+        # same model object per tenant: first warm compiles, the rest warm
+        # from the in-process memo — the instant-warm activation path
+        for i in range(args.tenants):
+            registry.deploy(model, tenant=f"t{i:02d}")
+    else:
+        registry.deploy(model)
     warm_s = time.perf_counter() - t_warm
     warm_cache = compile_cache.cache_stats()
     # serve-path drift sketch: scored records fold into per-feature
@@ -183,9 +194,11 @@ def main(argv=None) -> int:
         args.drift_after if args.drift_after is not None
         else args.duration / 2.0)
 
-    def client():
+    def client(idx: int = 0):
         local_lat, local_shed, local_err, local_n = [], 0, 0, 0
         local_psent, local_p422, sent = 0, 0, 0
+        my_url = url if not args.tenants else \
+            f"{url}?tenant=t{idx % args.tenants:02d}"
         while time.monotonic() < stop_at:
             body = shifted_payload if args.drift_shift and \
                 time.monotonic() >= drift_at else payload
@@ -196,7 +209,7 @@ def main(argv=None) -> int:
             sent += 1
             t0 = time.perf_counter()
             try:
-                req = urllib.request.Request(url, data=body,
+                req = urllib.request.Request(my_url, data=body,
                                              headers={"Content-Type": "application/json"})
                 with urllib.request.urlopen(req, timeout=30) as resp:
                     resp.read()
@@ -249,8 +262,8 @@ def main(argv=None) -> int:
         chaos["circuit"] = brk.snapshot()
         chaos["supervisor_recoveries"] = sup.recoveries
 
-    threads = [threading.Thread(target=client, daemon=True)
-               for _ in range(args.concurrency)]
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(args.concurrency)]
     t0 = time.monotonic()
     if args.kill_replica is not None:
         if not 0 <= args.kill_replica < registry.n_replicas:
@@ -288,6 +301,16 @@ def main(argv=None) -> int:
                            "load_s", "saves", "save_errors")},
         "drift_shift": args.drift_shift,
         "drift": server_metrics["serve"].get("drift", {}),
+        "tenants": args.tenants,
+        "tenant_stats": {
+            t: {"responses": st.get("responses", 0),
+                "shed": st.get("shed", 0),
+                "qps": (round(st.get("responses", 0) / elapsed, 1)
+                        if elapsed else 0.0),
+                "p99_ms": (st.get("request_latency") or {}).get("p99_ms",
+                                                                0.0)}
+            for t, st in (server_metrics["serve"].get("tenants")
+                          or {}).items()},
         "continual": server_metrics.get("continual", {}),
         "server_metrics": server_metrics["serve"],
     }

@@ -25,6 +25,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="Micro-batched online scoring server for a saved model")
     p.add_argument("model", help="saved model directory (model.save output)")
     p.add_argument("--version", default=None, help="version label (default v1)")
+    p.add_argument("--tenant", default=None,
+                   help="deploy as this named tenant on the shared plane "
+                        "(score with ?tenant=NAME; default: the single "
+                        "anonymous tenant)")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8123)
     p.add_argument("--max-batch", type=int, default=64,
@@ -57,8 +61,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                          max_wait_ms=args.max_wait_ms,
                          queue_size=args.queue_size)
     print(f"Loading model from {args.model} ...", file=sys.stderr)
-    entry = registry.deploy(load_model(args.model), version=args.version)
-    print(f"Deployed {entry.version} (warmed buckets: {entry.buckets}, "
+    if args.tenant:
+        entry = registry.deploy(load_model(args.model), version=args.version,
+                                tenant=args.tenant)
+    else:
+        entry = registry.deploy(load_model(args.model), version=args.version)
+    who = f" (tenant {args.tenant})" if args.tenant else ""
+    print(f"Deployed {entry.version}{who} (warmed buckets: {entry.buckets}, "
           f"replicas: {len(entry.replicas)})", file=sys.stderr)
     server.start()
     print(f"Serving at {server.url}/score (metrics: {server.url}/metrics)",

@@ -36,7 +36,13 @@ __all__ = [
 #: linear solvers share one seconds-per-unit scale)
 FAMILIES = ("linear", "mlp", "forest", "gbt")
 _KIND_FAMILY = {"fista": "linear", "newton": "linear", "svc": "linear",
-                "mlp": "mlp", "forest": "forest", "gbt": "gbt"}
+                "mlp": "mlp", "forest": "forest", "gbt": "gbt",
+                # serving-batch units (serve/placement.py) are their own
+                # family: no fitted per-family ratio exists (FAMILIES is the
+                # sweep training contract), so unit_scale falls through to
+                # the artifact's global t0 — the fleet-calibrated
+                # seconds-per-unit — rather than borrowing a solver's ratio
+                "serve": "serve"}
 
 #: fixed feature order — the regressor's input contract.  Append-only:
 #: vectors from old artifacts stay aligned by name, never by position.

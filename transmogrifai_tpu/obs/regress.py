@@ -68,6 +68,14 @@ POLICIES: Dict[str, Dict[str, int]] = {
         "quarantine_rate": -1, "data_fault_fraction": -1,
     },
     "continual_warm_retrain_speedup": {"value": +1},
+    # multi-tenant serving (PR 20): one plane hosts N named tenants —
+    # aggregate throughput must hold while the worst tenant's tail stays
+    # bounded; reactivation must stay on the compile cache's warm path
+    # (0 fresh XLA compiles) and a tenant hot-swap must never gap a
+    # neighbour's capacity
+    "serve_multi_tenant_qps": {
+        "value": +1, "reactivation_compiles": -1, "capacity_gap_errors": -1,
+    },
     # ASHA search (PR 16): 500+-candidate rung-scheduled search wall over
     # the exhaustive 28-grid wall — the whole point is fitting ~18x the
     # candidates within ~2x the wall, so the ratio must not creep up

@@ -188,6 +188,23 @@ def serve_devices(n: Optional[int] = None) -> List[jax.Device]:
     return [devs[i % len(devs)] for i in range(n)]
 
 
+def serve_chip_index(devices: Sequence[jax.Device]) -> List[int]:
+    """Map each serving slot's device to a stable physical-chip ordinal, so
+    tenant placement can account chip budgets even when ``serve_devices``
+    oversubscribes (several slots cycling one chip share one ordinal).
+    Ordinals follow first-appearance order over the slot list — a pure
+    function of its input, like everything placement consumes."""
+    order: dict = {}
+    out: List[int] = []
+    for d in devices:
+        key = getattr(d, "id", None)
+        key = key if key is not None else id(d)
+        if key not in order:
+            order[key] = len(order)
+        out.append(order[key])
+    return out
+
+
 def data_mesh() -> Optional[Mesh]:
     """All local devices on the ``data`` axis — for row-sharded statistics
     passes (SanityChecker / RFF moments + Gram, SURVEY §2.7 axis 1).

@@ -18,7 +18,11 @@ Layering::
                       least-outstanding-work replica routing
     contract.py       per-model input contracts: admission + batch
                       validation, poison rows quarantined per-row (422)
-    registry.py       versioned models, N replica slots, rolling hot-swap
+    registry.py       versioned models, N replica slots, rolling hot-swap;
+                      N named TENANTS share the fleet behind an LRU
+                      activation tier (``TMOG_MAX_ACTIVE_TENANTS``)
+    placement.py      cost-model-priced bin-packing of tenants onto chips
+                      (predicted per-batch wall x observed per-tenant QPS)
     supervisor.py     self-healing: per-slot circuit breakers + the probe/
                       rebuild daemon (degraded host path when all slots down)
     aot.py            per-(bucket, device) AOT score programs over the
@@ -34,16 +38,20 @@ in-process embedding (tests, notebooks).
 from ..resilience.quarantine import DataFault
 from .batcher import MicroBatcher, Scored, ShedError
 from .contract import InputContract, validation_enabled
-from .metrics import LatencyHistogram, ServeMetrics, prometheus_replica_text
-from .registry import (ModelRegistry, Replica, ServingModel, bucket_for,
-                       shape_buckets)
+from .metrics import (LatencyHistogram, ServeMetrics,
+                      prometheus_replica_text, prometheus_tenant_text)
+from .placement import PlacementPlan, TenantLoad
+from .placement import plan as placement_plan
+from .registry import (DEFAULT_TENANT, ModelRegistry, Replica, ServingModel,
+                       TenantState, bucket_for, shape_buckets)
 from .server import ModelServer
 from .supervisor import ReplicaSupervisor
 
 __all__ = [
-    "DataFault", "InputContract", "LatencyHistogram", "MicroBatcher",
-    "ModelRegistry", "ModelServer", "Replica", "ReplicaSupervisor",
-    "Scored", "ServeMetrics", "ServingModel", "ShedError",
-    "bucket_for", "prometheus_replica_text", "shape_buckets",
-    "validation_enabled",
+    "DEFAULT_TENANT", "DataFault", "InputContract", "LatencyHistogram",
+    "MicroBatcher", "ModelRegistry", "ModelServer", "PlacementPlan",
+    "Replica", "ReplicaSupervisor", "Scored", "ServeMetrics",
+    "ServingModel", "ShedError", "TenantLoad", "TenantState",
+    "bucket_for", "placement_plan", "prometheus_replica_text",
+    "prometheus_tenant_text", "shape_buckets", "validation_enabled",
 ]

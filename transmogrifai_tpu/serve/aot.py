@@ -84,6 +84,36 @@ _HLO_LOCK = threading.Lock()
 #: identical DAG — building it once keeps N-replica warmup from paying N
 #: GIL-bound planning passes.  Values pin the model against id reuse.
 _PLAN_MEMO: "OrderedDict[tuple, tuple]" = OrderedDict()
+
+#: prediction-head executables, cross-instance: (id(stage), shape, device)
+#: -> (compiled, stage).  The stage object in the value pins the id so a
+#: reactivated tenant's fresh AotScorer re-binds the SAME compiled head
+#: instead of re-lowering it (see _head_call).
+_HEAD_MEMO: "OrderedDict[tuple, tuple]" = OrderedDict()
+
+#: running tally of warm sources — how many bucket warms resolved from the
+#: in-process memo, the disk cache, or a fresh XLA compile.  The multi-tenant
+#: bench and CI assert instant-warm REACTIVATION through this: an evicted
+#: tenant coming back must add only "memo"/"hit" counts, never "compile".
+_WARM_STATS = {"memo": 0, "hit": 0, "compile": 0}
+
+
+def _note_warm(source: str) -> str:
+    with _MEMO_LOCK:
+        _WARM_STATS[source] = _WARM_STATS.get(source, 0) + 1
+    return source
+
+
+def warm_stats() -> dict:
+    """Copy of the cumulative {source: count} warm tally."""
+    with _MEMO_LOCK:
+        return dict(_WARM_STATS)
+
+
+def reset_warm_stats() -> None:
+    with _MEMO_LOCK:
+        for k in list(_WARM_STATS):
+            _WARM_STATS[k] = 0
 _PLAN_LOCK = threading.Lock()
 
 
@@ -160,7 +190,7 @@ class BucketScorer:
         """Ensure the executable for one bucket exists; returns its source
         ("memo" | "hit" | "compile")."""
         if bucket in self._exec:
-            return "memo"
+            return _note_warm("memo")
         memo_key = (self._plan.key, bucket, str(self.device))
         with _MEMO_LOCK:
             hit = _MEMO.get(memo_key)
@@ -168,7 +198,7 @@ class BucketScorer:
                 _MEMO.move_to_end(memo_key)
         if hit is not None:
             self._exec[bucket] = hit[0]
-            return "memo"
+            return _note_warm("memo")
 
         def lower():
             return self._jitted.lower(self._lowering_args(bucket))
@@ -193,7 +223,7 @@ class BucketScorer:
             while len(_MEMO) > _MEMO_MAX:
                 _MEMO.popitem(last=False)
         self._exec[bucket] = hit[0]
-        return source
+        return _note_warm(source)
 
     def warm(self, score: bool = True) -> None:
         """Compile/load every bucket, then ONE end-to-end null score — the
@@ -300,27 +330,45 @@ class BucketScorer:
         V = np.asarray(vec.values, np.float32)
         state = self._heads.get(t.uid)
         if state is None or state[1] != V.shape:
-            try:
-                program = head_program(t)
-                if program is None:  # tree families: no traceable program
+            # cross-instance memo first: an LRU-evicted tenant's reactivation
+            # builds FRESH scorers for the same model object, and its head
+            # executables must come back without an XLA compile just like the
+            # fused bucket programs do.  id() keys are pinned by holding the
+            # stage in the value (same discipline as _PLAN_MEMO).
+            head_key = (id(t), V.shape, str(self.device))
+            with _MEMO_LOCK:
+                ent = _HEAD_MEMO.get(head_key)
+                if ent is not None:
+                    _HEAD_MEMO.move_to_end(head_key)
+            if ent is not None and ent[1] is t:
+                state = (ent[0], V.shape)
+                self._heads[t.uid] = state
+            else:
+                try:
+                    program = head_program(t)
+                    if program is None:  # tree families: no traceable program
+                        self._heads[t.uid] = False
+                        return None
+                    lowered = jax.jit(program).lower(
+                        jax.device_put(jnp.zeros(V.shape, jnp.float32),
+                                       self.device))
+                    compiled, _ = compile_cache.load_or_compile(
+                        f"serve.head.{cls.__name__}.b{V.shape[0]}", lowered,
+                        self.device, hlo_text=lowered.as_text())
+                    state = (compiled, V.shape)
+                except NotImplementedError:
                     self._heads[t.uid] = False
                     return None
-                lowered = jax.jit(program).lower(
-                    jax.device_put(jnp.zeros(V.shape, jnp.float32),
-                                   self.device))
-                compiled, _ = compile_cache.load_or_compile(
-                    f"serve.head.{cls.__name__}.b{V.shape[0]}", lowered,
-                    self.device, hlo_text=lowered.as_text())
-                state = (compiled, V.shape)
-            except NotImplementedError:
-                self._heads[t.uid] = False
-                return None
-            except Exception as e:  # noqa: BLE001 — head AOT must not break serving
-                record_fallback("serve", "head_aot_failed",
-                                stage=type(t).__name__, error=str(e))
-                self._heads[t.uid] = False
-                return None
-            self._heads[t.uid] = state
+                except Exception as e:  # noqa: BLE001 — head AOT must not break serving
+                    record_fallback("serve", "head_aot_failed",
+                                    stage=type(t).__name__, error=str(e))
+                    self._heads[t.uid] = False
+                    return None
+                self._heads[t.uid] = state
+                with _MEMO_LOCK:
+                    _HEAD_MEMO[head_key] = (state[0], t)
+                    while len(_HEAD_MEMO) > _MEMO_MAX:
+                        _HEAD_MEMO.popitem(last=False)
         pred, raw, prob = state[0](jax.device_put(V, self.device))
         col = PredictionColumn(
             T.Prediction, np.asarray(pred, np.float64),

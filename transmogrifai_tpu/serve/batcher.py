@@ -24,6 +24,14 @@ slot still holds that replica — if a swap won the race, it backs out and
 refetches.  Once the in-flight guard is confirmed, the registry's per-slot
 drain cannot complete until this batch resolves, so a returned ``deploy``
 guarantees no stale-version response for post-swap submissions.
+
+Multi-tenant: ``submit(record, tenant=...)`` admits against BOTH the global
+bound and the tenant's own budget (``TMOG_TENANT_QUEUE_SIZE``) so a noisy
+tenant sheds alone; the collector groups each window by tenant and routes
+every group to that tenant's PLACED slots (``serve/placement.py``),
+reactivating LRU-evicted tenants through the compile cache's warm path on
+the way.  Responses feed per-tenant latency histograms and the
+``TMOG_TENANT_SLO_MS`` violation counter.
 """
 from __future__ import annotations
 
@@ -39,9 +47,10 @@ from ..resilience import inject as _inject
 from ..resilience import quarantine as _quar
 from ..resilience import retry as _retry
 from ..resilience.quarantine import DataFault
+from ..utils import env as _env
 from . import contract as _contract
 from .metrics import ServeMetrics
-from .registry import ModelRegistry, bucket_for
+from .registry import DEFAULT_TENANT, ModelRegistry, bucket_for
 from .supervisor import ReplicaSupervisor
 
 _rscope = obs_registry.scope("resilience")
@@ -94,6 +103,7 @@ class _Pending(NamedTuple):
     record: Dict[str, Any]
     future: Future
     enqueued_at: float
+    tenant: str = DEFAULT_TENANT
 
 
 class MicroBatcher:
@@ -120,6 +130,16 @@ class MicroBatcher:
         self._capacity = int(queue_size)
         self._admit_lock = threading.Lock()
         self._outstanding = 0
+        # per-tenant admission budget: a NAMED tenant may hold at most this
+        # many outstanding requests, so one noisy tenant saturating its own
+        # budget sheds ITS traffic and nobody else's; the default tenant
+        # keeps the full global bound (single-tenant behaviour unchanged)
+        self._tenant_capacity = max(1, _env.env_int(
+            "TMOG_TENANT_QUEUE_SIZE", max(1, int(queue_size) // 4)))
+        self._tenant_outstanding: Dict[str, int] = {}
+        # per-tenant latency SLO (ms): responses over it count as
+        # slo_violations in that tenant's metrics; 0 disables
+        self._slo_ms = _env.env_float("TMOG_TENANT_SLO_MS", 0.0)
         self._queue: "queue.Queue[_Pending]" = queue.Queue()
         self.metrics.add_gauge("queue_depth", self._queue.qsize)
         self.metrics.add_gauge("outstanding", lambda: self._outstanding)
@@ -176,65 +196,93 @@ class MicroBatcher:
                 except queue.Empty:
                     break
                 if item is not None:
-                    leftovers.extend(item)
+                    leftovers.extend(item[1])  # (tenant, items) tuples
         for pending in leftovers:
             pending.future.set_exception(RuntimeError("server shutting down"))
 
     # ---- admission ---------------------------------------------------------
-    def submit(self, record: Dict[str, Any]) -> "Future[Scored]":
-        """Enqueue one record; sheds with ``ShedError`` when the queue is
-        full, raises :class:`DataFault` when the record violates the active
-        model's input contract (the admission half of validation — cheap
-        per-record shape checks; the vectorized finiteness sweep runs on
-        the assembled batch in ``_dispatch``)."""
+    def submit(self, record: Dict[str, Any],
+               tenant: str = DEFAULT_TENANT) -> "Future[Scored]":
+        """Enqueue one record for ``tenant``; sheds with ``ShedError`` when
+        the global queue is full OR the tenant's own admission budget is
+        exhausted (the noisy tenant sheds alone), raises :class:`DataFault`
+        when the record violates the tenant's input contract (the admission
+        half of validation — cheap per-record shape checks; the vectorized
+        finiteness sweep runs on the assembled batch in ``_dispatch``)."""
         self.metrics.inc("requests")
-        contract = self._active_contract()
+        self.metrics.inc_tenant("requests", tenant)
+        self.registry.touch_tenant(tenant)
+        contract = self._active_contract(tenant)
         if contract is not None:
             try:
                 contract.check_record(record)
             except DataFault as fault:
-                self._note_data_fault(record, fault)
+                self._note_data_fault(record, fault, tenant=tenant)
                 raise
         with self._admit_lock:
             if self._outstanding >= self._capacity:
                 self.metrics.inc("shed")
+                self.metrics.inc_tenant("shed", tenant)
                 raise ShedError(f"admission queue full ({self._capacity} "
                                 f"outstanding); retry later")
+            if tenant != DEFAULT_TENANT:
+                held = self._tenant_outstanding.get(tenant, 0)
+                if held >= self._tenant_capacity:
+                    self.metrics.inc("shed")
+                    self.metrics.inc_tenant("shed", tenant)
+                    raise ShedError(
+                        f"tenant {tenant!r} admission budget full "
+                        f"({self._tenant_capacity} outstanding); retry later")
+                self._tenant_outstanding[tenant] = held + 1
             self._outstanding += 1
         future: "Future[Scored]" = Future()
-        future.add_done_callback(self._release_admission)
-        self._queue.put(_Pending(record, future, time.monotonic()))
+        future.add_done_callback(
+            lambda _f, t=tenant: self._release_admission(t))
+        self._queue.put(_Pending(record, future, time.monotonic(), tenant))
         return future
 
-    def _release_admission(self, _future) -> None:
+    def _release_admission(self, tenant: str) -> None:
         with self._admit_lock:
             self._outstanding -= 1
+            if tenant != DEFAULT_TENANT:
+                held = self._tenant_outstanding.get(tenant, 1) - 1
+                if held <= 0:
+                    self._tenant_outstanding.pop(tenant, None)
+                else:
+                    self._tenant_outstanding[tenant] = held
 
-    def _active_contract(self):
-        """The active model's InputContract, or None when validation is
-        off, no model is deployed, or the model predates contracts."""
+    def _active_contract(self, tenant: str = DEFAULT_TENANT):
+        """The tenant's active model's InputContract, or None when validation
+        is off, no model is deployed (or the tenant is cold — dispatch
+        re-checks after reactivation), or the model predates contracts."""
         if not _contract.validation_enabled():
             return None
         try:
-            return getattr(self.registry.active(), "contract", None)
+            if tenant == DEFAULT_TENANT:
+                return getattr(self.registry.active(), "contract", None)
+            return getattr(self.registry.tenant_active(tenant), "contract",
+                           None)
         except Exception:
             return None
 
-    def _note_data_fault(self, record, fault: DataFault) -> None:
+    def _note_data_fault(self, record, fault: DataFault,
+                         tenant: str = DEFAULT_TENANT) -> None:
         """Count + dead-letter one rejected record.  Deliberately does NOT
         touch ``errors``, the breaker, the supervisor, or the SLO burn —
         a poison record is the client's fault, not the replica's."""
         self.metrics.inc("data_faults")
         self.metrics.inc("quarantined")
+        self.metrics.inc_tenant("data_faults", tenant)
         _rscope.inc("data_faults")
         _quar.store().put("serve", fault.reason, index=fault.index,
                           field=fault.field, record=record,
                           detail=fault.detail)
 
     def score(self, record: Dict[str, Any],
-              timeout_s: Optional[float] = 30.0) -> Dict[str, Any]:
+              timeout_s: Optional[float] = 30.0,
+              tenant: str = DEFAULT_TENANT) -> Dict[str, Any]:
         """Submit + wait: the blocking single-record convenience API."""
-        return self.submit(record).result(timeout_s).output
+        return self.submit(record, tenant=tenant).result(timeout_s).output
 
     # ---- collect + route ---------------------------------------------------
     def _loop(self) -> None:
@@ -253,26 +301,53 @@ class MicroBatcher:
                     batch.append(self._queue.get(timeout=remaining))
                 except queue.Empty:
                     break
-            self._slot_queues[self._pick_slot()].put(batch)
+            # one collected window may interleave tenants; each tenant's
+            # rows pad + score against ITS model, routed to ITS placed slots
+            # (grouping preserves per-tenant submission order)
+            groups: Dict[str, List[_Pending]] = {}
+            for p in batch:
+                groups.setdefault(p.tenant, []).append(p)
+            for tenant, items in groups.items():
+                self._route(tenant, items)
 
-    def _pick_slot(self) -> int:
-        """Least-outstanding-work routing: queued batches + in-flight work.
-        Slots with an open circuit are routed AROUND (survivors absorb the
-        load); a slot due its half-open trial counts as routable so real
-        traffic can re-admit it.  With every circuit open the least-loaded
-        slot still wins — dispatch then degrades those batches to the host
-        row path rather than failing them."""
-        slots = self.registry.slots()
+    def _route(self, tenant: str, items: List[_Pending]) -> None:
+        """Hand one tenant's collected rows to a slot worker.  A cold
+        (LRU-evicted) tenant reactivates HERE, on the collector thread —
+        the instant-warm path: same model object, memoized executables, zero
+        XLA compiles — so the submitting clients only ever see latency,
+        never an error, from eviction."""
+        if tenant != DEFAULT_TENANT:
+            try:
+                self.registry.ensure_active(tenant)
+            except Exception as e:  # noqa: BLE001 — surface on the futures
+                for p in items:
+                    p.future.set_exception(e)
+                self.metrics.inc("errors", len(items))
+                self.metrics.inc_tenant("errors", tenant, len(items))
+                return
+        self._slot_queues[self._pick_slot(tenant)].put((tenant, items))
+
+    def _pick_slot(self, tenant: str = DEFAULT_TENANT) -> int:
+        """Least-outstanding-work routing among the TENANT'S placed slots:
+        queued batches + in-flight work across every tenant sharing the
+        slot.  Slots with an open circuit are routed AROUND (survivors
+        absorb the load); a slot due its half-open trial counts as routable
+        so real traffic can re-admit it.  With every circuit open the
+        least-loaded slot still wins — dispatch then degrades those batches
+        to the host row path rather than failing them."""
+        candidates = self.registry.tenant_slots(tenant)
+        if not candidates:
+            candidates = list(range(len(self._slot_queues)))
         sup = self.supervisor
-        all_down = not sup.any_routable()
-        best, best_load = 0, None
-        for i, q in enumerate(self._slot_queues):
+        all_down = not any(sup.routable(i) for i in candidates)
+        best, best_load = candidates[0], None
+        for i in candidates:
+            if i >= len(self._slot_queues):
+                continue
             if not all_down and not sup.routable(i):
                 continue
-            load = q.qsize()
-            rep = slots[i] if i < len(slots) else None
-            if rep is not None:
-                load += rep.inflight
+            load = self._slot_queues[i].qsize()
+            load += self.registry.slot_inflight(i)
             if best_load is None or load < best_load:
                 best, best_load = i, load
         return best
@@ -281,35 +356,53 @@ class MicroBatcher:
     def _worker(self, slot: int) -> None:
         q = self._slot_queues[slot]
         while True:
-            batch = q.get()
-            if batch is None:  # stop() sentinel
+            item = q.get()
+            if item is None:  # stop() sentinel
                 break
-            self._dispatch(slot, batch)
+            tenant, batch = item
+            self._dispatch(slot, batch, tenant)
 
-    def _acquire_replica(self, slot: int):
-        """Enter the slot's current replica's in-flight guard, swap-safely."""
+    def _acquire_replica(self, slot: int, tenant: str = DEFAULT_TENANT):
+        """Enter the tenant's replica's in-flight guard on ``slot``,
+        swap-safely (the re-check defeats the rolling-swap race for default
+        and named tenants alike)."""
         while True:
-            rep = self.registry.replica(slot)
+            rep = self.registry.tenant_replica(tenant, slot)
             if rep is None:
                 return None, None
             ctx = rep.in_flight()
             ctx.__enter__()
-            if self.registry.replica(slot) is rep:
+            if self.registry.tenant_replica(tenant, slot) is rep:
                 return rep, ctx
             # a rolling swap replaced this slot between fetch and guard
             ctx.__exit__(None, None, None)
 
-    def _dispatch(self, slot: int, batch: List[_Pending]) -> None:
-        rep, ctx = self._acquire_replica(slot)
+    def _dispatch(self, slot: int, batch: List[_Pending],
+                  tenant: str = DEFAULT_TENANT) -> None:
+        rep, ctx = self._acquire_replica(slot, tenant)
+        if rep is None and tenant != DEFAULT_TENANT:
+            # the tenant was LRU-evicted between routing and dispatch: the
+            # queued futures must never drop — reactivate through the warm
+            # path and re-route to the (sticky) placed slots
+            try:
+                self.registry.ensure_active(tenant)
+                new_slot = self._pick_slot(tenant)
+                rep, ctx = self._acquire_replica(new_slot, tenant)
+                slot = new_slot if rep is not None else slot
+            except Exception:  # noqa: BLE001 — fall through to the error path
+                rep, ctx = None, None
         if rep is None:
             try:
-                self.registry.active()  # raises with the useful message
+                self.registry.ensure_active(tenant)  # raises usefully
                 err: Exception = RuntimeError(f"replica slot {slot} is empty")
             except LookupError as e:
+                err = e
+            except Exception as e:  # noqa: BLE001 — reactivation failure
                 err = e
             for p in batch:
                 p.future.set_exception(err)
             self.metrics.inc("errors", len(batch))
+            self.metrics.inc_tenant("errors", tenant, len(batch))
             return
         entry = rep.owner
         sup = self.supervisor
@@ -328,14 +421,15 @@ class MicroBatcher:
                 if fault is None:
                     clean.append(p)
                 else:
-                    self._note_data_fault(p.record, fault)
+                    self._note_data_fault(p.record, fault, tenant=tenant)
                     p.future.set_exception(fault)
                     quarantined += 1
         else:
             clean = batch
         if not clean:
             ctx.__exit__(None, None, None)
-            self.metrics.observe_records([], (), quarantined=quarantined)
+            self.metrics.observe_records([], (), quarantined=quarantined,
+                                         tenant=tenant)
             return
         n = len(clean)
         bucket = bucket_for(n, entry.buckets)
@@ -344,7 +438,8 @@ class MicroBatcher:
         t0 = time.monotonic()
         try:
             with trace.span("serve.batch", records=n, bucket=bucket,
-                            version=entry.version, replica=rep.id):
+                            version=entry.version, replica=rep.id,
+                            tenant=tenant):
                 if not brk.available and not brk.try_trial():
                     # circuit open and no trial due: don't touch the dead
                     # replica — degraded mode, host numpy row path (reduced
@@ -383,18 +478,20 @@ class MicroBatcher:
                    if isinstance(out, DataFault)}
         self.metrics.observe_records(
             [p.record for i, p in enumerate(clean) if i not in faulted],
-            outputs, quarantined=quarantined + len(faulted))
+            outputs, quarantined=quarantined + len(faulted), tenant=tenant)
         done = time.monotonic()
         for i, (p, out) in enumerate(zip(clean, outputs)):
             if isinstance(out, DataFault):
-                self._note_data_fault(p.record, out)
+                self._note_data_fault(p.record, out, tenant=tenant)
                 p.future.set_exception(out)
             elif isinstance(out, Exception):
                 self.metrics.inc("errors")
+                self.metrics.inc_tenant("errors", tenant)
                 p.future.set_exception(out)
             else:
                 self.metrics.observe_request((done - p.enqueued_at) * 1000.0,
-                                             replica=rep.slot)
+                                             replica=rep.slot, tenant=tenant,
+                                             slo_ms=self._slo_ms)
                 # queue wait + batch + resolution, timeline-aligned with the
                 # serve.batch span (same monotonic origin)
                 trace.complete("serve.request", p.enqueued_at, done,

@@ -69,15 +69,26 @@ class ServeMetrics:
         self.padded_rows = 0
         self.bucket_counts: Dict[int, int] = {}
         self.swaps = 0
+        # Multi-tenant fleet lifecycle (registry LRU tier): activations
+        # count every deploy/reactivation of a named tenant, reactivations
+        # the cold->warm subset, evictions the warm->cold demotions.
+        self.tenant_activations = 0
+        self.tenant_reactivations = 0
+        self.tenant_evictions = 0
         self.request_latency = LatencyHistogram()
         self.batch_latency = LatencyHistogram()
         #: per-replica-slot breakdowns (merged totals above stay the
         #: backward-compatible view; these add the labelled one)
         self.replica_stats: Dict[int, Dict[str, Any]] = {}
+        #: per-tenant breakdowns + SLO accounting (named tenants only; the
+        #: default tenant stays in the merged totals exactly as before)
+        self.tenant_stats: Dict[str, Dict[str, Any]] = {}
         #: gauges polled at snapshot time (e.g. live queue depth)
         self._gauges: Dict[str, Callable[[], Any]] = {}
         #: optional continual-learning drift sketch fed by the batch path
         self._sketch = None
+        #: per-tenant drift sketches (continual/), keyed by tenant name
+        self._tenant_sketches: Dict[str, Any] = {}
         _instances.add(self)
 
     def _replica(self, slot: int, device: str = "") -> Dict[str, Any]:
@@ -93,12 +104,29 @@ class ServeMetrics:
             st["device"] = device
         return st
 
+    def _tenant(self, tenant: str) -> Dict[str, Any]:
+        """Per-tenant accumulator (callers hold ``self._lock``)."""
+        st = self.tenant_stats.get(tenant)
+        if st is None:
+            st = {"requests": 0, "responses": 0, "shed": 0, "errors": 0,
+                  "data_faults": 0, "slo_violations": 0,
+                  "request_latency": LatencyHistogram()}
+            self.tenant_stats[tenant] = st
+        return st
+
     # ---- mutators ----------------------------------------------------------
     def inc(self, name: str, by: int = 1) -> None:
         with self._lock:
             setattr(self, name, getattr(self, name) + by)
 
-    def observe_request(self, ms: float, replica: int = None) -> None:
+    def inc_tenant(self, name: str, tenant: str, by: int = 1) -> None:
+        """Bump one per-tenant counter (requests/shed/errors/data_faults)."""
+        with self._lock:
+            st = self._tenant(tenant)
+            st[name] = st.get(name, 0) + by
+
+    def observe_request(self, ms: float, replica: int = None,
+                        tenant: str = None, slo_ms: float = 0.0) -> None:
         with self._lock:
             self.responses += 1
             self.request_latency.record(ms)
@@ -106,6 +134,12 @@ class ServeMetrics:
                 st = self._replica(replica)
                 st["responses"] += 1
                 st["request_latency"].record(ms)
+            if tenant is not None:
+                ts = self._tenant(tenant)
+                ts["responses"] += 1
+                ts["request_latency"].record(ms)
+                if slo_ms > 0 and ms > slo_ms:
+                    ts["slo_violations"] += 1
 
     def observe_batch(self, ms: float, n_records: int, bucket: int,
                       replica: int = None, device: str = "") -> None:
@@ -126,34 +160,49 @@ class ServeMetrics:
         with self._lock:
             self._gauges[name] = fn
 
-    def attach_sketch(self, sketch) -> None:
+    def attach_sketch(self, sketch, tenant: str = None) -> None:
         """Hook a :class:`~transmogrifai_tpu.continual.drift.ServeSketch`
-        into the batch path; its per-feature drift scores join snapshots."""
+        into the batch path; its per-feature drift scores join snapshots.
+        With ``tenant`` the sketch tracks that tenant's traffic only, so
+        each tenant's drift is judged against its OWN training baselines."""
         with self._lock:
-            self._sketch = sketch
+            if tenant is None:
+                self._sketch = sketch
+            else:
+                self._tenant_sketches[tenant] = sketch
 
-    def observe_records(self, records, outputs=(), quarantined: int = 0) -> None:
+    def tenant_sketch(self, tenant: str):
+        with self._lock:
+            return self._tenant_sketches.get(tenant)
+
+    def observe_records(self, records, outputs=(), quarantined: int = 0,
+                        tenant: str = None) -> None:
         """Fold scored records (+ outputs, for the prediction sketch) into
-        the attached drift sketch.  ``records`` must already EXCLUDE
+        the attached drift sketch — the global one and, when ``tenant`` is
+        given, that tenant's own.  ``records`` must already EXCLUDE
         quarantined rows (their garbage would poison the baselines
         comparison); ``quarantined`` feeds the ``__quarantined__``
         pseudo-feature so a quarantine-rate spike registers as drift.
         Never raises — drift accounting must not take down the serving
         path."""
         with self._lock:
-            sketch = self._sketch
-        if sketch is None:
-            return
-        try:
-            sketch.observe(records, outputs, quarantined=quarantined)
-        except TypeError:
-            # an older/foreign sketch without the quarantined parameter
+            sketches = [self._sketch]
+            if tenant is not None:
+                sketches.append(self._tenant_sketches.get(tenant))
+        for sketch in sketches:
+            if sketch is None:
+                continue
             try:
-                sketch.observe(records, outputs)
+                sketch.observe(records, outputs, quarantined=quarantined)
+            except TypeError:
+                # an older/foreign sketch without the quarantined parameter
+                try:
+                    sketch.observe(records, outputs)
+                except Exception:
+                    obs_registry.record_fallback("serve",
+                                                 "drift_sketch_failed")
             except Exception:
                 obs_registry.record_fallback("serve", "drift_sketch_failed")
-        except Exception:
-            obs_registry.record_fallback("serve", "drift_sketch_failed")
 
     # ---- export ------------------------------------------------------------
     def _merge_into(self, acc: Dict[str, Any]) -> None:
@@ -165,7 +214,9 @@ class ServeMetrics:
                       "fallback_records", "fallback_batches",
                       "degraded_batches", "replica_failures",
                       "replica_rebuilds", "batches",
-                      "occupancy_sum", "padded_rows", "swaps"):
+                      "occupancy_sum", "padded_rows", "swaps",
+                      "tenant_activations", "tenant_reactivations",
+                      "tenant_evictions"):
                 acc[k] += getattr(self, k)
             for b, c in self.bucket_counts.items():
                 acc["bucket_counts"][b] = acc["bucket_counts"].get(b, 0) + c
@@ -181,6 +232,15 @@ class ServeMetrics:
                     dst[k] += st[k]
                 dst["request_latency"].merge(st["request_latency"])
                 dst["batch_latency"].merge(st["batch_latency"])
+            for tenant, st in self.tenant_stats.items():
+                dst = acc["tenants"].setdefault(tenant, {
+                    "requests": 0, "responses": 0, "shed": 0, "errors": 0,
+                    "data_faults": 0, "slo_violations": 0,
+                    "request_latency": LatencyHistogram()})
+                for k in ("requests", "responses", "shed", "errors",
+                          "data_faults", "slo_violations"):
+                    dst[k] += st[k]
+                dst["request_latency"].merge(st["request_latency"])
 
     def slo_sample(self) -> Dict[str, Any]:
         """The cumulative counters the SLO monitor differences at its
@@ -209,6 +269,9 @@ class ServeMetrics:
                 "replica_rebuilds": self.replica_rebuilds,
                 "batches": self.batches,
                 "swaps": self.swaps,
+                "tenant_activations": self.tenant_activations,
+                "tenant_reactivations": self.tenant_reactivations,
+                "tenant_evictions": self.tenant_evictions,
                 "batch_occupancy_mean": (self.occupancy_sum / self.batches
                                          if self.batches else 0.0),
                 "padded_rows": self.padded_rows,
@@ -226,9 +289,23 @@ class ServeMetrics:
                         "request_latency": st["request_latency"].to_json(),
                         "batch_latency": st["batch_latency"].to_json(),
                     } for slot, st in sorted(self.replica_stats.items())},
+                "tenants": {
+                    tenant: {
+                        **{k: st[k] for k in (
+                            "requests", "responses", "shed", "errors",
+                            "data_faults", "slo_violations")},
+                        "request_latency": st["request_latency"].to_json(),
+                    } for tenant, st in sorted(self.tenant_stats.items())},
             }
             gauges = dict(self._gauges)
             sketch = self._sketch
+            tenant_sketches = dict(self._tenant_sketches)
+        for tenant, tsk in tenant_sketches.items():
+            if tenant in out["tenants"]:
+                try:
+                    out["tenants"][tenant]["drift"] = tsk.scores()
+                except Exception:
+                    out["tenants"][tenant]["drift"] = {}
         for name, fn in gauges.items():
             try:
                 out[name] = fn()
@@ -252,11 +329,14 @@ def merged_snapshot() -> Dict[str, Any]:
                        "fallback_records", "fallback_batches",
                        "degraded_batches", "replica_failures",
                        "replica_rebuilds", "batches",
-                       "occupancy_sum", "padded_rows", "swaps")}
+                       "occupancy_sum", "padded_rows", "swaps",
+                       "tenant_activations", "tenant_reactivations",
+                       "tenant_evictions")}
     acc["bucket_counts"] = {}
     acc["request_latency"] = LatencyHistogram()
     acc["batch_latency"] = LatencyHistogram()
     acc["replicas"] = {}
+    acc["tenants"] = {}
     n = 0
     for m in list(_instances):
         m._merge_into(acc)
@@ -274,6 +354,10 @@ def merged_snapshot() -> Dict[str, Any]:
                     "request_latency": st["request_latency"].to_json(),
                     "batch_latency": st["batch_latency"].to_json()}
         for slot, st in sorted(acc["replicas"].items())}
+    acc["tenants"] = {
+        tenant: {**{k: v for k, v in st.items() if k != "request_latency"},
+                 "request_latency": st["request_latency"].to_json()}
+        for tenant, st in sorted(acc["tenants"].items())}
     acc["instances"] = n
     sketches = [m._sketch for m in list(_instances)
                 if getattr(m, "_sketch", None) is not None]
@@ -309,6 +393,26 @@ def prometheus_replica_text(snapshot: Dict[str, Any]) -> str:
                 if isinstance(v, (int, float)):
                     lines.append(
                         f"tmog_serve_replica_{hist}_{q}{labels} {v}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def prometheus_tenant_text(snapshot: Dict[str, Any]) -> str:
+    """Labelled per-tenant lines (``{tenant=...}``) — same rationale as
+    :func:`prometheus_replica_text`: the label keeps 64 tenants as one
+    queryable series family instead of 64 metric names."""
+    lines = []
+    for tenant, st in sorted(snapshot.get("tenants", {}).items()):
+        labels = f'{{tenant="{tenant}"}}'
+        for k in ("requests", "responses", "shed", "errors",
+                  "data_faults", "slo_violations"):
+            if k in st:
+                lines.append(f"tmog_serve_tenant_{k}{labels} {st[k]}")
+        hj = st.get("request_latency") or {}
+        for q in ("count", "mean_ms", "p50_ms", "p99_ms"):
+            v = hj.get(q)
+            if isinstance(v, (int, float)):
+                lines.append(
+                    f"tmog_serve_tenant_request_latency_{q}{labels} {v}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 

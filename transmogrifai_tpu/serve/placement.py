@@ -1,0 +1,171 @@
+"""Tenant placement: cost-model-priced bin-packing of models onto chips.
+
+One serving plane now hosts N named tenants (``registry.deploy(model,
+tenant="checkout")``) on the same device fleet; this module decides WHICH
+slots host WHICH tenants.  The analogue of the sweep's LPT partitioner
+(``parallel/spec_partition``), applied to serving:
+
+- every tenant is priced as ``expected busy-seconds per second`` =
+  predicted per-batch wall x observed per-tenant QPS.  The per-batch wall
+  comes from the learned cost model when it is opted in (``TMOG_COSTMODEL=1``
+  + loadable artifact — the same activation contract every other consumer
+  follows) and otherwise from the analytic ``spec_units``-style prior
+  (rows x contract width), which only needs to be RIGHT relatively: bin
+  packing consumes load ratios, not absolute seconds;
+- tenants are packed longest-processing-time-first onto the least-loaded
+  slot, with slot ties broken by the underlying physical chip's load (an
+  oversubscribed CPU proxy cycles 8 slots over fewer cores; a real mesh
+  cycles ``TMOG_SERVE_REPLICAS`` slots over its chips) and then by slot
+  index, so a plan is a pure function of its inputs;
+- equal-load tenants (the cold-start case: no QPS observed yet) keep their
+  SUBMISSION order through the stable sort, which makes placement of T
+  fresh tenants on S slots exactly round-robin ``tenant i -> slot i % S``
+  — deterministic oversubscription when tenants outnumber chips.
+
+The registry calls :func:`plan` incrementally (``fixed`` carries the
+already-resident tenants so activating one tenant never shuffles the
+others) and stamps the chosen slots + pricing source into ``info()``.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence
+
+from ..utils import env as _env
+
+__all__ = ["TenantLoad", "PlacementPlan", "tenant_units", "batch_wall_s",
+           "replicas_per_tenant", "plan"]
+
+#: analytic seconds per cost unit when the learned model is off — the
+#: absolute scale is irrelevant to packing (only load RATIOS matter); the
+#: constant exists so priced walls are always well-formed seconds.
+_NOMINAL_S_PER_UNIT = 1e-6
+
+
+class TenantLoad(NamedTuple):
+    """One tenant's pricing inputs: analytic cost units per batch and the
+    observed request rate (0.0 for a tenant that has not served yet)."""
+
+    name: str
+    units: float
+    qps: float
+
+
+class PlacementPlan(NamedTuple):
+    """``slots[tenant]`` -> ordered slot indices; ``load[slot]`` -> packed
+    busy-fraction; ``source`` is "costmodel" or "analytic"."""
+
+    slots: Dict[str, List[int]]
+    load: List[float]
+    source: str
+
+
+def tenant_units(entry: Any, bucket: Optional[int] = None) -> float:
+    """Analytic per-batch cost units for one deployed model: batch rows x
+    input-contract width — the serving analogue of the sweep's
+    ``spec_units`` (rows x features) prior.  ``entry`` is a ``ServingModel``
+    (or anything with ``buckets`` / ``contract``); models without a
+    derivable contract price at width 1, which still ranks them sanely
+    against each other."""
+    if bucket is None:
+        buckets = getattr(entry, "buckets", None)
+        bucket = buckets[-1] if buckets else 64
+    contract = getattr(entry, "contract", None)
+    width = len(getattr(contract, "fields", ()) or ()) or 1
+    return float(bucket) * float(width)
+
+
+def batch_wall_s(units: float) -> tuple:
+    """Predicted per-batch wall seconds for ``units`` analytic cost units.
+
+    Learned path: the active cost model's seconds-per-unit calibration for
+    the ``serve`` family (``CostModel.unit_scale`` — regularized toward the
+    analytic prior, so a sparse artifact degrades gracefully).  Analytic
+    path (``TMOG_COSTMODEL`` off, missing/corrupt artifact): a fixed nominal
+    scale — bit-identical plans whether the constant is 1e-6 or 1.0,
+    because packing consumes ratios.  Returns ``(wall_s, source)``."""
+    from .. import costmodel
+
+    m = costmodel.active_model()
+    if m is not None:
+        try:
+            return max(units, 1.0) * m.unit_scale("serve"), "costmodel"
+        except Exception:  # noqa: BLE001 — degrade exactly like other consumers
+            from ..obs import registry as obs_registry
+
+            obs_registry.record_fallback("costmodel", "serve_unit_scale_failed")
+    return max(units, 1.0) * _NOMINAL_S_PER_UNIT, "analytic"
+
+
+def replicas_per_tenant(n_slots: int, n_tenants: int) -> int:
+    """Slots per tenant: ``TMOG_TENANT_REPLICAS`` when set, else spread —
+    every tenant gets at least one slot, and while the fleet has spare
+    capacity tenants fan out over it (``n_slots // n_tenants``, floored at
+    1).  16 tenants on 8 slots -> 1 each (oversubscribed); 2 tenants on 8
+    slots -> 4 each."""
+    k = _env.env_int("TMOG_TENANT_REPLICAS", 0)
+    if k > 0:
+        return min(k, max(n_slots, 1))
+    return max(1, n_slots // max(n_tenants, 1))
+
+
+def plan(tenants: Sequence[TenantLoad], n_slots: int,
+         chip_of: Optional[Sequence[int]] = None,
+         per_tenant: Optional[int] = None,
+         fixed: Optional[Dict[str, Sequence[int]]] = None) -> PlacementPlan:
+    """Pack ``tenants`` onto ``n_slots`` serving slots.
+
+    ``chip_of`` maps slot -> physical chip ordinal (slots oversubscribing a
+    chip share its budget; default: one chip per slot).  ``fixed`` pins
+    already-placed tenants to their slots — their load is accounted, their
+    assignment never moves (incremental activation must not shuffle
+    resident tenants).  Deterministic: stable LPT over (load desc,
+    submission order), slot choice by (chip load, slot load, slot index).
+    """
+    if n_slots <= 0:
+        raise ValueError("plan() needs at least one slot")
+    chip_of = list(chip_of) if chip_of is not None else list(range(n_slots))
+    if len(chip_of) != n_slots:
+        raise ValueError(f"chip_of has {len(chip_of)} entries for "
+                         f"{n_slots} slots")
+    n_chips = max(chip_of) + 1 if chip_of else n_slots
+    slot_load = [0.0] * n_slots
+    chip_load = [0.0] * n_chips
+    out: Dict[str, List[int]] = {}
+    source = "analytic"
+
+    priced = []
+    for t in tenants:
+        wall, src = batch_wall_s(t.units)
+        if src == "costmodel":
+            source = "costmodel"
+        # busy-fraction; a tenant with no observed traffic still needs a
+        # home, so the floor keeps fresh tenants comparable to each other
+        priced.append((t, wall * max(t.qps, 1.0)))
+
+    fixed = fixed or {}
+    for t, load in priced:
+        slots = fixed.get(t.name)
+        if slots is None:
+            continue
+        slots = [int(s) for s in slots]
+        out[t.name] = slots
+        for s in slots:
+            slot_load[s] += load / len(slots)
+            chip_load[chip_of[s]] += load / len(slots)
+
+    k = per_tenant if per_tenant is not None else replicas_per_tenant(
+        n_slots, len(priced))
+    movable = [(t, load) for t, load in priced if t.name not in fixed]
+    # stable: equal loads keep submission order -> fresh tenants round-robin
+    movable.sort(key=lambda pair: -pair[1])
+    for t, load in movable:
+        k_t = min(max(k, 1), n_slots)
+        chosen: List[int] = []
+        for _ in range(k_t):
+            best = min((s for s in range(n_slots) if s not in chosen),
+                       key=lambda s: (chip_load[chip_of[s]], slot_load[s], s))
+            chosen.append(best)
+            slot_load[best] += load / k_t
+            chip_load[chip_of[best]] += load / k_t
+        out[t.name] = chosen
+    return PlacementPlan(out, slot_load, source)

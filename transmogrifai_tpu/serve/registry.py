@@ -23,6 +23,20 @@ Deploy discipline: **load -> warm -> swap -> drain**, now per replica.
 
 A failed warmup aborts the deploy and leaves every active replica
 untouched.
+
+Multi-tenant fleet (PR 20): the registry also hosts N concurrent NAMED
+tenants on the same slot fleet (``deploy(model, tenant="checkout")``).
+Each tenant is bin-packed onto a subset of slots by ``serve/placement``
+(cost-model-predicted per-batch wall x observed QPS; analytic prior when
+``TMOG_COSTMODEL`` is off), keeps its own version history and rolling
+per-slot hot-swap (one tenant's promotion never dents another's capacity),
+and participates in an LRU activation tier: ``TMOG_MAX_ACTIVE_TENANTS``
+caps how many tenants hold device executables at once, eviction DRAINS
+in-flight work (futures always resolve) and drops only the device state —
+the host-side model is kept, so the first request to a cold tenant
+re-activates it through the compile cache / AOT memo zero-compile warm
+path.  The legacy single-model API is the ``default`` tenant, placed on
+every slot, outside the LRU tier — its behavior is unchanged.
 """
 from __future__ import annotations
 
@@ -36,10 +50,15 @@ from ..local.scoring import BatchScoreFunction, ScoreFunction
 from ..obs import registry as obs_registry
 from ..obs import trace
 from ..resilience import inject as _inject
+from ..utils import env as _env
 from ..workflow.model import OpWorkflowModel
 from .metrics import ServeMetrics
 
 DEFAULT_MAX_BATCH = 64
+
+#: the legacy single-model API's tenant name — placed on every slot and
+#: never LRU-evicted, so pre-tenant callers keep their exact semantics
+DEFAULT_TENANT = "default"
 
 
 def shape_buckets(max_batch: int) -> List[int]:
@@ -228,6 +247,47 @@ class ServingModel:
         return True
 
 
+class TenantState:
+    """Bookkeeping for one named tenant on the shared slot fleet.
+
+    ``slot_map`` (global slot -> this tenant's Replica on that slot) exists
+    only while the tenant is resident; ``model`` / ``version`` / ``slots``
+    survive eviction so re-activation rebuilds the identical ServingModel
+    on the identical slots (bit-identical outputs, zero-compile warm via
+    the AOT memo + persistent compile cache).
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self.active: Optional[ServingModel] = None
+        self.model: Optional[OpWorkflowModel] = None
+        self.version: Optional[str] = None
+        self.history: List[str] = []
+        self.slots: List[int] = []
+        self.slot_map: Dict[int, Replica] = {}
+        self.resident = False
+        self.last_used = time.monotonic()
+        #: EWMA requests/sec observed by the batcher — the QPS half of the
+        #: placement price (units x qps); 0.0 until traffic arrives
+        self.qps = 0.0
+        self._last_req: Optional[float] = None
+        self.units = 0.0
+        self.activations = 0
+        self.evictions = 0
+
+    def touch(self) -> None:
+        """One admitted request: LRU recency + the QPS EWMA."""
+        now = time.monotonic()
+        self.last_used = now
+        if self._last_req is not None:
+            dt = now - self._last_req
+            if dt > 0:
+                inst = min(1.0 / dt, 1e6)
+                self.qps = inst if self.qps == 0.0 \
+                    else 0.9 * self.qps + 0.1 * inst
+        self._last_req = now
+
+
 class ModelRegistry:
     """Versioned models behind N fixed replica slots (rolling hot-swap)."""
 
@@ -249,6 +309,13 @@ class ModelRegistry:
         #: the ReplicaSupervisor watching these slots, when serving started
         #: one (serve/supervisor.py); wired by the batcher/server lifecycle
         self.supervisor = None
+        #: named tenants sharing the slot fleet (the default tenant is NOT
+        #: tracked here; its state stays in _active/_slots/_history)
+        self._tenants: Dict[str, TenantState] = {}
+        #: serializes tenant activation/eviction so two cold tenants'
+        #: first requests cannot double-build or over-evict
+        self._activate_lock = threading.Lock()
+        self._placement_source: Optional[str] = None
 
     @property
     def n_replicas(self) -> int:
@@ -264,11 +331,19 @@ class ModelRegistry:
             return list(self._slots)
 
     def deploy(self, model: OpWorkflowModel, version: Optional[str] = None,
-               warm: bool = True, drain_timeout_s: Optional[float] = 30.0
-               ) -> ServingModel:
+               warm: bool = True, drain_timeout_s: Optional[float] = 30.0,
+               tenant: str = DEFAULT_TENANT) -> ServingModel:
         """load -> warm -> rolling per-slot swap+drain; returns the active
         version.  Capacity never drops: every slot keeps its current replica
-        until the moment its replacement (already warmed) is installed."""
+        until the moment its replacement (already warmed) is installed.
+
+        With a named ``tenant`` the model joins the multi-tenant fleet:
+        placed on its bin-packed slot subset, versioned per tenant, swapped
+        rolling over its OWN slots only (other tenants' capacity untouched),
+        and subject to the ``TMOG_MAX_ACTIVE_TENANTS`` LRU tier."""
+        if tenant != DEFAULT_TENANT:
+            return self._deploy_tenant(tenant, model, version, warm,
+                                       drain_timeout_s)
         with self._lock:
             version = version or f"v{len(self._history) + 1}"
             if version in self._history:
@@ -301,27 +376,329 @@ class ModelRegistry:
             old.drain(drain_timeout_s)  # belt-and-braces for legacy guards
         return entry
 
+    # ---- multi-tenant fleet ------------------------------------------------
+    @staticmethod
+    def max_active_tenants() -> int:
+        """``TMOG_MAX_ACTIVE_TENANTS``: LRU cap on resident named tenants
+        (0 = unbounded).  Read per activation so tests/operators can turn
+        the tier on against a live registry."""
+        return max(0, _env.env_int("TMOG_MAX_ACTIVE_TENANTS", 0))
+
+    def _tenant(self, name: str, create: bool = False
+                ) -> Optional[TenantState]:
+        with self._lock:
+            st = self._tenants.get(name)
+            if st is None and create:
+                st = self._tenants[name] = TenantState(name)
+            return st
+
+    def _place_tenant(self, st: TenantState) -> List[int]:
+        """Slot subset for one tenant: sticky across redeploy/reactivation
+        (stable placement is what makes reactivation bit-identical and
+        incremental activation non-disruptive); fresh tenants are bin-packed
+        against the currently resident fleet."""
+        if st.slots:
+            return list(st.slots)
+        from ..parallel.mesh import serve_chip_index
+        from . import placement
+
+        with self._lock:
+            others = [t for t in self._tenants.values()
+                      if t.resident and t.name != st.name]
+            fixed = {t.name: list(t.slots) for t in others}
+            loads = [placement.TenantLoad(t.name, t.units or 1.0, t.qps)
+                     for t in others]
+            loads.append(placement.TenantLoad(st.name, st.units or 1.0,
+                                              st.qps))
+        p = placement.plan(loads, len(self.devices),
+                           chip_of=serve_chip_index(self.devices),
+                           fixed=fixed)
+        self._placement_source = p.source
+        return p.slots[st.name]
+
+    def _evict_for_capacity(self, keep: str,
+                            drain_timeout_s: Optional[float] = 30.0) -> None:
+        """LRU-evict resident named tenants until ``keep`` fits the
+        ``TMOG_MAX_ACTIVE_TENANTS`` tier (callers hold _activate_lock)."""
+        cap = self.max_active_tenants()
+        if cap <= 0:
+            return
+        while True:
+            with self._lock:
+                others = [t for t in self._tenants.values()
+                          if t.resident and t.name != keep]
+                if len(others) < cap:
+                    return
+                victim = min(others, key=lambda t: t.last_used).name
+            self.evict_tenant(victim, drain_timeout_s)
+
+    def _install_tenant(self, st: TenantState, entry: ServingModel,
+                        slots: List[int],
+                        drain_timeout_s: Optional[float]) -> None:
+        """Rolling per-slot install of a warmed ServingModel onto the
+        tenant's slots: each slot swaps under the lock then drains its old
+        occupant, so the tenant (and everyone else) keeps full capacity."""
+        with trace.span("serve.tenant_swap", tenant=st.name,
+                        version=entry.version, slots=len(slots)):
+            with self._lock:
+                old_map = dict(st.slot_map)
+                st.slots = list(slots)
+                st.resident = True
+                st.active = entry
+                entry.deployed_at_ms = int(time.time() * 1000)
+            for i, slot in enumerate(slots):
+                with self._lock:
+                    old_rep = st.slot_map.get(slot)
+                    st.slot_map[slot] = entry.replicas[i]
+                if old_rep is not None:
+                    with trace.span("serve.drain", replica=old_rep.id):
+                        old_rep.drain(drain_timeout_s)
+            # slots the old placement held but the new one does not
+            for slot, rep in old_map.items():
+                if slot not in slots:
+                    with self._lock:
+                        if st.slot_map.get(slot) is rep:
+                            del st.slot_map[slot]
+                    rep.drain(drain_timeout_s)
+
+    def _tenant_sketch(self, name: str, model: OpWorkflowModel) -> None:
+        """Per-tenant drift sketch (continual/), attached to the shared
+        metrics sink — guarded: drift accounting must never fail a deploy."""
+        if self.metrics is None or not hasattr(self.metrics, "attach_sketch"):
+            return
+        try:
+            from ..continual.drift import ServeSketch, baselines_from_model
+
+            self.metrics.attach_sketch(ServeSketch(
+                baselines_from_model(model)), tenant=name)
+        except TypeError:
+            pass  # a foreign metrics sink without per-tenant sketches
+        except Exception as e:  # noqa: BLE001 — serving beats sketching
+            obs_registry.record_fallback("serve", "tenant_sketch_failed",
+                                         tenant=name, error=repr(e))
+
+    def _deploy_tenant(self, name: str, model: OpWorkflowModel,
+                       version: Optional[str], warm: bool,
+                       drain_timeout_s: Optional[float]) -> ServingModel:
+        st = self._tenant(name, create=True)
+        with self._lock:
+            version = version or f"{name}-v{len(st.history) + 1}"
+            if version in st.history:
+                raise ValueError(f"Version {version!r} already deployed "
+                                 f"for tenant {name!r}")
+        with self._activate_lock:
+            self._evict_for_capacity(keep=name,
+                                     drain_timeout_s=drain_timeout_s)
+            slots = self._place_tenant(st)
+            entry = ServingModel(version, model, self.buckets,
+                                 devices=[self.devices[s] for s in slots])
+            st.units = self._units_of(entry)
+            if warm:
+                entry.warmup()  # raises -> tenant state untouched
+            with self._lock:
+                st.model = model
+                st.version = version
+                st.history.append(version)
+                st.activations += 1
+            self._install_tenant(st, entry, slots, drain_timeout_s)
+        if self.metrics is not None:
+            self.metrics.inc("tenant_activations")
+            self.metrics.inc("swaps")
+        self._tenant_sketch(name, model)
+        return entry
+
+    @staticmethod
+    def _units_of(entry: ServingModel) -> float:
+        from . import placement
+
+        try:
+            return placement.tenant_units(entry)
+        except Exception:  # noqa: BLE001 — pricing must not fail a deploy
+            return 1.0
+
+    def ensure_active(self, tenant: str = DEFAULT_TENANT) -> ServingModel:
+        """The tenant's active ServingModel, re-activating it from the LRU
+        cold tier if needed — the warm path: same model object, same slots,
+        so every executable comes from the AOT memo / compile cache with
+        zero XLA compiles."""
+        if tenant == DEFAULT_TENANT:
+            return self.active()
+        st = self._tenant(tenant)
+        if st is None or st.model is None:
+            raise LookupError(f"No model deployed for tenant {tenant!r}; "
+                              f"call registry.deploy(model, tenant=...)")
+        with self._lock:
+            if st.resident and st.active is not None:
+                return st.active
+        with self._activate_lock:
+            with self._lock:
+                if st.resident and st.active is not None:
+                    return st.active  # another request won the race
+                model, version = st.model, st.version
+            with trace.span("serve.tenant_reactivate", tenant=tenant,
+                            version=version):
+                self._evict_for_capacity(keep=tenant)
+                slots = self._place_tenant(st)
+                entry = ServingModel(version, model, self.buckets,
+                                     devices=[self.devices[s]
+                                              for s in slots])
+                entry.warmup()
+                with self._lock:
+                    st.activations += 1
+                self._install_tenant(st, entry, slots, None)
+        if self.metrics is not None:
+            self.metrics.inc("tenant_activations")
+            self.metrics.inc("tenant_reactivations")
+        return entry
+
+    def evict_tenant(self, name: str,
+                     drain_timeout_s: Optional[float] = 30.0) -> bool:
+        """Demote one tenant to the cold tier: replicas leave the slot maps
+        (no new batches route to them), in-flight batches DRAIN to
+        completion (futures always resolve), device executables are
+        released.  Host-side model/version/placement survive, so
+        :meth:`ensure_active` restores it zero-compile.  Returns False for
+        an unknown or already-cold tenant; the default tenant never
+        evicts."""
+        if name == DEFAULT_TENANT:
+            return False
+        with self._lock:
+            st = self._tenants.get(name)
+            if st is None or not st.resident:
+                return False
+            st.resident = False
+            old_map, st.slot_map = st.slot_map, {}
+            st.active = None
+        with trace.span("serve.tenant_evict", tenant=name,
+                        replicas=len(old_map)):
+            for rep in old_map.values():
+                rep.drain(drain_timeout_s)
+        with self._lock:
+            st.evictions += 1
+        if self.metrics is not None:
+            self.metrics.inc("tenant_evictions")
+        return True
+
+    def touch_tenant(self, tenant: str) -> None:
+        """Batcher admission hook: LRU recency + QPS EWMA (placement's
+        observed-rate input).  No-op for the default tenant."""
+        if tenant == DEFAULT_TENANT:
+            return
+        st = self._tenant(tenant)
+        if st is not None:
+            with self._lock:
+                st.touch()
+
+    def tenant_replica(self, tenant: str, slot: int) -> Optional[Replica]:
+        """The tenant's replica on one global slot (None when the tenant is
+        cold, unknown, or not placed there)."""
+        if tenant == DEFAULT_TENANT:
+            return self.replica(slot)
+        with self._lock:
+            st = self._tenants.get(tenant)
+            return None if st is None else st.slot_map.get(slot)
+
+    def tenant_slots(self, tenant: str) -> List[int]:
+        """Global slot indices serving this tenant (all slots for the
+        default tenant; a cold tenant keeps its sticky placement)."""
+        if tenant == DEFAULT_TENANT:
+            return list(range(len(self._slots)))
+        with self._lock:
+            st = self._tenants.get(tenant)
+            return [] if st is None else list(st.slots)
+
+    def tenant_active(self, tenant: str = DEFAULT_TENANT
+                      ) -> Optional[ServingModel]:
+        """The tenant's active entry WITHOUT re-activating a cold one."""
+        if tenant == DEFAULT_TENANT:
+            with self._lock:
+                return self._active
+        with self._lock:
+            st = self._tenants.get(tenant)
+            return None if st is None else st.active
+
+    def tenant_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._tenants)
+
+    def slot_inflight(self, slot: int) -> int:
+        """Outstanding scoring on one slot's DEVICE across every tenant —
+        the batcher's routing load signal (a chip busy for tenant A is just
+        as busy for tenant B)."""
+        with self._lock:
+            reps = [self._slots[slot]] if slot < len(self._slots) else []
+            reps += [st.slot_map.get(slot) for st in self._tenants.values()
+                     if st.resident]
+        return sum(r.inflight for r in reps if r is not None)
+
+    def tenants_info(self) -> Dict[str, Dict[str, object]]:
+        with self._lock:
+            return {name: {
+                "resident": st.resident,
+                "version": st.version,
+                "versions": list(st.history),
+                "slots": list(st.slots),
+                "qps": round(st.qps, 3),
+                "units": round(st.units, 1),
+                "activations": st.activations,
+                "evictions": st.evictions,
+            } for name, st in sorted(self._tenants.items())}
+
     def rebuild_slot(self, slot: int) -> Optional[Replica]:
-        """Self-healing: replace one slot's replica with a freshly built and
-        warmed copy of the ACTIVE version's artifact (same model, same
+        """Self-healing: replace one slot's replicas — the default tenant's
+        AND every resident named tenant placed there — with freshly built
+        and warmed copies of their active artifacts (same model, same
         device).  Warmup routes through the persistent compile cache, so a
         rebuild is milliseconds, not a recompile.  Returns the installed
-        replica, or None when nothing is deployed; a failed warm raises and
-        leaves the slot untouched.  The dead occupant is NOT drained — its
-        in-flight batches already failed, which is why we are here."""
+        default replica (or the first rebuilt tenant replica when no
+        default model is deployed), or None when nothing lives on the slot;
+        a failed warm raises and leaves that occupant untouched.  Dead
+        occupants are NOT drained — their in-flight batches already failed,
+        which is why we are here."""
         with self._lock:
             entry = self._active
-        if entry is None:
-            return None
-        with trace.span("serve.rebuild", slot=slot, version=entry.version):
-            rep = Replica(entry, slot, self.devices[slot])
+            tenant_names = [st.name for st in self._tenants.values()
+                            if st.resident and slot in st.slot_map]
+        out: Optional[Replica] = None
+        if entry is not None:
+            with trace.span("serve.rebuild", slot=slot,
+                            version=entry.version):
+                rep = Replica(entry, slot, self.devices[slot])
+                rep.warm()
+            with self._lock:
+                if self._active is not entry:
+                    # a deploy raced the rebuild: its fresh slots win
+                    out = self._slots[slot]
+                else:
+                    self._slots[slot] = rep
+                    entry.replicas[slot] = rep
+                    out = rep
+            if self.metrics is not None:
+                self.metrics.inc("replica_rebuilds")
+        for name in tenant_names:
+            rebuilt = self._rebuild_tenant_slot(name, slot)
+            if out is None:
+                out = rebuilt
+        return out
+
+    def _rebuild_tenant_slot(self, name: str, slot: int
+                             ) -> Optional[Replica]:
+        with self._lock:
+            st = self._tenants.get(name)
+            t_entry = None if st is None or not st.resident else st.active
+            if t_entry is None or slot not in st.slots:
+                return None
+            local = st.slots.index(slot)
+        with trace.span("serve.rebuild", slot=slot, tenant=name,
+                        version=t_entry.version):
+            rep = Replica(t_entry, local, self.devices[slot])
             rep.warm()
         with self._lock:
-            if self._active is not entry:
-                # a deploy raced the rebuild: its fresh slots win
-                return self._slots[slot]
-            self._slots[slot] = rep
-            entry.replicas[slot] = rep
+            if st.active is not t_entry or not st.resident:
+                # a tenant redeploy/eviction raced the rebuild
+                return st.slot_map.get(slot)
+            st.slot_map[slot] = rep
+            t_entry.replicas[local] = rep
         if self.metrics is not None:
             self.metrics.inc("replica_rebuilds")
         return rep
@@ -364,4 +741,7 @@ class ModelRegistry:
             "health": None if sup is None else sup.health(),
             "slo": (None if sup is None or getattr(sup, "slo", None) is None
                     else sup.slo.status()),
+            "tenants": self.tenants_info(),
+            "max_active_tenants": self.max_active_tenants(),
+            "placement_source": self._placement_source,
         }

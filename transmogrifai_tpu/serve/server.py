@@ -9,13 +9,15 @@ HTTP 429 (load shedding — overload degrades explicitly, never by hanging).
 Endpoints:
 
 - ``POST /score``   — body: one record object, a list of records, or
-  ``{"records": [...]}``; response carries the scoring model's version.
-  Records violating the active model's input contract fail PER ROW: the
-  response is HTTP 422 with ``errors`` entries ``{"index", "reason", ...}``
-  and ``scores`` still filled for the valid co-batched rows (a non-list
-  body or non-dict list item is a structural 400, also row-indexed).
+  ``{"records": [...], "tenant"?: "name"}`` (``?tenant=name`` also works);
+  response carries the scoring model's version.  Records violating the
+  tenant's input contract fail PER ROW: the response is HTTP 422 with
+  ``errors`` entries ``{"index", "reason", ...}`` and ``scores`` still
+  filled for the valid co-batched rows (a non-list body or non-dict list
+  item is a structural 400, also row-indexed); an unknown tenant is 404.
 - ``POST /models``  — hot-swap: ``{"path": "<saved model dir>",
-  "version": "v2"?}`` loads, warms and atomically swaps via the registry.
+  "version": "v2"?, "tenant": "name"?}`` loads, warms and atomically swaps
+  via the registry (per tenant when named — other tenants keep serving).
 - ``GET /metrics``  — serve metrics snapshot + registry/queue state;
   ``GET /metrics?format=prometheus`` renders the full obs registry snapshot
   (sweep/stream/flops/serve) in Prometheus text exposition format.
@@ -34,8 +36,9 @@ from urllib.parse import parse_qs, urlsplit
 from .. import obs
 from ..resilience.quarantine import DataFault
 from .batcher import MicroBatcher, ShedError
-from .metrics import ServeMetrics, prometheus_replica_text
-from .registry import ModelRegistry
+from .metrics import (ServeMetrics, prometheus_replica_text,
+                      prometheus_tenant_text)
+from .registry import DEFAULT_TENANT, ModelRegistry
 
 
 class ModelServer:
@@ -153,8 +156,10 @@ def _make_handler(server: "ModelServer"):
                     # exposition — same numbers as the JSON payload — plus
                     # properly-labelled per-replica series (the generic
                     # flattener is label-free)
+                    snap = server.metrics.snapshot()
                     text = obs.prometheus_text(obs.snapshot())
-                    text += prometheus_replica_text(server.metrics.snapshot())
+                    text += prometheus_replica_text(snap)
+                    text += prometheus_tenant_text(snap)
                     self._reply_text(200, text)
                     return
                 try:  # continual counters ride along (defaults via import)
@@ -186,9 +191,10 @@ def _make_handler(server: "ModelServer"):
 
         # ---- POST ----------------------------------------------------------
         def do_POST(self):
-            if self.path == "/score":
+            path = urlsplit(self.path).path
+            if path == "/score":
                 self._score()
-            elif self.path == "/models":
+            elif path == "/models":
                 self._deploy()
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
@@ -199,6 +205,12 @@ def _make_handler(server: "ModelServer"):
             except (ValueError, json.JSONDecodeError):
                 self._reply(400, {"error": "invalid JSON body"})
                 return
+            # tenant selection: ?tenant=name query param, or a "tenant" key
+            # riding next to "records" in the body envelope
+            tenant = parse_qs(urlsplit(self.path).query).get(
+                "tenant", [DEFAULT_TENANT])[0] or DEFAULT_TENANT
+            if isinstance(body, dict) and "records" in body:
+                tenant = body.get("tenant") or tenant
             single = isinstance(body, dict) and "records" not in body
             records = [body] if single else \
                 (body["records"] if isinstance(body, dict) else body)
@@ -222,13 +234,17 @@ def _make_handler(server: "ModelServer"):
             try:
                 for i, r in enumerate(records):
                     try:
-                        futures[i] = server.batcher.submit(r)
+                        futures[i] = server.batcher.submit(r, tenant=tenant)
                     except DataFault as e:
                         d = e.to_json()
                         d["index"] = i
                         row_errors.append(d)
             except ShedError as e:
                 self._reply(429, {"error": str(e), "shed": True})
+                return
+            except LookupError as e:
+                # unknown tenant / nothing deployed for it: client error
+                self._reply(404, {"error": str(e)})
                 return
             outputs: list = [None] * len(records)
             version = None
@@ -248,11 +264,19 @@ def _make_handler(server: "ModelServer"):
                     d = e.to_json()
                     d["index"] = i
                     row_errors.append(d)
+                except LookupError as e:
+                    # unknown tenant / nothing deployed for it: client error
+                    self._reply(404, {"error": str(e)})
+                    return
                 except Exception as e:  # noqa: BLE001 — system errors stay 500
                     self._reply(500, {"error": str(e)})
                     return
             if version is None:
-                version = server.registry.active_version()
+                if tenant != DEFAULT_TENANT:
+                    st = server.registry.info()["tenants"].get(tenant) or {}
+                    version = st.get("version")
+                else:
+                    version = server.registry.active_version()
             if row_errors:
                 row_errors.sort(key=lambda d: d["index"])
                 payload = {"error": f"{len(row_errors)} of {len(records)} "
@@ -275,15 +299,23 @@ def _make_handler(server: "ModelServer"):
                 path = body["path"]
             except Exception:
                 self._reply(400, {"error": "expected {\"path\": ..., "
-                                           "\"version\"?: ...}"})
+                                           "\"version\"?: ..., "
+                                           "\"tenant\"?: ...}"})
                 return
+            tenant = body.get("tenant") or DEFAULT_TENANT
             try:
                 from ..workflow.model import load_model
 
                 entry = server.registry.deploy(load_model(path),
-                                               version=body.get("version"))
+                                               version=body.get("version"),
+                                               tenant=tenant)
             except Exception as e:  # noqa: BLE001 — bad model must not kill serving
                 self._reply(400, {"error": f"deploy failed: {e}"})
+                return
+            if tenant != DEFAULT_TENANT:
+                info = server.registry.info()["tenants"].get(tenant) or {}
+                self._reply(200, {"tenant": tenant, "active": entry.version,
+                                  "versions": info.get("versions", [])})
                 return
             self._reply(200, {"active": entry.version,
                               "versions": server.registry.versions()})
